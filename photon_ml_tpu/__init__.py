@@ -1,0 +1,20 @@
+"""photon-ml-tpu: a TPU-native generalized-linear-model + GAME framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of LinkedIn Photon-ML
+(reference: /root/reference, Spark/Scala). Nothing here is a port: the
+Spark RDD/broadcast/treeAggregate choreography is replaced by pjit-sharded
+device arrays with XLA collectives over ICI, and the per-entity random-effect
+solves become vmapped batched solvers under shard_map.
+
+Layering (see SURVEY.md section 7):
+  core/      pytrees: batches, coefficients, normalization
+  ops/       pointwise losses, fused GLM objectives, metrics, statistics
+  solvers/   L-BFGS / OWL-QN / TRON as jitted lax.while_loop machines
+  models/    GLM + GAME model classes and the supervised training API
+  game/      GAME datasets, coordinates, coordinate descent
+  parallel/  mesh / sharding helpers, distributed init
+  io/        Avro codec, model save/load, feature vocabularies
+  cli/       train / score drivers with typed configs
+"""
+
+__version__ = "0.1.0"

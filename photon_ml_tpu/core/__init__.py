@@ -1,0 +1,9 @@
+from photon_ml_tpu.core.types import LabeledBatch, Coefficients
+from photon_ml_tpu.core.normalization import NormalizationContext, NormalizationType
+
+__all__ = [
+    "LabeledBatch",
+    "Coefficients",
+    "NormalizationContext",
+    "NormalizationType",
+]

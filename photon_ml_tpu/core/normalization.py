@@ -1,0 +1,129 @@
+"""Feature normalization as whitening algebra folded into the objective.
+
+Reference: ``normalization/NormalizationContext.scala:41-151`` and
+``normalization/NormalizationType.java:21-44``. The reference never densifies
+sparse vectors: the aggregators fold (factors, shifts) into effective
+coefficients and margin shifts (``function/ValueAndGradientAggregator.scala:87-118``).
+We keep exactly that algebra — the model is *trained in normalized space*
+(x' = (x - shift) * factor) but the margin is computed against raw features:
+
+    margin = x' . w = x . (w * factor) - sum(shift * factor * w)
+
+so the normalized-space objective costs one extra dot product per evaluation
+and never materializes normalized features. ``transform_model_coefficients``
+maps the converged normalized-space solution back to raw-feature space
+(``NormalizationContext.scala:77-94``): w_raw = w * factor, with the intercept
+absorbing the shift term.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.types import Coefficients, _pytree_dataclass
+
+
+class NormalizationType(enum.Enum):
+    """``normalization/NormalizationType.java:21-44``."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+@_pytree_dataclass
+class NormalizationContext:
+    """(factors, shifts) whitening parameters; intercept excluded from both.
+
+    factors: (d,) multiplicative scale, or None for identity
+    shifts:  (d,) subtractive shift, or None for zero
+    A None intercept_index means no intercept column exists.
+    """
+
+    factors: Optional[jax.Array]
+    shifts: Optional[jax.Array]
+
+    def effective_coefficients(self, w: jax.Array) -> jax.Array:
+        """coef * factor — the sparse-safe reparameterization
+        (``ValueAndGradientAggregator.scala:95-104``)."""
+        return w * self.factors if self.factors is not None else w
+
+    def margin_shift(self, w: jax.Array) -> jax.Array:
+        """Constant-in-x margin correction: -shift . effective_coefficients
+        (``ValueAndGradientAggregator.scala:106-118``)."""
+        if self.shifts is None:
+            return jnp.zeros((), w.dtype)
+        return -jnp.dot(self.shifts, self.effective_coefficients(w))
+
+    def transform_model_coefficients(
+        self, coef: Coefficients, intercept_index: Optional[int]
+    ) -> Coefficients:
+        """Map normalized-space solution to raw-feature space
+        (``NormalizationContext.scala:77-94``)."""
+        w = coef.means
+        w_raw = self.effective_coefficients(w)
+        if self.shifts is not None:
+            if intercept_index is None:
+                raise ValueError(
+                    "normalization with shifts requires an intercept "
+                    "(reference Params.scala:166-169)"
+                )
+            w_raw = w_raw.at[intercept_index].add(self.margin_shift(w))
+        variances = coef.variances
+        if variances is not None and self.factors is not None:
+            variances = variances * self.factors**2
+        return Coefficients(means=w_raw, variances=variances)
+
+
+def no_normalization() -> NormalizationContext:
+    """``normalization/NoNormalization.scala`` — identity context."""
+    return NormalizationContext(factors=None, shifts=None)
+
+
+def build_normalization_context(
+    norm_type: NormalizationType,
+    summary,
+    intercept_index: Optional[int],
+) -> NormalizationContext:
+    """``NormalizationContext.apply`` (``NormalizationContext.scala:96-151``):
+    derive (factors, shifts) from a feature summary.
+
+    summary must expose .mean, .variance, .max_abs as (d,) arrays
+    (see ops/stats.py BasicStatisticalSummary).
+    """
+    if norm_type == NormalizationType.NONE:
+        return no_normalization()
+
+    d = summary.mean.shape[0]
+
+    def protect(x):
+        # guard zero-variance / zero-magnitude features: factor 1.0
+        return jnp.where(x > 0, x, 1.0)
+
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors = 1.0 / jnp.sqrt(protect(summary.variance))
+        shifts = None
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors = 1.0 / protect(summary.max_abs)
+        shifts = None
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        factors = 1.0 / jnp.sqrt(protect(summary.variance))
+        shifts = summary.mean
+    else:
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        factors = factors.at[intercept_index].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[intercept_index].set(0.0)
+    elif shifts is not None:
+        raise ValueError(
+            "standardization requires an intercept term "
+            "(reference Params.scala:166-169)"
+        )
+    return NormalizationContext(factors=factors, shifts=shifts)

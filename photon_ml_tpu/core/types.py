@@ -1,0 +1,153 @@
+"""Core pytrees: labeled batches and model coefficients.
+
+TPU-first redesign of the reference's per-row objects. The reference keeps one
+JVM object per example (``data/LabeledPoint.scala:29`` — label, Breeze feature
+vector, offset, weight) and one per GAME example (``data/GameDatum.scala:32``).
+On TPU everything is struct-of-arrays: a batch is a dense ``(n, d)`` feature
+matrix (bfloat16/float32) plus ``(n,)`` label / offset / weight columns, padded
+to a static shape with a validity mask so XLA sees fixed shapes only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pytree_dataclass(cls):
+    """Register a dataclass as a JAX pytree (all fields are children)."""
+    cls = dataclasses.dataclass(frozen=True)(cls)
+    fields = [f.name for f in dataclasses.fields(cls)]
+
+    def flatten(obj):
+        return [getattr(obj, name) for name in fields], None
+
+    def unflatten(_, children):
+        return cls(*children)
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls
+
+
+@_pytree_dataclass
+class LabeledBatch:
+    """A fixed-shape batch of labeled examples.
+
+    Fields mirror the reference ``LabeledPoint`` (``data/LabeledPoint.scala:29``)
+    column-wise:
+      features: (n, d) dense design matrix (sparse inputs are densified or
+                hash-bucketed at ingest; CSR batches live in ops/sparse.py)
+      labels:   (n,) response
+      offsets:  (n,) fixed per-example margin added to x.w (GAME residual trick)
+      weights:  (n,) importance weights
+      mask:     (n,) 1.0 for real rows, 0.0 for padding. All reductions are
+                mask-weighted so padding is algebraically invisible.
+    """
+
+    features: jax.Array
+    labels: jax.Array
+    offsets: jax.Array
+    weights: jax.Array
+    mask: jax.Array
+
+    @property
+    def num_features(self) -> int:
+        return self.features.shape[-1]
+
+    @property
+    def batch_size(self) -> int:
+        return self.features.shape[-2]
+
+    def effective_weights(self) -> jax.Array:
+        """Weights with padding zeroed — the only weights kernels should use."""
+        return self.weights * self.mask
+
+    def with_offsets(self, offsets: jax.Array) -> "LabeledBatch":
+        return dataclasses.replace(self, offsets=offsets)
+
+    def add_scores_to_offsets(self, scores: jax.Array) -> "LabeledBatch":
+        """TPU analog of ``DataSet.addScoresToOffsets`` (``data/DataSet.scala:23``):
+        the reference does an RDD join; here it is plain array addition."""
+        return dataclasses.replace(self, offsets=self.offsets + scores)
+
+    @staticmethod
+    def create(
+        features,
+        labels,
+        offsets=None,
+        weights=None,
+        mask=None,
+        dtype=jnp.float32,
+    ) -> "LabeledBatch":
+        features = jnp.asarray(features, dtype)
+        n = features.shape[-2]
+        labels = jnp.asarray(labels, dtype)
+        offsets = jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype)
+        weights = jnp.ones((n,), dtype) if weights is None else jnp.asarray(weights, dtype)
+        mask = jnp.ones((n,), dtype) if mask is None else jnp.asarray(mask, dtype)
+        return LabeledBatch(features, labels, offsets, weights, mask)
+
+    @staticmethod
+    def pad_to(batch: "LabeledBatch", n: int) -> "LabeledBatch":
+        """Pad a batch to `n` rows with masked (invisible) rows."""
+        cur = batch.batch_size
+        if cur == n:
+            return batch
+        if cur > n:
+            raise ValueError(f"cannot pad batch of {cur} rows down to {n}")
+        pad = n - cur
+
+        def pad_rows(x):
+            widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths)
+
+        return LabeledBatch(
+            features=pad_rows(batch.features),
+            labels=pad_rows(batch.labels),
+            offsets=pad_rows(batch.offsets),
+            weights=pad_rows(batch.weights),
+            mask=pad_rows(batch.mask),
+        )
+
+
+@_pytree_dataclass
+class Coefficients:
+    """Model coefficients: means plus optional per-coefficient variances.
+
+    Mirrors ``model/Coefficients.scala:27-86`` (means, variancesOption,
+    computeScore). Variances come from the inverse Hessian diagonal
+    (``optimization/game/OptimizationProblem.scala:64-116``).
+    """
+
+    means: jax.Array
+    variances: Optional[jax.Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features: jax.Array) -> jax.Array:
+        """score = x . w  (``model/Coefficients.scala`` computeScore)."""
+        return features @ self.means
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dim,), dtype))
+
+    @staticmethod
+    def of(means, variances=None) -> "Coefficients":
+        means = jnp.asarray(means)
+        if variances is not None:
+            variances = jnp.asarray(variances)
+        return Coefficients(means=means, variances=variances)
+
+
+def tree_vdot(a, b) -> jax.Array:
+    """Sum of elementwise products over two identical pytrees."""
+    leaves = jax.tree_util.tree_map(lambda x, y: jnp.vdot(x, y), a, b)
+    return jax.tree_util.tree_reduce(jnp.add, leaves)

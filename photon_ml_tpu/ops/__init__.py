@@ -1,0 +1,19 @@
+from photon_ml_tpu.ops.losses import (
+    PointwiseLoss,
+    LOGISTIC_LOSS,
+    SQUARED_LOSS,
+    POISSON_LOSS,
+    SMOOTHED_HINGE_LOSS,
+    loss_for_task,
+)
+from photon_ml_tpu.ops.objective import GLMObjective
+
+__all__ = [
+    "PointwiseLoss",
+    "LOGISTIC_LOSS",
+    "SQUARED_LOSS",
+    "POISSON_LOSS",
+    "SMOOTHED_HINGE_LOSS",
+    "loss_for_task",
+    "GLMObjective",
+]

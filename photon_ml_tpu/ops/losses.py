@@ -1,0 +1,123 @@
+"""Pointwise GLM losses: l(z, y), dl/dz, d2l/dz2 on the margin z = x.w + offset.
+
+Rebuild of the reference's ``function/PointwiseLossFunction.scala:23-39``
+interface and its four concrete losses. Each loss is a triple of vectorized
+functions of (margins, labels); everything else (weighting, reduction,
+regularization, normalization) lives in ops/objective.py. First derivatives
+are also available by autodiff, but the analytic forms below are what the
+fused kernels use (one transcendental per element instead of a VJP graph).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """l(z,y), l'(z,y), l''(z,y) — all elementwise over same-shape arrays."""
+
+    name: str
+    value: Callable[[jax.Array, jax.Array], jax.Array]
+    d1: Callable[[jax.Array, jax.Array], jax.Array]
+    d2: Callable[[jax.Array, jax.Array], jax.Array]
+    # E[y|z] link inverse for scoring (``GeneralizedLinearModel.computeMean``).
+    mean: Callable[[jax.Array], jax.Array] = lambda z: z
+    # smoothed hinge is first-order only in the reference (LBFGS-only,
+    # ``function/SmoothedHingeLossFunction.scala:24-60``); its d2 is a
+    # subgradient-style surrogate and TRON refuses it (models/training.py).
+    twice_differentiable: bool = True
+
+
+def _logistic_value(z, y):
+    # l = log(1 + exp(-z)) for y=1, log(1 + exp(z)) for y=0/-1.
+    # Stable via softplus, matching util/Utils.log1pExp
+    # (``function/LogisticLossFunction.scala:31-88``). Labels are {0,1}.
+    s = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
+    return jax.nn.softplus(-s * z)
+
+
+def _logistic_d1(z, y):
+    s = 2.0 * y - 1.0
+    return -s * jax.nn.sigmoid(-s * z)  # = sigmoid(z) - y for y in {0,1}
+
+
+def _logistic_d2(z, y):
+    p = jax.nn.sigmoid(z)
+    return p * (1.0 - p)
+
+
+LOGISTIC_LOSS = PointwiseLoss(
+    name="logistic",
+    value=_logistic_value,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=jax.nn.sigmoid,
+)
+
+
+SQUARED_LOSS = PointwiseLoss(
+    # l = 0.5 (z - y)^2  (``function/SquaredLossFunction.scala:29-64``)
+    name="squared",
+    value=lambda z, y: 0.5 * (z - y) ** 2,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+)
+
+
+POISSON_LOSS = PointwiseLoss(
+    # l = exp(z) - y z  (``function/PoissonLossFunction.scala:29-81``)
+    name="poisson",
+    value=lambda z, y: jnp.exp(z) - y * z,
+    d1=lambda z, y: jnp.exp(z) - y,
+    d2=lambda z, y: jnp.exp(z),
+    mean=jnp.exp,
+)
+
+
+def _smoothed_hinge_value(z, y):
+    # Rennie smoothed hinge on s*z with s in {-1,+1}
+    # (``function/SmoothedHingeLossFunction.scala:24-60``): labels {0,1}.
+    s = 2.0 * y - 1.0
+    m = s * z
+    return jnp.where(m >= 1.0, 0.0, jnp.where(m <= 0.0, 0.5 - m, 0.5 * (1.0 - m) ** 2))
+
+
+def _smoothed_hinge_d1(z, y):
+    s = 2.0 * y - 1.0
+    m = s * z
+    dldm = jnp.where(m >= 1.0, 0.0, jnp.where(m <= 0.0, -1.0, m - 1.0))
+    return s * dldm
+
+
+def _smoothed_hinge_d2(z, y):
+    s = 2.0 * y - 1.0
+    m = s * z
+    return jnp.where((m > 0.0) & (m < 1.0), 1.0, 0.0)
+
+
+SMOOTHED_HINGE_LOSS = PointwiseLoss(
+    name="smoothed_hinge",
+    value=_smoothed_hinge_value,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    twice_differentiable=False,
+)
+
+
+_LOSS_BY_TASK = {
+    "LOGISTIC_REGRESSION": LOGISTIC_LOSS,
+    "LINEAR_REGRESSION": SQUARED_LOSS,
+    "POISSON_REGRESSION": POISSON_LOSS,
+    "SMOOTHED_HINGE_LOSS_LINEAR_SVM": SMOOTHED_HINGE_LOSS,
+}
+
+
+def loss_for_task(task_type) -> PointwiseLoss:
+    """Task → loss dispatch (``ModelTraining.scala:50-93``)."""
+    key = getattr(task_type, "name", task_type)
+    return _LOSS_BY_TASK[key]

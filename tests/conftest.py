@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU "pod".
+
+The reference fakes a cluster with local-mode Spark
+(``photon-test/.../SparkTestUtils.scala:31-75``, local[4]). Our analog is
+XLA's host-platform device-count flag: every test sees 8 CPU "chips" so the
+full mesh/sharding/collective path is exercised without TPU hardware.
+Must run before the first jax import, hence module-level in conftest.
+"""
+
+# Force CPU: the suite must be hermetic and double-precision-capable even when
+# the session has a live TPU tunnel (JAX_PLATFORMS=axon in the environment).
+# The image's sitecustomize imports jax at interpreter startup, so env vars
+# are too late here — jax.config updates are the only mechanism that works
+# (valid any time before first backend use).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

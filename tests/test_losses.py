@@ -1,0 +1,80 @@
+"""Derivative identities for every pointwise loss vs finite differences.
+
+Mirrors the reference's loss unit tests
+(``function/LogisticLossFunctionTest.scala``,
+``function/ObjectiveFunctionTest.scala``), which check analytic gradients and
+Hessian-vector products against central differences.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.losses import (
+    LOGISTIC_LOSS,
+    POISSON_LOSS,
+    SMOOTHED_HINGE_LOSS,
+    SQUARED_LOSS,
+)
+
+ALL_LOSSES = [LOGISTIC_LOSS, SQUARED_LOSS, POISSON_LOSS, SMOOTHED_HINGE_LOSS]
+
+
+def _labels_for(loss, rng, n):
+    if loss.name in ("logistic", "smoothed_hinge"):
+        return rng.integers(0, 2, n).astype(float)
+    if loss.name == "poisson":
+        return rng.poisson(2.0, n).astype(float)
+    return rng.normal(size=n)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_d1_matches_finite_difference(loss, rng):
+    z = rng.normal(size=64) * 2.0
+    y = _labels_for(loss, rng, 64)
+    eps = 1e-6
+    fd = (np.asarray(loss.value(z + eps, y)) - np.asarray(loss.value(z - eps, y))) / (
+        2 * eps
+    )
+    np.testing.assert_allclose(np.asarray(loss.d1(z, y)), fd, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "loss", [l for l in ALL_LOSSES if l.twice_differentiable], ids=lambda l: l.name
+)
+def test_d2_matches_finite_difference(loss, rng):
+    z = rng.normal(size=64) * 2.0
+    y = _labels_for(loss, rng, 64)
+    eps = 1e-5
+    fd = (np.asarray(loss.d1(z + eps, y)) - np.asarray(loss.d1(z - eps, y))) / (2 * eps)
+    np.testing.assert_allclose(np.asarray(loss.d2(z, y)), fd, rtol=1e-3, atol=1e-5)
+
+
+def test_d1_matches_autodiff(rng):
+    for loss in ALL_LOSSES:
+        z = jnp.asarray(rng.normal(size=32))
+        y = jnp.asarray(_labels_for(loss, rng, 32))
+        auto = jax.vmap(jax.grad(lambda zz, yy: loss.value(zz, yy)))(z, y)
+        np.testing.assert_allclose(
+            np.asarray(loss.d1(z, y)), np.asarray(auto), rtol=1e-6, atol=1e-8
+        )
+
+
+def test_logistic_loss_is_stable_at_extreme_margins():
+    # util/Utils.log1pExp stability (LogisticLossFunction.scala:31)
+    z = jnp.asarray([-1e4, -50.0, 0.0, 50.0, 1e4])
+    y = jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0])
+    v = LOGISTIC_LOSS.value(z, y)
+    assert bool(jnp.all(jnp.isfinite(v)))
+    assert v[0] == pytest.approx(1e4)
+    assert v[2] == pytest.approx(np.log(2.0))
+
+
+def test_smoothed_hinge_piecewise_values():
+    # SmoothedHingeLossFunction.scala: 0 beyond margin 1, quadratic in (0,1),
+    # linear below 0; continuous at the knots.
+    y = jnp.ones((3,))
+    z = jnp.asarray([2.0, 0.5, -1.0])
+    v = np.asarray(SMOOTHED_HINGE_LOSS.value(z, y))
+    np.testing.assert_allclose(v, [0.0, 0.125, 1.5])
