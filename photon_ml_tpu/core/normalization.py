@@ -99,8 +99,6 @@ def build_normalization_context(
     if norm_type == NormalizationType.NONE:
         return no_normalization()
 
-    d = summary.mean.shape[0]
-
     def protect(x):
         # guard zero-variance / zero-magnitude features: factor 1.0
         return jnp.where(x > 0, x, 1.0)

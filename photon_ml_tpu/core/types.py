@@ -11,12 +11,10 @@ to a static shape with a validity mask so XLA sees fixed shapes only.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def _pytree_dataclass(cls):

@@ -120,4 +120,9 @@ _LOSS_BY_TASK = {
 def loss_for_task(task_type) -> PointwiseLoss:
     """Task → loss dispatch (``ModelTraining.scala:50-93``)."""
     key = getattr(task_type, "name", task_type)
+    if key not in _LOSS_BY_TASK:
+        raise ValueError(
+            f"unknown task type {task_type!r}; expected one of "
+            f"{sorted(_LOSS_BY_TASK)}"
+        )
     return _LOSS_BY_TASK[key]

@@ -1,0 +1,30 @@
+"""Solvers: jitted, vmappable convex optimizers (L-BFGS, OWL-QN, TRON).
+
+TPU rebuild of the reference's ``optimization/`` layer
+(``optimization/Optimizer.scala:31``, ``optimization/LBFGS.scala:41``,
+``optimization/TRON.scala:82``). One implementation serves both execution
+regimes of the reference's ``Either[RDD, Iterable]`` duality
+(``optimization/Optimizer.scala:163-212``): the *global* instantiation runs
+the whole iteration on-device under pjit/shard_map (gradients psum-reduced
+over ICI), the *per-entity* instantiation is the same while_loop under vmap
+with per-entity masked convergence.
+"""
+
+from photon_ml_tpu.solvers.common import (
+    ConvergenceReason,
+    SolverConfig,
+    SolverResult,
+    project_to_hypercube,
+)
+from photon_ml_tpu.solvers.lbfgs import minimize_lbfgs, minimize_owlqn
+from photon_ml_tpu.solvers.tron import minimize_tron
+
+__all__ = [
+    "ConvergenceReason",
+    "SolverConfig",
+    "SolverResult",
+    "project_to_hypercube",
+    "minimize_lbfgs",
+    "minimize_owlqn",
+    "minimize_tron",
+]

@@ -1,0 +1,144 @@
+"""Shared solver machinery: convergence semantics, configs, result pytrees.
+
+Convergence criteria reproduce ``optimization/AbstractOptimizer.scala:49-63``
+exactly, *relative to the initial state*:
+
+  - FUNCTION_VALUES_CONVERGED:  |f_prev - f_cur| <= tol * f_initial
+  - GRADIENT_CONVERGED:         ||g_cur|| <= tol * ||g_initial||
+  - MAX_ITERATIONS
+  - OBJECTIVE_NOT_IMPROVING (TRON's improvement-failure budget,
+    ``optimization/TRON.scala:136-224``)
+
+Reasons are int32 codes (not Python enums) so they live on device and survive
+jit/vmap — per-entity convergence histograms
+(``optimization/game/RandomEffectOptimizationTracker.scala:33-110``) are then
+one ``jnp.bincount`` away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.types import _pytree_dataclass
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Device-friendly codes; mirrors ``optimization/ConvergenceReason.scala``."""
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Static (trace-time) solver knobs.
+
+    Defaults follow the reference: L-BFGS maxIter 80 / tol 1e-7 / 10
+    corrections (``optimization/LBFGS.scala:129-133``); TRON overrides via
+    ``tron_*`` fields (``optimization/TRON.scala:230-237``).
+    """
+
+    max_iters: int = 80
+    tolerance: float = 1e-7
+    num_corrections: int = 10
+    # line search
+    ls_max_evals: int = 20
+    ls_c1: float = 1e-4
+    ls_c2: float = 0.9
+    # TRON inner CG (``TRON.scala:252-319``)
+    tron_max_cg: int = 20
+    tron_cg_tol: float = 0.1
+    tron_max_failures: int = 5
+    # Box constraints (``optimization/OptimizationUtils.scala``): arrays of
+    # shape (d,) or None. Applied by coefficient clipping after each step.
+    lower_bounds: Optional[jax.Array] = None
+    upper_bounds: Optional[jax.Array] = None
+    # Record (value, |grad|) per iteration into fixed-size device buffers
+    # (``optimization/OptimizationStatesTracker.scala:33-115``).
+    track_states: bool = True
+
+
+@_pytree_dataclass
+class SolverResult:
+    """What a solve returns — all device arrays, so it vmaps cleanly.
+
+    ``values``/``grad_norms`` are (max_iters+1,) tracker buffers; entries at
+    index > iterations are garbage and must be masked by callers (the tracker
+    wrapper does this). Mirrors OptimizerState + OptimizationStatesTracker.
+    """
+
+    w: jax.Array
+    value: jax.Array
+    grad: jax.Array
+    iterations: jax.Array  # int32
+    reason: jax.Array  # int32 ConvergenceReason code
+    values: jax.Array  # (max_iters+1,) objective per iteration
+    grad_norms: jax.Array  # (max_iters+1,) ||grad|| per iteration
+
+
+def project_to_hypercube(
+    w: jax.Array,
+    lower: Optional[jax.Array],
+    upper: Optional[jax.Array],
+) -> jax.Array:
+    """``OptimizationUtils.projectCoefficientsToHypercube`` as jnp.clip."""
+    if lower is None and upper is None:
+        return w
+    return jnp.clip(
+        w,
+        -jnp.inf if lower is None else lower,
+        jnp.inf if upper is None else upper,
+    )
+
+
+def check_convergence(
+    value_prev: jax.Array,
+    value_cur: jax.Array,
+    grad_norm_cur: jax.Array,
+    value_initial: jax.Array,
+    grad_norm_initial: jax.Array,
+    iteration: jax.Array,
+    max_iters: int,
+    tolerance: float,
+) -> jax.Array:
+    """Return the ConvergenceReason code (0 = keep going).
+
+    Order matters and follows ``AbstractOptimizer.convergenceReason:49-63``:
+    max-iterations, then function values, then gradient.
+    """
+    reason = jnp.int32(ConvergenceReason.NOT_CONVERGED)
+    grad_conv = grad_norm_cur <= tolerance * grad_norm_initial
+    reason = jnp.where(
+        grad_conv, jnp.int32(ConvergenceReason.GRADIENT_CONVERGED), reason
+    )
+    func_conv = jnp.abs(value_prev - value_cur) <= tolerance * jnp.abs(value_initial)
+    reason = jnp.where(
+        func_conv, jnp.int32(ConvergenceReason.FUNCTION_VALUES_CONVERGED), reason
+    )
+    reason = jnp.where(
+        iteration >= max_iters, jnp.int32(ConvergenceReason.MAX_ITERATIONS), reason
+    )
+    return reason
+
+
+def tracker_buffers(
+    max_iters: int, dtype, track: bool = True
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-iteration (value, ||grad||) buffers. With track=False the buffers
+    collapse to one slot (holding the latest state) so vmapped per-entity
+    solves don't carry (entities, max_iters) tracker state."""
+    size = max_iters + 1 if track else 1
+    return jnp.full((size,), jnp.nan, dtype), jnp.full((size,), jnp.nan, dtype)
+
+
+def record_state(values, grad_norms, i, value, grad_norm):
+    i = jnp.minimum(i, values.shape[0] - 1)
+    return values.at[i].set(value), grad_norms.at[i].set(grad_norm)

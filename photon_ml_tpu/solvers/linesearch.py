@@ -1,0 +1,225 @@
+"""Strong-Wolfe line search as a single jittable state machine.
+
+The reference delegates line search to breeze's StrongWolfeLineSearch inside
+``breeze.optimize.LBFGS`` (wrapped at ``optimization/LBFGS.scala:56-98``).
+There is no breeze here, so this is a from-scratch implementation of the
+classic bracket/zoom algorithm (Nocedal & Wright, Alg. 3.5/3.6) expressed as
+one ``lax.while_loop`` that performs exactly ONE objective evaluation per
+trip — the evaluation is the expensive, distributed part (a full value+grad
+pass over the sharded batch), so the eval budget is the real cost model.
+
+Stages: 0 = bracketing, 1 = zoom, 2 = accepted, 3 = failed.
+The whole thing is vmappable (used by the batched per-entity L-BFGS path).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_BRACKET = 0
+_ZOOM = 1
+_DONE = 2
+_FAIL = 3
+
+
+class _LSState(NamedTuple):
+    stage: jax.Array
+    i: jax.Array
+    # candidate to evaluate next
+    a: jax.Array
+    # previous bracketing point
+    a_prev: jax.Array
+    phi_prev: jax.Array
+    dphi_prev: jax.Array
+    # zoom interval
+    a_lo: jax.Array
+    phi_lo: jax.Array
+    dphi_lo: jax.Array
+    a_hi: jax.Array
+    phi_hi: jax.Array
+    dphi_hi: jax.Array
+    # accepted point
+    a_star: jax.Array
+    phi_star: jax.Array
+
+
+def _cubic_min(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi):
+    """Minimizer of the cubic through (a_lo, phi_lo, dphi_lo), (a_hi, phi_hi,
+    dphi_hi); safeguarded to the interior of the interval, bisection fallback."""
+    d1 = dphi_lo + dphi_hi - 3.0 * (phi_lo - phi_hi) / (a_lo - a_hi)
+    rad = d1 * d1 - dphi_lo * dphi_hi
+    sqrt_rad = jnp.sqrt(jnp.maximum(rad, 0.0))
+    d2 = jnp.sign(a_hi - a_lo) * sqrt_rad
+    denom = dphi_hi - dphi_lo + 2.0 * d2
+    cand = a_hi - (a_hi - a_lo) * (dphi_hi + d2 - d1) / denom
+    lo = jnp.minimum(a_lo, a_hi)
+    hi = jnp.maximum(a_lo, a_hi)
+    width = hi - lo
+    inside = (cand > lo + 0.1 * width) & (cand < hi - 0.1 * width)
+    ok = (rad >= 0.0) & (jnp.abs(denom) > 1e-20) & jnp.isfinite(cand) & inside
+    return jnp.where(ok, cand, 0.5 * (a_lo + a_hi))
+
+
+def strong_wolfe(
+    phi_fn: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+    phi0: jax.Array,
+    dphi0: jax.Array,
+    alpha_init: jax.Array,
+    c1: float = 1e-4,
+    c2: float = 0.9,
+    max_evals: int = 20,
+    alpha_max: float = 1e10,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Find alpha with  phi(a) <= phi0 + c1*a*dphi0  and  |phi'(a)| <= c2*|dphi0|.
+
+    phi_fn(alpha) -> (phi, dphi) along the fixed search direction.
+    Returns (alpha, phi(alpha), ok). On failure ok=False and alpha is the best
+    Armijo-satisfying point seen (possibly 0.0 = no progress).
+    """
+    dtype = phi0.dtype
+    zero = jnp.zeros((), dtype)
+
+    init = _LSState(
+        stage=jnp.int32(_BRACKET),
+        i=jnp.int32(0),
+        a=jnp.asarray(alpha_init, dtype),
+        a_prev=zero,
+        phi_prev=phi0,
+        dphi_prev=dphi0,
+        a_lo=zero,
+        phi_lo=phi0,
+        dphi_lo=dphi0,
+        a_hi=zero,
+        phi_hi=phi0,
+        dphi_hi=dphi0,
+        a_star=zero,
+        phi_star=phi0,
+    )
+
+    def armijo_ok(a, phi):
+        return phi <= phi0 + c1 * a * dphi0
+
+    def curvature_ok(dphi):
+        return jnp.abs(dphi) <= -c2 * dphi0
+
+    def body(s: _LSState) -> _LSState:
+        phi_a, dphi_a = phi_fn(s.a)
+
+        def bracket_step(s: _LSState) -> _LSState:
+            hit_armijo_fail = (~armijo_ok(s.a, phi_a)) | (
+                (phi_a >= s.phi_prev) & (s.i > 0)
+            )
+            hit_curv = curvature_ok(dphi_a)
+            hit_pos_slope = dphi_a >= 0.0
+
+            # -> zoom(prev, a)
+            to_zoom_pf = hit_armijo_fail
+            # accept a
+            accept = (~hit_armijo_fail) & hit_curv
+            # -> zoom(a, prev)
+            to_zoom_ap = (~hit_armijo_fail) & (~hit_curv) & hit_pos_slope
+            # keep extrapolating
+            extend = (~hit_armijo_fail) & (~hit_curv) & (~hit_pos_slope)
+
+            stage = jnp.where(
+                accept,
+                _DONE,
+                jnp.where(to_zoom_pf | to_zoom_ap, _ZOOM, _BRACKET),
+            ).astype(jnp.int32)
+
+            a_lo = jnp.where(to_zoom_pf, s.a_prev, jnp.where(to_zoom_ap, s.a, s.a_lo))
+            phi_lo = jnp.where(
+                to_zoom_pf, s.phi_prev, jnp.where(to_zoom_ap, phi_a, s.phi_lo)
+            )
+            dphi_lo = jnp.where(
+                to_zoom_pf, s.dphi_prev, jnp.where(to_zoom_ap, dphi_a, s.dphi_lo)
+            )
+            a_hi = jnp.where(to_zoom_pf, s.a, jnp.where(to_zoom_ap, s.a_prev, s.a_hi))
+            phi_hi = jnp.where(
+                to_zoom_pf, phi_a, jnp.where(to_zoom_ap, s.phi_prev, s.phi_hi)
+            )
+            dphi_hi = jnp.where(
+                to_zoom_pf, dphi_a, jnp.where(to_zoom_ap, s.dphi_prev, s.dphi_hi)
+            )
+
+            next_a = jnp.where(
+                stage == _ZOOM,
+                _cubic_min(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi),
+                jnp.minimum(2.0 * s.a, alpha_max),
+            )
+            return s._replace(
+                stage=stage,
+                a=jnp.where(extend, jnp.minimum(2.0 * s.a, alpha_max), next_a),
+                a_prev=jnp.where(extend, s.a, s.a_prev),
+                phi_prev=jnp.where(extend, phi_a, s.phi_prev),
+                dphi_prev=jnp.where(extend, dphi_a, s.dphi_prev),
+                a_lo=a_lo,
+                phi_lo=phi_lo,
+                dphi_lo=dphi_lo,
+                a_hi=a_hi,
+                phi_hi=phi_hi,
+                dphi_hi=dphi_hi,
+                a_star=jnp.where(accept, s.a, s.a_star),
+                phi_star=jnp.where(accept, phi_a, s.phi_star),
+            )
+
+        def zoom_step(s: _LSState) -> _LSState:
+            aj, phi_j, dphi_j = s.a, phi_a, dphi_a
+            shrink_hi = (~armijo_ok(aj, phi_j)) | (phi_j >= s.phi_lo)
+            accept = (~shrink_hi) & curvature_ok(dphi_j)
+            # hi <- lo when the new lo's slope points away from hi
+            flip = (~shrink_hi) & (~accept) & (dphi_j * (s.a_hi - s.a_lo) >= 0.0)
+
+            a_hi = jnp.where(shrink_hi, aj, jnp.where(flip, s.a_lo, s.a_hi))
+            phi_hi = jnp.where(shrink_hi, phi_j, jnp.where(flip, s.phi_lo, s.phi_hi))
+            dphi_hi = jnp.where(
+                shrink_hi, dphi_j, jnp.where(flip, s.dphi_lo, s.dphi_hi)
+            )
+            a_lo = jnp.where(shrink_hi, s.a_lo, aj)
+            phi_lo = jnp.where(shrink_hi, s.phi_lo, phi_j)
+            dphi_lo = jnp.where(shrink_hi, s.dphi_lo, dphi_j)
+
+            # Degenerate interval => stop with the best (lo) point.
+            tiny = jnp.abs(a_hi - a_lo) <= 1e-12 * jnp.maximum(
+                1.0, jnp.abs(a_hi)
+            )
+            stage = jnp.where(
+                accept, _DONE, jnp.where(tiny, _FAIL, _ZOOM)
+            ).astype(jnp.int32)
+            return s._replace(
+                stage=stage,
+                a=_cubic_min(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi),
+                a_lo=a_lo,
+                phi_lo=phi_lo,
+                dphi_lo=dphi_lo,
+                a_hi=a_hi,
+                phi_hi=phi_hi,
+                dphi_hi=dphi_hi,
+                a_star=jnp.where(accept, aj, s.a_star),
+                phi_star=jnp.where(accept, phi_j, s.phi_star),
+            )
+
+        s2 = lax.cond(s.stage == _BRACKET, bracket_step, zoom_step, s)
+        return s2._replace(i=s.i + 1)
+
+    def cond(s: _LSState) -> jax.Array:
+        return (s.stage < _DONE) & (s.i < max_evals)
+
+    final = lax.while_loop(cond, body, init)
+
+    accepted = final.stage == _DONE
+    # Fall back to the zoom interval's lo point: by invariant it satisfies
+    # Armijo whenever the zoom stage was entered.
+    fallback_ok = armijo_ok(final.a_lo, final.phi_lo) & (final.a_lo > 0.0)
+    alpha = jnp.where(
+        accepted, final.a_star, jnp.where(fallback_ok, final.a_lo, 0.0)
+    )
+    phi = jnp.where(
+        accepted, final.phi_star, jnp.where(fallback_ok, final.phi_lo, phi0)
+    )
+    ok = accepted | fallback_ok
+    return alpha, phi, ok

@@ -1,0 +1,260 @@
+"""Solver tests: convergence on analytic objectives from many random starts.
+
+Mirrors the reference's test strategy (``optimization/LBFGSTest.scala``,
+``optimization/OptimizerIntegTest.scala``, SURVEY §4): optimizers must reach
+the known optimum of convex objectives from multiple starts, and the batched
+(vmapped) instantiation must agree with the sequential one — the TPU analog
+of the RDD-vs-local `Either` duality contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_ml_tpu.solvers import (
+    ConvergenceReason,
+    SolverConfig,
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+)
+from photon_ml_tpu.solvers.tron import TRON_DEFAULT_CONFIG
+
+
+def quadratic_problem(rng, d=8):
+    """0.5 (w-c)' A (w-c) with SPD A."""
+    m = rng.normal(size=(d, d))
+    a = m @ m.T + d * np.eye(d)
+    c = rng.normal(size=(d,))
+    a_j, c_j = jnp.asarray(a), jnp.asarray(c)
+
+    def vg(w):
+        r = a_j @ (w - c_j)
+        return 0.5 * jnp.vdot(w - c_j, r), r
+
+    def hvp(w, v):
+        return a_j @ v
+
+    return vg, hvp, c
+
+
+def logistic_problem(rng, n=200, d=10, l2=0.1):
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=(d,))
+    p = 1.0 / (1.0 + np.exp(-x @ w_true))
+    y = (rng.uniform(size=n) < p).astype(np.float64)
+    x_j, y_j = jnp.asarray(x), jnp.asarray(y)
+
+    def vg(w):
+        z = x_j @ w
+        val = jnp.sum(jax.nn.softplus(z) - y_j * z) + 0.5 * l2 * jnp.vdot(w, w)
+        g = x_j.T @ (jax.nn.sigmoid(z) - y_j) + l2 * w
+        return val, g
+
+    def hvp(w, v):
+        z = x_j @ w
+        s = jax.nn.sigmoid(z)
+        return x_j.T @ (s * (1 - s) * (x_j @ v)) + l2 * v
+
+    def np_obj(w):
+        z = x @ w
+        return float(
+            np.sum(np.logaddexp(0.0, z) - y * z) + 0.5 * l2 * np.dot(w, w)
+        )
+
+    return vg, hvp, np_obj, d
+
+
+class TestLBFGS:
+    def test_quadratic_many_starts(self, rng):
+        vg, _, c = quadratic_problem(rng)
+        for _ in range(5):
+            w0 = jnp.asarray(rng.normal(size=c.shape) * 5)
+            # tolerance is relative to the initial state (AbstractOptimizer
+            # semantics); tighten it so the far starts still reach the optimum
+            cfg = SolverConfig(tolerance=1e-12)
+            res = jax.jit(lambda w: minimize_lbfgs(vg, w, cfg))(w0)
+            np.testing.assert_allclose(np.asarray(res.w), c, atol=1e-5)
+            assert int(res.reason) in (
+                ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+                ConvergenceReason.GRADIENT_CONVERGED,
+            )
+
+    def test_logistic_matches_scipy(self, rng):
+        vg, _, np_obj, d = logistic_problem(rng)
+        res = minimize_lbfgs(vg, jnp.zeros(d))
+        sp = scipy.optimize.minimize(np_obj, np.zeros(d), method="L-BFGS-B")
+        assert float(res.value) <= sp.fun + 1e-6
+
+    def test_tracker_buffers(self, rng):
+        vg, _, _ = quadratic_problem(rng, d=4)
+        res = minimize_lbfgs(vg, jnp.zeros(4))
+        iters = int(res.iterations)
+        vals = np.asarray(res.values)[: iters + 1]
+        assert np.all(np.isfinite(vals))
+        # objective decreases monotonically on a quadratic
+        assert np.all(np.diff(vals) <= 1e-12)
+
+    def test_box_constraints(self, rng):
+        vg, _, c = quadratic_problem(rng)
+        lb = jnp.asarray(np.full(c.shape, -0.1))
+        ub = jnp.asarray(np.full(c.shape, 0.1))
+        cfg = SolverConfig(lower_bounds=lb, upper_bounds=ub)
+        res = minimize_lbfgs(vg, jnp.zeros(c.shape[0]), cfg)
+        w = np.asarray(res.w)
+        assert np.all(w >= -0.1 - 1e-12) and np.all(w <= 0.1 + 1e-12)
+
+    def test_vmapped_batch_solve_matches_sequential(self, rng):
+        """The per-entity batched regime == the sequential regime."""
+        d = 6
+        probs = [quadratic_problem(rng, d) for _ in range(4)]
+        a_stack = []
+        c_stack = []
+        for _, _, c in probs:
+            c_stack.append(c)
+        # rebuild as stacked arrays for a single vmapped objective
+        mats = []
+        for _ in range(4):
+            m = rng.normal(size=(d, d))
+            mats.append(m @ m.T + d * np.eye(d))
+        a_stack = jnp.asarray(np.stack(mats))
+        c_stack = jnp.asarray(np.stack(c_stack))
+
+        def solve_one(a, c, w0):
+            def vg(w):
+                r = a @ (w - c)
+                return 0.5 * jnp.vdot(w - c, r), r
+
+            return minimize_lbfgs(vg, w0, SolverConfig(max_iters=60))
+
+        w0s = jnp.asarray(rng.normal(size=(4, d)))
+        batched = jax.jit(jax.vmap(solve_one))(a_stack, c_stack, w0s)
+        for i in range(4):
+            single = solve_one(a_stack[i], c_stack[i], w0s[i])
+            np.testing.assert_allclose(
+                np.asarray(batched.w[i]), np.asarray(single.w), atol=1e-5
+            )
+
+
+class TestOWLQN:
+    def test_lasso_matches_sklearn(self, rng):
+        from sklearn.linear_model import Lasso
+
+        n, d = 120, 15
+        x = rng.normal(size=(n, d))
+        w_true = np.zeros(d)
+        w_true[:3] = [2.0, -3.0, 1.5]
+        y = x @ w_true + 0.01 * rng.normal(size=n)
+        alpha = 0.1
+        x_j, y_j = jnp.asarray(x), jnp.asarray(y)
+
+        def vg(w):  # smooth part: (1/2n)||Xw - y||^2  (sklearn's scaling)
+            r = x_j @ w - y_j
+            return 0.5 * jnp.vdot(r, r) / n, x_j.T @ r / n
+
+        res = minimize_owlqn(vg, jnp.zeros(d), alpha, SolverConfig(max_iters=200))
+        skl = Lasso(alpha=alpha, fit_intercept=False, tol=1e-10).fit(x, y)
+
+        def full_obj(w):
+            return 0.5 * np.sum((x @ w - y) ** 2) / n + alpha * np.sum(np.abs(w))
+
+        ours, theirs = full_obj(np.asarray(res.w)), full_obj(skl.coef_)
+        assert ours <= theirs + 1e-6
+        # sparsity pattern recovered
+        assert np.sum(np.abs(np.asarray(res.w)) > 1e-6) <= 6
+
+    def test_l1_logistic_sparsity(self, rng):
+        n, d = 300, 20
+        x = rng.normal(size=(n, d))
+        w_true = np.zeros(d)
+        w_true[:2] = [3.0, -3.0]
+        p = 1.0 / (1.0 + np.exp(-x @ w_true))
+        y = (rng.uniform(size=n) < p).astype(np.float64)
+        x_j, y_j = jnp.asarray(x), jnp.asarray(y)
+
+        def vg(w):
+            z = x_j @ w
+            return (
+                jnp.sum(jax.nn.softplus(z) - y_j * z),
+                x_j.T @ (jax.nn.sigmoid(z) - y_j),
+            )
+
+        res = minimize_owlqn(vg, jnp.zeros(d), 20.0, SolverConfig(max_iters=200))
+        w = np.asarray(res.w)
+        assert np.abs(w[0]) > 1e-3 and np.abs(w[1]) > 1e-3
+        assert np.sum(np.abs(w) > 1e-8) < d  # some exact zeros
+
+    def test_zero_l1_matches_lbfgs(self, rng):
+        vg, _, np_obj, d = logistic_problem(rng)
+        res_owl = minimize_owlqn(vg, jnp.zeros(d), 0.0)
+        res_lb = minimize_lbfgs(vg, jnp.zeros(d))
+        np.testing.assert_allclose(
+            float(res_owl.value), float(res_lb.value), rtol=1e-6
+        )
+
+
+class TestTRON:
+    def test_quadratic_one_newton_step_region(self, rng):
+        vg, hvp, c = quadratic_problem(rng)
+        cfg = SolverConfig(max_iters=30, tolerance=1e-12)
+        res = minimize_tron(vg, hvp, jnp.asarray(rng.normal(size=c.shape)), cfg)
+        np.testing.assert_allclose(np.asarray(res.w), c, atol=1e-5)
+
+    def test_logistic_matches_scipy(self, rng):
+        vg, hvp, np_obj, d = logistic_problem(rng)
+        res = minimize_tron(vg, hvp, jnp.zeros(d), TRON_DEFAULT_CONFIG)
+        sp = scipy.optimize.minimize(np_obj, np.zeros(d), method="L-BFGS-B")
+        assert float(res.value) <= sp.fun + 1e-5
+
+    def test_many_starts(self, rng):
+        vg, hvp, np_obj, d = logistic_problem(rng)
+        values = []
+        for _ in range(4):
+            w0 = jnp.asarray(rng.normal(size=(d,)) * 3)
+            res = minimize_tron(vg, hvp, w0)
+            values.append(float(res.value))
+        assert np.ptp(values) < 1e-4  # all starts reach the same optimum
+
+    def test_vmapped_tron(self, rng):
+        d = 5
+        mats = np.stack(
+            [
+                (lambda m: m @ m.T + d * np.eye(d))(rng.normal(size=(d, d)))
+                for _ in range(3)
+            ]
+        )
+        cs = rng.normal(size=(3, d))
+        a_j, c_j = jnp.asarray(mats), jnp.asarray(cs)
+
+        def solve_one(a, c):
+            def vg(w):
+                r = a @ (w - c)
+                return 0.5 * jnp.vdot(w - c, r), r
+
+            return minimize_tron(
+                vg,
+                lambda w, v: a @ v,
+                jnp.zeros(d),
+                SolverConfig(max_iters=30, tolerance=1e-12),
+            )
+
+        out = jax.jit(jax.vmap(solve_one))(a_j, c_j)
+        np.testing.assert_allclose(np.asarray(out.w), cs, atol=1e-5)
+
+
+class TestConvergenceSemantics:
+    def test_max_iterations_reason(self, rng):
+        vg, _, _, d = logistic_problem(rng)
+        res = minimize_lbfgs(vg, jnp.zeros(d), SolverConfig(max_iters=2, tolerance=0.0))
+        assert int(res.reason) == ConvergenceReason.MAX_ITERATIONS
+        assert int(res.iterations) == 2
+
+    def test_already_converged_at_start(self):
+        def vg(w):
+            return jnp.vdot(w, w) * 0.5, w
+
+        res = minimize_lbfgs(vg, jnp.zeros(3))
+        assert int(res.reason) == ConvergenceReason.GRADIENT_CONVERGED
+        assert int(res.iterations) == 0
