@@ -1,9 +1,12 @@
 from photon_ml_tpu.core.types import LabeledBatch, Coefficients
 from photon_ml_tpu.core.normalization import NormalizationContext, NormalizationType
+from photon_ml_tpu.core.validators import DataValidationType, sanity_check_data
 
 __all__ = [
     "LabeledBatch",
     "Coefficients",
     "NormalizationContext",
     "NormalizationType",
+    "DataValidationType",
+    "sanity_check_data",
 ]

@@ -80,6 +80,32 @@ class NormalizationContext:
         return Coefficients(means=w_raw, variances=variances)
 
 
+    def inverse_transform_model_coefficients(
+        self, coef: Coefficients, intercept_index: Optional[int]
+    ) -> Coefficients:
+        """Raw-feature-space -> normalized-space coefficients (exact inverse
+        of ``transform_model_coefficients``); used to warm-start a
+        normalized-space solve from a previously exported model."""
+        w_raw = coef.means
+        if self.shifts is not None:
+            if intercept_index is None:
+                raise ValueError(
+                    "normalization with shifts requires an intercept "
+                    "(reference Params.scala:166-169)"
+                )
+            # w_raw_int = w_int + margin_shift(w) = w_int - sum(s*f*w), and
+            # s.f.w == s.w_raw off-intercept (shift/factor are 0/1 there)
+            correction = jnp.dot(self.shifts, w_raw) - (
+                self.shifts[intercept_index] * w_raw[intercept_index]
+            )
+            w_raw = w_raw.at[intercept_index].add(correction)
+        w = w_raw / self.factors if self.factors is not None else w_raw
+        variances = coef.variances
+        if variances is not None and self.factors is not None:
+            variances = variances / self.factors**2
+        return Coefficients(means=w, variances=variances)
+
+
 def no_normalization() -> NormalizationContext:
     """``normalization/NoNormalization.scala`` — identity context."""
     return NormalizationContext(factors=None, shifts=None)

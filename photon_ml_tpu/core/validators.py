@@ -1,0 +1,83 @@
+"""Data sanity validation, vectorized.
+
+Rebuild of ``data/DataValidators.scala:29-136`` + ``DataValidationType``:
+per-task row validators (finite features/offset/weight, finite label, binary
+label for classifiers, non-negative label for Poisson) composed per task and
+applied in FULL / SAMPLE (1%) / DISABLED modes. One masked jnp pass instead
+of per-row closures; returns offending-row counts for error messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import LabeledBatch
+
+
+class DataValidationType(enum.Enum):
+    """``DataValidationType.scala``."""
+
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+
+def _row_checks(batch: LabeledBatch, task: TaskType) -> Dict[str, jax.Array]:
+    """Per-check boolean (n,) arrays; True = row VIOLATES the check."""
+    m = batch.mask > 0
+    checks = {
+        "finite_features": m
+        & ~jnp.all(jnp.isfinite(batch.features), axis=-1),
+        "finite_label": m & ~jnp.isfinite(batch.labels),
+        "finite_offset": m & ~jnp.isfinite(batch.offsets),
+        "finite_weight": m & ~jnp.isfinite(batch.weights),
+    }
+    if task.is_classifier:
+        checks["binary_label"] = m & ~(
+            (batch.labels == 0.0) | (batch.labels == 1.0)
+        )
+    if task == TaskType.POISSON_REGRESSION:
+        checks["non_negative_label"] = m & (batch.labels < 0.0)
+    return checks
+
+
+@jax.jit
+def _violation_counts_jit(flags):
+    return {k: jnp.sum(v) for k, v in flags.items()}
+
+
+def sanity_check_data(
+    batch: LabeledBatch,
+    task: TaskType,
+    mode: DataValidationType = DataValidationType.VALIDATE_FULL,
+    sample_fraction: float = 0.01,
+    seed: int = 0,
+) -> Dict[str, int]:
+    """Raise ValueError on any violation (``DataValidators.sanityCheckData``).
+
+    Returns the (all-zero) per-check violation counts on success. SAMPLE mode
+    subsamples rows Bernoulli(sample_fraction) like the reference's 1% check.
+    """
+    if mode == DataValidationType.VALIDATE_DISABLED:
+        return {}
+    checked = batch
+    if mode == DataValidationType.VALIDATE_SAMPLE:
+        keep = (
+            jax.random.uniform(jax.random.PRNGKey(seed), batch.mask.shape)
+            < sample_fraction
+        )
+        checked = dataclasses.replace(batch, mask=batch.mask * keep)
+    counts = {
+        k: int(v)
+        for k, v in _violation_counts_jit(_row_checks(checked, task)).items()
+    }
+    bad = {k: v for k, v in counts.items() if v > 0}
+    if bad:
+        raise ValueError(f"input data failed validation: {bad}")
+    return counts

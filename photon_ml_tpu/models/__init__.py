@@ -1,0 +1,18 @@
+"""Supervised GLM models + training API (reference L4, ``supervised/``)."""
+
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, TaskType
+from photon_ml_tpu.models.training import (
+    GLMTrainingConfig,
+    OptimizerType,
+    TrainedModel,
+    train_glm,
+)
+
+__all__ = [
+    "GeneralizedLinearModel",
+    "TaskType",
+    "GLMTrainingConfig",
+    "OptimizerType",
+    "TrainedModel",
+    "train_glm",
+]

@@ -1,0 +1,75 @@
+"""Generalized linear models as pytrees.
+
+Rebuild of ``supervised/model/GeneralizedLinearModel.scala:27`` and its four
+task-specific subclasses (``supervised/classification/*.scala``,
+``supervised/regression/*.scala``). The reference uses a class per task; here
+one pytree carries the coefficients as children and the task as static aux
+data, so a model jits/vmaps like an array and task dispatch costs nothing at
+trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import Coefficients
+from photon_ml_tpu.ops.losses import loss_for_task
+
+__all__ = ["GeneralizedLinearModel", "TaskType"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """(coefficients, task). Registered as a pytree with `task` static."""
+
+    coefficients: Coefficients
+    task: TaskType
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def compute_margin(
+        self, features: jax.Array, offsets: Optional[jax.Array] = None
+    ) -> jax.Array:
+        m = features @ self.coefficients.means
+        return m if offsets is None else m + offsets
+
+    def compute_mean(
+        self, features: jax.Array, offsets: Optional[jax.Array] = None
+    ) -> jax.Array:
+        """E[y|x]: identity / sigmoid / exp link per task
+        (``GeneralizedLinearModel.computeMean`` overrides)."""
+        return loss_for_task(self.task).mean(self.compute_margin(features, offsets))
+
+    def predict_class(
+        self,
+        features: jax.Array,
+        threshold: float = 0.5,
+        offsets: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """``BinaryClassifier.predictClassWithThreshold``: mean > t -> 1.0."""
+        if not self.task.is_classifier:
+            raise ValueError(f"{self.task} is not a binary classifier")
+        return jnp.where(
+            self.compute_mean(features, offsets) > threshold, 1.0, 0.0
+        )
+
+    def validate_coefficients(self) -> bool:
+        """``GeneralizedLinearModel.validateCoefficients``: all finite."""
+        return bool(jnp.all(jnp.isfinite(self.coefficients.means)))
+
+    def with_coefficients(self, coefficients: Coefficients):
+        return dataclasses.replace(self, coefficients=coefficients)
+
+
+jax.tree_util.register_pytree_node(
+    GeneralizedLinearModel,
+    lambda m: ((m.coefficients,), m.task),
+    lambda task, children: GeneralizedLinearModel(children[0], task),
+)

@@ -1,0 +1,58 @@
+"""Best-model selection on validation data.
+
+Rebuild of ``ModelSelection.scala:31,39-77``: classifiers pick max AUROC,
+linear regression picks min RMSE, Poisson picks min total Poisson loss.
+Used by the driver's validate stage (``Driver.scala:293-347``) and the GAME
+driver's best-model output (``cli/game/training/Driver.scala:393-441``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.models.training import TrainedModel
+from photon_ml_tpu.ops import metrics
+
+
+def validation_metric(
+    task: TaskType, model, batch: LabeledBatch
+) -> Tuple[str, jax.Array]:
+    """(metric name, value) used for selection; higher_is_better iff AUC."""
+    w = batch.effective_weights()
+    margins = model.compute_margin(batch.features, batch.offsets)
+    if task.is_classifier:
+        return "AUC", metrics.area_under_roc_curve(batch.labels, margins, w)
+    if task == TaskType.POISSON_REGRESSION:
+        return "POISSON_LOSS", metrics.total_poisson_loss(
+            batch.labels, margins, w
+        )
+    return "RMSE", metrics.root_mean_squared_error(
+        batch.labels, model.compute_mean(batch.features, batch.offsets), w
+    )
+
+
+def select_best_model(
+    trained: Sequence[TrainedModel], validation: LabeledBatch
+) -> Tuple[TrainedModel, dict]:
+    """Returns (best model, {reg_weight: metric value}).
+
+    Selection direction follows ``ModelSelection.scala``: max for AUC,
+    min for the error metrics.
+    """
+    if not trained:
+        raise ValueError("no trained models to select from")
+    task = trained[0].model.task
+    higher_is_better = task.is_classifier
+    scores = {}
+    for tm in trained:
+        _, value = validation_metric(task, tm.model, validation)
+        scores[tm.reg_weight] = float(value)
+    best = (max if higher_is_better else min)(
+        trained, key=lambda tm: scores[tm.reg_weight]
+    )
+    return best, scores

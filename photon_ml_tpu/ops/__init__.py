@@ -6,9 +6,15 @@ from photon_ml_tpu.ops.losses import (
     SMOOTHED_HINGE_LOSS,
     loss_for_task,
 )
-from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.objective import GLMObjective, RegularizationContext
+from photon_ml_tpu.ops.stats import BasicStatisticalSummary, summarize_features
+from photon_ml_tpu.ops import metrics
 
 __all__ = [
+    "RegularizationContext",
+    "BasicStatisticalSummary",
+    "summarize_features",
+    "metrics",
     "PointwiseLoss",
     "LOGISTIC_LOSS",
     "SQUARED_LOSS",

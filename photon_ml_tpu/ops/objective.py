@@ -103,6 +103,15 @@ class GLMObjective:
     l1_weight: float = 0.0  # consumed by OWL-QN, NOT added to value/grad here
     axis_name: Optional[str] = None
 
+    @property
+    def _has_l2(self) -> bool:
+        """Trace-safe L2 gate: reg weights may be traced scalars (the lambda
+        path jits ONE solve reused across lambdas), in which case the term is
+        always emitted and XLA folds the zero case."""
+        if isinstance(self.l2_weight, (int, float)):
+            return self.l2_weight != 0.0
+        return True
+
     # -- margins ---------------------------------------------------------
 
     def margins(self, w: jax.Array, batch: LabeledBatch) -> jax.Array:
@@ -142,7 +151,7 @@ class GLMObjective:
         grad = self._backproject(a, batch)
         val = _maybe_psum(val, self.axis_name)
         grad = _maybe_psum(grad, self.axis_name)
-        if self.l2_weight:
+        if self._has_l2:
             val = val + 0.5 * self.l2_weight * jnp.vdot(w, w)
             grad = grad + self.l2_weight * w
         return val, grad
@@ -163,7 +172,7 @@ class GLMObjective:
         b = ew * self.loss.d2(z, batch.labels) * zv
         hv = self._backproject(b, batch)
         hv = _maybe_psum(hv, self.axis_name)
-        if self.l2_weight:
+        if self._has_l2:
             hv = hv + self.l2_weight * v
         return hv
 
@@ -185,7 +194,7 @@ class GLMObjective:
         if norm.factors is not None:
             diag = diag * norm.factors**2
         diag = _maybe_psum(diag, self.axis_name)
-        if self.l2_weight:
+        if self._has_l2:
             diag = diag + self.l2_weight
         return diag
 

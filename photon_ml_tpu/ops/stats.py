@@ -1,0 +1,94 @@
+"""Per-feature statistics: one fused masked pass over the batch.
+
+Rebuild of ``stat/BasicStatistics.scala`` + ``stat/BasicStatisticalSummary.scala:33-127``
+(a wrapper over Spark mllib ``Statistics.colStats`` with NaN / negative-variance
+sanitization). Here the whole summary is one jitted reduction over the (n, d)
+design matrix — under pjit with the batch axis sharded, XLA turns the sums
+into psum collectives; pass axis_name explicitly when using shard_map.
+
+The summary feeds normalization (``core/normalization.build_normalization_context``)
+and the feature-summary output of the driver (``Driver.scala:212-225``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.types import LabeledBatch, _pytree_dataclass
+
+
+@_pytree_dataclass
+class BasicStatisticalSummary:
+    """Per-feature moments; all fields (d,) except count (scalar).
+
+    Mirrors ``BasicStatisticalSummary.scala``: mean, variance, count, min,
+    max, normL1, normL2, meanAbs, numNonzeros. Variance is the unbiased
+    (n-1) sample variance like Spark's colStats, sanitized to 0 when
+    negative/NaN (``BasicStatisticalSummary.scala:60-127``).
+    """
+
+    mean: jax.Array
+    variance: jax.Array
+    count: jax.Array
+    min: jax.Array
+    max: jax.Array
+    norm_l1: jax.Array
+    norm_l2: jax.Array
+    mean_abs: jax.Array
+    num_nonzeros: jax.Array
+
+    @property
+    def max_abs(self) -> jax.Array:
+        """max(|x|) per feature, for SCALE_WITH_MAX_MAGNITUDE."""
+        return jnp.maximum(jnp.abs(self.min), jnp.abs(self.max))
+
+
+def summarize_features(
+    batch: LabeledBatch, axis_name: Optional[str] = None
+) -> BasicStatisticalSummary:
+    """Single-pass masked column statistics (unweighted rows, like colStats)."""
+    x = batch.features
+    m = batch.mask[:, None]
+    xm = x * m
+
+    def _psum(v):
+        return jax.lax.psum(v, axis_name) if axis_name is not None else v
+
+    n = _psum(jnp.sum(batch.mask))
+    s1 = _psum(jnp.sum(xm, axis=0))
+    s2 = _psum(jnp.sum(xm * x, axis=0))
+    sabs = _psum(jnp.sum(jnp.abs(xm), axis=0))
+    nnz = _psum(jnp.sum((x != 0.0) * m, axis=0))
+    # masked rows must not contribute to min/max: substitute +/- inf
+    big = jnp.asarray(jnp.inf, x.dtype)
+    mn = _psum_min(jnp.min(jnp.where(m > 0, x, big), axis=0), axis_name)
+    mx = _psum_max(jnp.max(jnp.where(m > 0, x, -big), axis=0), axis_name)
+
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    # unbiased sample variance, sanitized like the reference
+    var = (s2 - n * mean * mean) / jnp.maximum(n - 1.0, 1.0)
+    var = jnp.where(jnp.isfinite(var) & (var > 0.0), var, 0.0)
+
+    return BasicStatisticalSummary(
+        mean=mean,
+        variance=var,
+        count=n,
+        min=mn,
+        max=mx,
+        norm_l1=sabs,
+        norm_l2=jnp.sqrt(s2),
+        mean_abs=sabs / safe_n,
+        num_nonzeros=nnz,
+    )
+
+
+def _psum_min(v, axis_name):
+    return -jax.lax.pmax(-v, axis_name) if axis_name is not None else v
+
+
+def _psum_max(v, axis_name):
+    return jax.lax.pmax(v, axis_name) if axis_name is not None else v

@@ -1,0 +1,135 @@
+"""Metric kernels vs sklearn oracles, incl. ties, weights, and padding."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.ops import metrics
+from photon_ml_tpu.ops.stats import summarize_features
+
+
+class TestAUC:
+    def test_matches_sklearn(self, rng):
+        y = (rng.uniform(size=500) < 0.4).astype(float)
+        s = rng.normal(size=500) + y
+        w = np.ones(500)
+        ours = float(metrics.area_under_roc_curve(jnp.asarray(y), jnp.asarray(s), jnp.asarray(w)))
+        assert ours == pytest.approx(skm.roc_auc_score(y, s), abs=1e-10)
+
+    def test_weighted_with_ties(self, rng):
+        y = (rng.uniform(size=300) < 0.5).astype(float)
+        s = np.round(rng.normal(size=300) + y, 1)  # heavy ties
+        w = rng.uniform(0.1, 3.0, size=300)
+        ours = float(metrics.area_under_roc_curve(jnp.asarray(y), jnp.asarray(s), jnp.asarray(w)))
+        assert ours == pytest.approx(
+            skm.roc_auc_score(y, s, sample_weight=w), abs=1e-10
+        )
+
+    def test_padding_invisible(self, rng):
+        y = (rng.uniform(size=100) < 0.5).astype(float)
+        s = rng.normal(size=100)
+        base = float(metrics.area_under_roc_curve(jnp.asarray(y), jnp.asarray(s), jnp.ones(100)))
+        y_pad = np.concatenate([y, np.ones(20)])
+        s_pad = np.concatenate([s, rng.normal(size=20) * 100])
+        w_pad = np.concatenate([np.ones(100), np.zeros(20)])
+        padded = float(
+            metrics.area_under_roc_curve(
+                jnp.asarray(y_pad), jnp.asarray(s_pad), jnp.asarray(w_pad)
+            )
+        )
+        assert padded == pytest.approx(base, abs=1e-12)
+
+    def test_degenerate_single_class(self):
+        auc = float(
+            metrics.area_under_roc_curve(
+                jnp.ones(10), jnp.arange(10.0), jnp.ones(10)
+            )
+        )
+        assert auc == 0.5
+
+    def test_perfect_and_inverted(self):
+        y = jnp.asarray([0.0, 0.0, 1.0, 1.0])
+        s = jnp.asarray([-2.0, -1.0, 1.0, 2.0])
+        assert float(metrics.area_under_roc_curve(y, s, jnp.ones(4))) == 1.0
+        assert float(metrics.area_under_roc_curve(y, -s, jnp.ones(4))) == 0.0
+
+
+class TestPRMetrics:
+    def test_average_precision_matches_sklearn(self, rng):
+        y = (rng.uniform(size=400) < 0.3).astype(float)
+        s = rng.normal(size=400) + 2 * y
+        ours = float(
+            metrics.average_precision(jnp.asarray(y), jnp.asarray(s), jnp.ones(400))
+        )
+        assert ours == pytest.approx(skm.average_precision_score(y, s), abs=1e-9)
+
+    def test_average_precision_with_ties(self, rng):
+        y = (rng.uniform(size=200) < 0.5).astype(float)
+        s = np.round(rng.normal(size=200), 1)
+        ours = float(
+            metrics.average_precision(jnp.asarray(y), jnp.asarray(s), jnp.ones(200))
+        )
+        assert ours == pytest.approx(skm.average_precision_score(y, s), abs=1e-9)
+
+    def test_peak_f1(self, rng):
+        y = (rng.uniform(size=300) < 0.4).astype(float)
+        s = rng.normal(size=300) + y
+        ours = float(metrics.peak_f1(jnp.asarray(y), jnp.asarray(s), jnp.ones(300)))
+        # oracle: best F1 over all thresholds taken at observed scores
+        best = 0.0
+        for t in np.unique(s):
+            pred = (s >= t).astype(float)
+            best = max(best, skm.f1_score(y, pred))
+        assert ours == pytest.approx(best, abs=1e-9)
+
+
+class TestRegressionMetrics:
+    def test_rmse_mae_weighted(self, rng):
+        y = rng.normal(size=100)
+        p = y + rng.normal(size=100) * 0.5
+        w = rng.uniform(0.5, 2.0, size=100)
+        rmse = float(
+            metrics.root_mean_squared_error(jnp.asarray(y), jnp.asarray(p), jnp.asarray(w))
+        )
+        mae = float(
+            metrics.mean_absolute_error(jnp.asarray(y), jnp.asarray(p), jnp.asarray(w))
+        )
+        assert rmse == pytest.approx(
+            np.sqrt(skm.mean_squared_error(y, p, sample_weight=w)), abs=1e-10
+        )
+        assert mae == pytest.approx(
+            skm.mean_absolute_error(y, p, sample_weight=w), abs=1e-10
+        )
+
+
+class TestStats:
+    def test_summary_matches_numpy(self, rng):
+        x = rng.normal(size=(50, 7)) * 3 + 1
+        x[:, 2] = 0.0
+        batch = LabeledBatch.create(x, np.zeros(50), dtype=jnp.float64)
+        s = summarize_features(batch)
+        np.testing.assert_allclose(np.asarray(s.mean), x.mean(0), atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(s.variance), x.var(0, ddof=1), atol=1e-12
+        )
+        np.testing.assert_allclose(np.asarray(s.min), x.min(0), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(s.max), x.max(0), atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(s.mean_abs), np.abs(x).mean(0), atol=1e-12
+        )
+        assert float(s.count) == 50
+        assert np.asarray(s.num_nonzeros)[2] == 0
+
+    def test_summary_ignores_padding(self, rng):
+        x = rng.normal(size=(30, 4))
+        batch = LabeledBatch.create(x, np.zeros(30), dtype=jnp.float64)
+        padded = LabeledBatch.pad_to(batch, 48)
+        s0 = summarize_features(batch)
+        s1 = summarize_features(padded)
+        np.testing.assert_allclose(np.asarray(s1.mean), np.asarray(s0.mean), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(s1.variance), np.asarray(s0.variance), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(s1.min), np.asarray(s0.min), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(s1.max), np.asarray(s0.max), atol=1e-12)
+        assert float(s1.count) == 30
