@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from functools import partial
+from functools import lru_cache
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -73,10 +73,24 @@ class GLMTrainingConfig:
     tolerance: float = 1e-7
     num_corrections: int = 10
     intercept_index: Optional[int] = None
-    lower_bounds: Optional[jax.Array] = None
-    upper_bounds: Optional[jax.Array] = None
+    # box constraints as (hashable) tuples so configs key the solver cache;
+    # arrays are accepted and converted
+    lower_bounds: Optional[Tuple[float, ...]] = None
+    upper_bounds: Optional[Tuple[float, ...]] = None
     compute_variances: bool = False
     track_states: bool = True
+
+    def __post_init__(self):
+        import numpy as np
+
+        for name in ("reg_weights", "lower_bounds", "upper_bounds"):
+            v = getattr(self, name)
+            if v is not None:
+                # normalize ANY sequence (incl. device arrays: one transfer,
+                # not one sync per element) to a hashable float tuple
+                object.__setattr__(
+                    self, name, tuple(np.asarray(v, dtype=float).tolist())
+                )
 
     def validate(self) -> None:
         """The reference's cross-flag validation matrix
@@ -113,12 +127,14 @@ class GLMTrainingConfig:
             )
 
     def solver_config(self) -> SolverConfig:
+        lb = self.lower_bounds
+        ub = self.upper_bounds
         return SolverConfig(
             max_iters=self.max_iters,
             tolerance=self.tolerance,
             num_corrections=self.num_corrections,
-            lower_bounds=self.lower_bounds,
-            upper_bounds=self.upper_bounds,
+            lower_bounds=None if lb is None else jnp.asarray(lb),
+            upper_bounds=None if ub is None else jnp.asarray(ub),
             track_states=self.track_states,
         )
 
@@ -133,21 +149,24 @@ class TrainedModel:
     result: SolverResult
 
 
-def _build_solver(config: GLMTrainingConfig, norm: NormalizationContext):
-    """One jitted solve(w0, reg_weight, batch) with traced reg weight, so
-    the whole lambda path shares a single compilation."""
+@lru_cache(maxsize=64)
+def _build_solver(config: GLMTrainingConfig):
+    """jitted solve(w0, reg_weight, batch, norm) with traced reg weight and
+    normalization arrays. Cached on the (hashable) config so repeated
+    train_glm calls — the lambda path, GAME coordinate-descent rounds,
+    bootstrap replicas — reuse ONE compilation instead of re-tracing.
+    """
     loss = loss_for_task(config.task)
-    base = GLMObjective(loss=loss, normalization=norm)
     reg = config.regularization
     scfg = config.solver_config()
     use_owlqn = reg.reg_type in ("L1", "ELASTIC_NET")
     use_tron = config.optimizer == OptimizerType.TRON
 
     @jax.jit
-    def solve(w0, reg_weight, batch: LabeledBatch):
+    def solve(w0, reg_weight, batch: LabeledBatch, norm: NormalizationContext):
         l1 = reg_weight * reg.l1_weight(1.0)
         l2 = reg_weight * reg.l2_weight(1.0)
-        obj = dataclasses.replace(base, l2_weight=l2)
+        obj = GLMObjective(loss=loss, normalization=norm, l2_weight=l2)
         vg = lambda w: obj.value_and_grad(w, batch)
         if use_owlqn:
             return minimize_owlqn(vg, w0, l1, scfg)
@@ -157,13 +176,18 @@ def _build_solver(config: GLMTrainingConfig, norm: NormalizationContext):
         return minimize_lbfgs(vg, w0, scfg)
 
     @jax.jit
-    def variances(w, reg_weight, batch: LabeledBatch):
+    def variances(
+        w, reg_weight, batch: LabeledBatch, norm: NormalizationContext
+    ):
         l2 = reg_weight * reg.l2_weight(1.0)
-        obj = dataclasses.replace(base, l2_weight=l2)
+        obj = GLMObjective(loss=loss, normalization=norm, l2_weight=l2)
         diag = obj.hessian_diagonal(w, batch)
         return 1.0 / jnp.maximum(diag, _VARIANCE_EPSILON)
 
     return solve, variances
+
+
+_summarize_jit = jax.jit(summarize_features)
 
 
 def prepare_normalization(
@@ -172,7 +196,7 @@ def prepare_normalization(
     """Feature summary pass -> whitening context (``Driver.scala:229-253``)."""
     if config.normalization == NormalizationType.NONE:
         return no_normalization()
-    summary = jax.jit(summarize_features)(batch)
+    summary = _summarize_jit(batch)
     return build_normalization_context(
         config.normalization, summary, config.intercept_index
     )
@@ -199,7 +223,7 @@ def train_glm(
         if normalization is not None
         else prepare_normalization(config, batch)
     )
-    solve, variances_fn = _build_solver(config, norm)
+    solve, variances_fn = _build_solver(config)
 
     d = batch.num_features
     dtype = batch.features.dtype
@@ -212,10 +236,10 @@ def train_glm(
 
     by_lambda = {}
     for lam in sorted(config.reg_weights, reverse=True):
-        result = solve(w, jnp.asarray(lam, dtype), batch)
+        result = solve(w, jnp.asarray(lam, dtype), batch, norm)
         w = result.w  # warm start for the next (smaller) lambda
         var = (
-            variances_fn(result.w, jnp.asarray(lam, dtype), batch)
+            variances_fn(result.w, jnp.asarray(lam, dtype), batch, norm)
             if config.compute_variances
             else None
         )

@@ -52,16 +52,18 @@ def summarize_features(
     """Single-pass masked column statistics (unweighted rows, like colStats)."""
     x = batch.features
     m = batch.mask[:, None]
-    xm = x * m
+    # where (not *): padding rows may legitimately hold NaN/Inf (validators
+    # exempt masked rows) and NaN * 0 would poison every sum
+    xm = jnp.where(m > 0, x, 0.0)
 
     def _psum(v):
         return jax.lax.psum(v, axis_name) if axis_name is not None else v
 
     n = _psum(jnp.sum(batch.mask))
     s1 = _psum(jnp.sum(xm, axis=0))
-    s2 = _psum(jnp.sum(xm * x, axis=0))
+    s2 = _psum(jnp.sum(xm * xm, axis=0))
     sabs = _psum(jnp.sum(jnp.abs(xm), axis=0))
-    nnz = _psum(jnp.sum((x != 0.0) * m, axis=0))
+    nnz = _psum(jnp.sum((xm != 0.0) * m, axis=0))
     # masked rows must not contribute to min/max: substitute +/- inf
     big = jnp.asarray(jnp.inf, x.dtype)
     mn = _psum_min(jnp.min(jnp.where(m > 0, x, big), axis=0), axis_name)

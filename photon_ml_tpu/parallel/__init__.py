@@ -1,0 +1,36 @@
+"""Device-mesh parallelism: the TPU replacement for Spark's cluster runtime.
+
+The reference's distribution backend is Spark primitives — treeAggregate,
+broadcast, shuffle (SURVEY §5.8, ``function/DiffFunction.scala:126-143``).
+Here the backend is a ``jax.sharding.Mesh`` with XLA collectives over ICI:
+
+  | Spark primitive            | here                                      |
+  |----------------------------|-------------------------------------------|
+  | treeAggregate(depth)       | psum over the 'data' mesh axis            |
+  | broadcast(coefficients)    | replicated sharding (resident on device)  |
+  | partitionBy(hash)          | even batch-axis sharding                  |
+  | entity-partitioned RDDs    | 'entity' mesh axis for batched solves     |
+  | join/cogroup by entityId   | device_put to entity shards at ingest     |
+"""
+
+from photon_ml_tpu.parallel.mesh import (
+    batch_sharding,
+    default_mesh,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+from photon_ml_tpu.parallel.distributed import (
+    distributed_train_glm,
+    shard_map_value_and_grad,
+)
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+    "distributed_train_glm",
+    "shard_map_value_and_grad",
+]

@@ -1,0 +1,76 @@
+"""Distributed (multi-chip) GLM training over a device mesh.
+
+The reference's fixed-effect regime: examples partitioned across workers,
+loss/grad/HVP partials tree-reduced, coefficients broadcast each iteration
+(``function/ValueAndGradientAggregator.scala:204-220``,
+``optimization/Optimizer.scala:142-151``). Here the WHOLE solve — solver
+loop, line searches, CG, convergence — is one jitted SPMD computation over
+the mesh: batch arrays arrive 'data'-sharded, coefficients replicated, and
+XLA's partitioner inserts the all-reduces where the objective contracts
+over the row axis. No per-iteration host round-trip, no broadcast cost.
+
+Two entry points:
+  - ``distributed_train_glm``: GSPMD path — jit + sharding constraints;
+    collectives are inferred. The default.
+  - ``shard_map_value_and_grad``: explicit-collective path — shard_map with
+    the objective's ``axis_name`` psum, for when manual scheduling beats the
+    partitioner (and as the analog of the reference's explicit
+    treeAggregate contract, tested for equality like
+    ``ObjectiveFunctionIntegTest``'s RDD-vs-local duality).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.models.training import (
+    GLMTrainingConfig,
+    TrainedModel,
+    train_glm,
+)
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, replicated, shard_batch
+
+
+def distributed_train_glm(
+    batch: LabeledBatch,
+    config: GLMTrainingConfig,
+    mesh: Mesh,
+    **kwargs,
+) -> Sequence[TrainedModel]:
+    """``train_glm`` with the batch sharded over the mesh's 'data' axis.
+
+    The solver code is unchanged — that is the point: the reference needs
+    two code paths (RDD vs Iterable, ``optimization/Optimizer.scala:163-212``);
+    here distribution is a data-placement property. Results are bitwise
+    deterministic for a fixed mesh shape.
+    """
+    sharded = shard_batch(batch, mesh)
+    with jax.set_mesh(mesh):
+        return train_glm(sharded, config, **kwargs)
+
+
+def shard_map_value_and_grad(
+    objective: GLMObjective, mesh: Mesh
+):
+    """Explicit-collective value+grad: shard_map over 'data' with in-kernel
+    psum (``objective.axis_name``). Returns f(w, sharded_batch) -> (val, grad)
+    with replicated outputs."""
+    obj = objective.with_axis(DATA_AXIS)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+    )
+    def vg(w, batch: LabeledBatch):
+        return obj.value_and_grad(w, batch)
+
+    return vg

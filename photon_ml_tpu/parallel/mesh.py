@@ -1,0 +1,77 @@
+"""Mesh construction and sharding helpers.
+
+Axis conventions for the whole framework (SURVEY §2.5, §5.7):
+  'data'   — batch rows of the global (fixed-effect) problem; the analog of
+             Spark example partitioning (``FixedEffectDataSet.scala:31``).
+  'entity' — random-effect entity buckets; the analog of
+             ``RandomEffectIdPartitioner`` placement (expert-parallel-like).
+
+A 1D mesh uses just 'data'; GAME training uses ('data', 'entity') with the
+same devices viewed both ways (the two phases alternate, they don't nest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.core.types import LabeledBatch
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def make_mesh(
+    n_data: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1D 'data' mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devs)
+    return Mesh(np.asarray(devs[:n_data]), (DATA_AXIS,))
+
+
+def make_game_mesh(
+    n_data: int, n_entity: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2D ('data', 'entity') mesh: fixed-effect solves shard over both axes
+    flattened; random-effect bucket solves shard over 'entity'."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data * n_entity > len(devs):
+        raise ValueError(
+            f"mesh {n_data}x{n_entity} needs {n_data * n_entity} devices, "
+            f"have {len(devs)}"
+        )
+    grid = np.asarray(devs[: n_data * n_entity]).reshape(n_data, n_entity)
+    return Mesh(grid, (DATA_AXIS, ENTITY_AXIS))
+
+
+def default_mesh() -> Mesh:
+    return make_mesh()
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (row) axis over 'data'; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
+    """Place a batch row-sharded over the 'data' axis (pads rows to a
+    multiple of the axis size first — padding is masked, so invisible)."""
+    n_shards = mesh.shape[DATA_AXIS]
+    n = batch.batch_size
+    padded = LabeledBatch.pad_to(batch, ((n + n_shards - 1) // n_shards) * n_shards)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, batch_sharding(mesh, np.ndim(x))
+        ),
+        padded,
+    )
