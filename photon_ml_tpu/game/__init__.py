@@ -1,0 +1,46 @@
+"""GAME: Generalized Additive Mixed Effects, TPU-first.
+
+Rebuild of the reference's experimental heart (SURVEY §2.3): one global
+*fixed-effect* GLM plus many per-entity *random-effect* GLMs trained by
+block coordinate descent with residual score offsets
+(``algorithm/CoordinateDescent.scala:39-198``).
+
+Architecture vs the reference:
+  - Scores are dense (n,) device arrays indexed by row — the reference's
+    KeyValueScore RDD joins (``data/KeyValueScore.scala:60-85``) become
+    plain array arithmetic.
+  - Random effects hold an (entities, dim) coefficient table; scoring is an
+    embedding-style gather (missing entity -> index -1 -> score 0, the
+    reference's semantic at ``model/RandomEffectModel.scala:117-146``).
+  - Per-entity training data is bucketed into padded (entities, rows, dim)
+    tensors at ingest (``RandomEffectDataSet``'s grouping/capping,
+    ``data/RandomEffectDataSet.scala:172-380``) and solved by ONE vmapped
+    jitted solver call — the reference's millions of independent in-executor
+    solves (``algorithm/RandomEffectCoordinate.scala:185-213``) with zero
+    scheduling overhead.
+  - Down-sampling keeps static shapes: dropped rows get weight 0 and kept
+    negatives are re-weighted (``sampler/BinaryClassificationDownSampler``).
+"""
+
+from photon_ml_tpu.game.data import (
+    GameData,
+    RandomEffectDesign,
+    build_random_effect_design,
+)
+from photon_ml_tpu.game.coordinates import (
+    CoordinateConfig,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.descent import CoordinateDescent, GameModel
+
+__all__ = [
+    "GameData",
+    "RandomEffectDesign",
+    "build_random_effect_design",
+    "CoordinateConfig",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "CoordinateDescent",
+    "GameModel",
+]

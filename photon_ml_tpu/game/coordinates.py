@@ -1,0 +1,244 @@
+"""GAME coordinates: fixed-effect and random-effect update/score units.
+
+Rebuild of ``algorithm/Coordinate.scala:28-55`` and its concrete types.
+A coordinate owns its (device-resident) training design and exposes:
+
+  update(params, partial_scores, key) -> (params', SolverResult)
+      solve the coordinate's subproblem with the OTHER coordinates' scores
+      added to the offsets — the residual trick of
+      ``algorithm/Coordinate.scala:45-48`` — warm-starting from the current
+      parameters (``FixedEffectCoordinate.scala:69-71``)
+  score(params) -> (n,) margins for the coordinate's own rows
+
+FixedEffectCoordinate (``algorithm/FixedEffectCoordinate.scala:33-179``):
+one global GLM solve; under a mesh the batch is 'data'-sharded and the
+solve runs SPMD. Optional down-sampling is a weight transform (static
+shapes; ``sampler/*DownSampler.scala`` semantics).
+
+RandomEffectCoordinate (``algorithm/RandomEffectCoordinate.scala:36-214``):
+ONE vmapped solver call over the padded (entities, rows, dim) design — the
+reference's millions of per-entity in-executor solves. Per-entity
+convergence reasons come back as an (E,) int array for the tracker
+histogram (``RandomEffectOptimizationTracker.scala:33-110``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.game.data import RandomEffectDesign
+from photon_ml_tpu.models.training import OptimizerType
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.solvers import (
+    SolverConfig,
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateConfig:
+    """Per-coordinate optimization knobs — the typed analog of the
+    reference's GLMOptimizationConfiguration mini-DSL
+    ("maxIter,tol,lambda,downSampleRate,optimizer,regType",
+    ``optimization/game/GLMOptimizationConfiguration.scala:32-80``).
+    Defaults per ``GLMOptimizationConfiguration.scala:33-38``."""
+
+    shard: str
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+    optimizer: OptimizerType = OptimizerType.TRON
+    reg_weight: float = 50.0
+    l1_ratio: float = 0.0  # >0 selects OWL-QN (elastic-net alpha)
+    max_iters: int = 20
+    tolerance: float = 1e-5
+    # fixed-effect only: None = no down-sampling; else keep rate in (0,1)
+    down_sampling_rate: Optional[float] = None
+    # random-effect only
+    random_effect: Optional[str] = None
+    active_cap: Optional[int] = None
+
+    def solver_config(self) -> SolverConfig:
+        return SolverConfig(
+            max_iters=self.max_iters,
+            tolerance=self.tolerance,
+            track_states=False,
+        )
+
+
+@lru_cache(maxsize=128)
+def _make_solve(config: CoordinateConfig, batched: bool):
+    """jitted solve(w0, features, labels, offsets, weights, mask) for one
+    subproblem; vmapped over the leading axis when `batched`."""
+    loss = loss_for_task(config.task)
+    scfg = config.solver_config()
+    l1 = config.reg_weight * config.l1_ratio
+    l2 = config.reg_weight * (1.0 - config.l1_ratio)
+    use_owlqn = config.l1_ratio > 0.0
+    use_tron = config.optimizer == OptimizerType.TRON
+
+    def solve_one(w0, features, labels, offsets, weights, mask):
+        batch = LabeledBatch(features, labels, offsets, weights, mask)
+        obj = GLMObjective(loss=loss, l2_weight=l2)
+        vg = lambda w: obj.value_and_grad(w, batch)
+        if use_owlqn:
+            return minimize_owlqn(vg, w0, l1, scfg)
+        if use_tron:
+            hvp = lambda w, v: obj.hessian_vector(w, v, batch)
+            return minimize_tron(vg, hvp, w0, scfg)
+        return minimize_lbfgs(vg, w0, scfg)
+
+    return jax.jit(jax.vmap(solve_one) if batched else solve_one)
+
+
+class FixedEffectCoordinate:
+    """Global GLM coordinate. Owns a device LabeledBatch (shard view)."""
+
+    def __init__(self, batch: LabeledBatch, config: CoordinateConfig):
+        if config.random_effect is not None:
+            raise ValueError("config names a random effect; wrong coordinate")
+        self.batch = batch
+        self.config = config
+        self._solve = _make_solve(config, batched=False)
+        self._score = jax.jit(lambda w, feats: feats @ w)
+        self._downsample = (
+            jax.jit(_binary_downsample_weights, static_argnums=(3,))
+            if config.down_sampling_rate is not None
+            and config.task.is_classifier
+            else jax.jit(_uniform_downsample_weights, static_argnums=(3,))
+            if config.down_sampling_rate is not None
+            else None
+        )
+
+    @property
+    def dim(self) -> int:
+        return self.batch.num_features
+
+    def initial_params(self) -> jax.Array:
+        return jnp.zeros((self.dim,), self.batch.features.dtype)
+
+    def update(
+        self, w: jax.Array, partial_scores: jax.Array, key=None
+    ) -> Tuple[jax.Array, object]:
+        offsets = self.batch.offsets + partial_scores
+        weights = self.batch.weights
+        if self._downsample is not None:
+            if key is None:
+                raise ValueError(
+                    "down-sampling needs a PRNG key per update; a fixed "
+                    "default would drop the SAME rows every pass"
+                )
+            weights = self._downsample(
+                key,
+                weights * self.batch.mask,
+                self.batch.labels,
+                self.config.down_sampling_rate,
+            )
+        result = self._solve(
+            w,
+            self.batch.features,
+            self.batch.labels,
+            offsets,
+            weights,
+            self.batch.mask,
+        )
+        return result.w, result
+
+    def score(self, w: jax.Array) -> jax.Array:
+        """Broadcast-dot scoring (``FixedEffectCoordinate.scala:171-178``),
+        WITHOUT the dataset offset (scores must sum across coordinates)."""
+        return self._score(w, self.batch.features)
+
+
+class RandomEffectCoordinate:
+    """Per-entity batched coordinate.
+
+    Owns the padded active design plus full-row (features, entity index)
+    for scoring. Scoring covers ALL rows — active and passive — through the
+    coefficient table (``RandomEffectCoordinate.scala:116-170``).
+    """
+
+    def __init__(
+        self,
+        design: RandomEffectDesign,
+        row_features: jax.Array,  # (n, d) full scoring view
+        row_entities: jax.Array,  # (n,) int32, -1 = unknown entity
+        full_offsets_base: jax.Array,  # (n,) data offsets
+        config: CoordinateConfig,
+    ):
+        if config.random_effect is None:
+            raise ValueError("config lacks random_effect; wrong coordinate")
+        self.design = design
+        self.row_features = row_features
+        self.row_entities = row_entities
+        self.full_offsets_base = full_offsets_base
+        self.config = config
+        self._solve = _make_solve(config, batched=True)
+
+        @jax.jit
+        def score_rows(table, feats, ents):
+            safe = jnp.maximum(ents, 0)
+            per_row = jnp.einsum("nd,nd->n", feats, table[safe])
+            return jnp.where(ents >= 0, per_row, 0.0)
+
+        self._score = score_rows
+
+    @property
+    def num_entities(self) -> int:
+        return self.design.num_entities
+
+    @property
+    def dim(self) -> int:
+        return self.design.dim
+
+    def initial_params(self) -> jax.Array:
+        return jnp.zeros(
+            (self.num_entities, self.dim), self.design.features.dtype
+        )
+
+    def update(
+        self, table: jax.Array, partial_scores: jax.Array, key=None
+    ) -> Tuple[jax.Array, object]:
+        offsets = self.design.gather_offsets(
+            self.full_offsets_base + partial_scores
+        )
+        result = self._solve(
+            table,
+            self.design.features,
+            self.design.labels,
+            offsets,
+            self.design.weights,
+            self.design.mask,
+        )
+        return result.w, result
+
+    def score(self, table: jax.Array) -> jax.Array:
+        return self._score(table, self.row_features, self.row_entities)
+
+
+# -- down-samplers (``sampler/``) -------------------------------------------
+
+
+def _binary_downsample_weights(key, weights, labels, rate: float):
+    """Keep positives; keep negatives w.p. rate with weight / rate
+    (``sampler/BinaryClassificationDownSampler.scala:36-66``). Static
+    shapes: dropped rows get weight 0."""
+    keep = jax.random.uniform(key, weights.shape) < rate
+    neg = labels <= 0.0
+    w = jnp.where(neg & keep, weights / rate, weights)
+    return jnp.where(neg & ~keep, 0.0, w)
+
+
+def _uniform_downsample_weights(key, weights, labels, rate: float):
+    """Uniform Bernoulli down-sampling with reweighting
+    (``sampler/DefaultDownSampler.scala:30``)."""
+    keep = jax.random.uniform(key, weights.shape) < rate
+    return jnp.where(keep, weights / rate, 0.0)

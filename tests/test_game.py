@@ -1,0 +1,255 @@
+"""GAME subsystem tests.
+
+Contracts from the reference (SURVEY §4): the training objective decreases
+monotonically across coordinate updates; fixed+random mixed-effects models
+recover per-entity structure a global model cannot; active-data caps
+preserve total weight; unknown entities score 0; down-sampling keeps
+positives and preserves expected weight.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.game import (
+    CoordinateConfig,
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    GameData,
+    RandomEffectCoordinate,
+    build_random_effect_design,
+)
+from photon_ml_tpu.game.coordinates import (
+    _binary_downsample_weights,
+    _uniform_downsample_weights,
+)
+from photon_ml_tpu.game.data import (
+    apply_entity_vocabulary,
+    build_entity_vocabulary,
+)
+from photon_ml_tpu.models.training import OptimizerType
+
+
+def make_mixed_effects_data(rng, n_users=40, rows_per_user=30, d_global=5, d_user=3):
+    """y ~ sigmoid(x_g . w_global + x_u . w_user[u]): per-user coefficients
+    on user features, shared global effect."""
+    n = n_users * rows_per_user
+    user = np.repeat(np.arange(n_users), rows_per_user)
+    xg = rng.normal(size=(n, d_global))
+    xu = rng.normal(size=(n, d_user))
+    w_global = rng.normal(size=d_global)
+    w_user = rng.normal(size=(n_users, d_user)) * 2.0
+    margin = xg @ w_global + np.einsum("nd,nd->n", xu, w_user[user])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    data = GameData.create(
+        features={"global": xg, "per_user": xu},
+        labels=y,
+        entity_ids={"userId": user},
+    )
+    return data, user, n_users
+
+
+def build_game(data, n_users, re_reg=1.0, fe_reg=0.1, dtype=jnp.float64):
+    fe_cfg = CoordinateConfig(
+        shard="global",
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.TRON,
+        reg_weight=fe_reg,
+        max_iters=20,
+        tolerance=1e-9,
+    )
+    re_cfg = CoordinateConfig(
+        shard="per_user",
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.TRON,
+        reg_weight=re_reg,
+        max_iters=20,
+        tolerance=1e-9,
+        random_effect="userId",
+    )
+    fixed = FixedEffectCoordinate(data.fixed_effect_batch("global", dtype), fe_cfg)
+    design = build_random_effect_design(
+        data, "userId", "per_user", n_users, dtype=dtype
+    )
+    random = RandomEffectCoordinate(
+        design=design,
+        row_features=jnp.asarray(data.features["per_user"], dtype),
+        row_entities=jnp.asarray(data.entity_ids["userId"]),
+        full_offsets_base=jnp.asarray(data.offsets, dtype),
+        config=re_cfg,
+    )
+    cd = CoordinateDescent(
+        coordinates={"fixed": fixed, "per-user": random},
+        labels=jnp.asarray(data.labels, dtype),
+        base_offsets=jnp.asarray(data.offsets, dtype),
+        weights=jnp.asarray(data.weights, dtype),
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    return cd
+
+
+class TestCoordinateDescent:
+    def test_objective_monotone_decreasing(self, rng):
+        data, user, n_users = make_mixed_effects_data(rng)
+        cd = build_game(data, n_users)
+        model, history = cd.run(num_iterations=3)
+        objs = [h.objective for h in history]
+        assert all(np.isfinite(objs))
+        # monotone non-increasing across every coordinate update
+        assert all(b <= a + 1e-6 for a, b in zip(objs, objs[1:]))
+
+    def test_mixed_beats_fixed_only(self, rng):
+        from photon_ml_tpu.ops.metrics import area_under_roc_curve
+
+        data, user, n_users = make_mixed_effects_data(rng)
+        cd = build_game(data, n_users)
+        model, _ = cd.run(num_iterations=2)
+        mixed_scores = cd.total_scores(model)
+
+        fixed_only = build_game(data, n_users)
+        fixed_coord = fixed_only.coordinates["fixed"]
+        w, _ = fixed_coord.update(
+            fixed_coord.initial_params(), jnp.zeros(data.num_rows)
+        )
+        y = jnp.asarray(data.labels)
+        ones = jnp.ones(data.num_rows)
+        auc_mixed = float(area_under_roc_curve(y, mixed_scores, ones))
+        auc_fixed = float(
+            area_under_roc_curve(y, fixed_coord.score(w), ones)
+        )
+        assert auc_mixed > auc_fixed + 0.05
+
+    def test_random_effect_recovers_per_entity_signs(self, rng):
+        data, user, n_users = make_mixed_effects_data(
+            rng, n_users=10, rows_per_user=200, d_global=2, d_user=2
+        )
+        cd = build_game(data, n_users, re_reg=0.01)
+        model, _ = cd.run(num_iterations=3)
+        table = np.asarray(model.params["per-user"])
+        assert table.shape == (n_users, 2)
+        # per-entity tables must differ meaningfully across entities
+        assert np.std(table, axis=0).mean() > 0.3
+
+    def test_warm_start_second_run_converges_fast(self, rng):
+        data, _, n_users = make_mixed_effects_data(rng, n_users=8)
+        cd = build_game(data, n_users)
+        model, hist1 = cd.run(num_iterations=2)
+        model2, hist2 = cd.run(num_iterations=1, initial_model=model)
+        assert hist2[-1].objective <= hist1[-1].objective + 1e-6
+        assert hist2[0].solver_iterations <= hist1[0].solver_iterations
+
+
+class TestRandomEffectDesign:
+    def test_bucketing_routes_rows(self, rng):
+        data, user, n_users = make_mixed_effects_data(
+            rng, n_users=5, rows_per_user=7
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        assert design.features.shape == (5, 7, 3)
+        # every active slot's features match its source row
+        ri = np.asarray(design.row_index)
+        feats = np.asarray(design.features)
+        for e in range(5):
+            for r in range(7):
+                assert ri[e, r] >= 0
+                np.testing.assert_array_equal(
+                    feats[e, r], data.features["per_user"][ri[e, r]]
+                )
+                assert user[ri[e, r]] == e
+
+    def test_active_cap_preserves_weight(self, rng):
+        data, user, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=20
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, active_cap=5, dtype=jnp.float64
+        )
+        assert design.features.shape[1] == 5
+        w = np.asarray(design.weights)
+        # reference semantics: sampled weights scaled by count/cap so each
+        # entity's total active weight ~ its total data weight (20 here)
+        np.testing.assert_allclose(w.sum(axis=1), 20.0, rtol=1e-12)
+
+    def test_ragged_entities_masked(self, rng):
+        xg = rng.normal(size=(10, 2))
+        user = np.array([0] * 7 + [1] * 3)
+        data = GameData.create(
+            features={"s": xg}, labels=np.zeros(10), entity_ids={"u": user}
+        )
+        design = build_random_effect_design(data, "u", "s", 2, dtype=jnp.float64)
+        m = np.asarray(design.mask)
+        assert m[0].sum() == 7 and m[1].sum() == 3
+        assert np.all(np.asarray(design.row_index)[1, 3:] == -1)
+
+    def test_gather_offsets(self, rng):
+        data, user, n_users = make_mixed_effects_data(
+            rng, n_users=3, rows_per_user=4
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        full = jnp.arange(12.0)
+        got = np.asarray(design.gather_offsets(full))
+        ri = np.asarray(design.row_index)
+        for e in range(3):
+            for r in range(4):
+                assert got[e, r] == ri[e, r]
+
+
+class TestScoring:
+    def test_unknown_entity_scores_zero(self, rng):
+        data, user, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=5
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        ents = np.asarray(data.entity_ids["userId"]).copy()
+        ents[::2] = -1  # half unknown
+        coord = RandomEffectCoordinate(
+            design=design,
+            row_features=jnp.asarray(data.features["per_user"]),
+            row_entities=jnp.asarray(ents),
+            full_offsets_base=jnp.zeros(20),
+            config=CoordinateConfig(shard="per_user", random_effect="userId"),
+        )
+        table = jnp.asarray(rng.normal(size=(n_users, 3)))
+        s = np.asarray(coord.score(table))
+        assert np.all(s[::2] == 0.0)
+        assert np.all(s[1::2] != 0.0)
+
+    def test_entity_vocabulary_round_trip(self):
+        raw = np.array(["u3", "u1", "u3", "u7"])
+        vocab, idx = build_entity_vocabulary(raw)
+        assert len(vocab) == 3
+        np.testing.assert_array_equal(idx, [vocab["u3"], vocab["u1"], vocab["u3"], vocab["u7"]])
+        idx2 = apply_entity_vocabulary(vocab, np.array(["u1", "unseen"]))
+        assert idx2[0] == vocab["u1"] and idx2[1] == -1
+
+
+class TestDownSamplers:
+    def test_binary_keeps_positives(self, rng):
+        key = jax.random.PRNGKey(0)
+        labels = jnp.asarray((rng.uniform(size=2000) < 0.3).astype(float))
+        weights = jnp.ones(2000)
+        w = _binary_downsample_weights(key, weights, labels, 0.25)
+        w = np.asarray(w)
+        y = np.asarray(labels)
+        assert np.all(w[y > 0] == 1.0)  # positives untouched
+        kept_neg = w[(y == 0) & (w > 0)]
+        np.testing.assert_allclose(kept_neg, 4.0)  # 1/rate reweighting
+        # expected total negative weight preserved
+        assert abs(w[y == 0].sum() - (y == 0).sum()) / (y == 0).sum() < 0.15
+
+    def test_uniform_preserves_expected_weight(self, rng):
+        key = jax.random.PRNGKey(1)
+        weights = jnp.ones(5000)
+        w = np.asarray(
+            _uniform_downsample_weights(key, weights, jnp.zeros(5000), 0.1)
+        )
+        assert abs(w.sum() - 5000) / 5000 < 0.15
+        assert (w > 0).mean() == pytest.approx(0.1, abs=0.03)
