@@ -50,6 +50,17 @@ class CoordinateUpdateRecord:
     convergence_histogram: Dict[str, int]
 
 
+def _config_reg_term(cfg, params) -> jax.Array:
+    """loss-side penalty of one coordinate's params under its config —
+    matches exactly what the coordinate's solver minimizes."""
+    l2 = cfg.reg_weight * (1.0 - cfg.l1_ratio)
+    l1 = cfg.reg_weight * cfg.l1_ratio
+    leaves = jax.tree_util.tree_leaves(params)
+    sq = sum(jnp.vdot(p, p) for p in leaves)
+    ab = sum(jnp.sum(jnp.abs(p)) for p in leaves)
+    return 0.5 * l2 * sq + l1 * ab
+
+
 def _loss_fn_for_task(task: TaskType):
     if task == TaskType.LOGISTIC_REGRESSION:
         return metrics_mod.total_logistic_loss
@@ -90,13 +101,14 @@ class CoordinateDescent:
 
         self._objective = objective
 
-    def _reg_term(self, name: str, params: jax.Array) -> jax.Array:
-        cfg = self.coordinates[name].config
-        l2 = cfg.reg_weight * (1.0 - cfg.l1_ratio)
-        l1 = cfg.reg_weight * cfg.l1_ratio
-        return 0.5 * l2 * jnp.vdot(params, params) + l1 * jnp.sum(
-            jnp.abs(params)
-        )
+    def _reg_term(self, name: str, params) -> jax.Array:
+        """Delegates to the coordinate when it defines its own penalty
+        (factored coordinates penalize gamma and B under different
+        configs); otherwise applies the coordinate config to the params."""
+        coord = self.coordinates[name]
+        if hasattr(coord, "reg_term"):
+            return coord.reg_term(params)
+        return _config_reg_term(coord.config, params)
 
     def run(
         self,

@@ -1,0 +1,214 @@
+"""Factored random effects + matrix-factorization scoring.
+
+Rebuild of ``algorithm/FactoredRandomEffectCoordinate.scala:37-267``: when
+entities are too many / data too thin for full per-entity coefficient
+vectors, factor the random effect as  w_e = B^T gamma_e  with a shared
+projection B (d x k) and per-entity latent coefficients gamma_e (k,).
+Training alternates (numInnerIterations x):
+
+  (a) project the active design through the current B and solve the
+      per-entity latent GLMs (a RandomEffect solve in k dims);
+  (b) re-fit B as ONE fixed-effect-style GLM over Kronecker-product
+      features x (x) gamma_e — vec(B) is the coefficient vector
+      (``kroneckerProductFeaturesAndCoefficients`` :251-266).
+
+Both phases are jitted; the Kronecker design is an einsum. Scoring:
+margin_i = gamma_{e(i)} . (B^T x_i), unknown entities score 0.
+
+``MatrixFactorizationModel`` (``model/MatrixFactorizationModel.scala:30-134``)
+is the inference-side pairing: two latent tables scored by gathered dot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.core.types import _pytree_dataclass
+from photon_ml_tpu.game.coordinates import CoordinateConfig, _make_solve
+from photon_ml_tpu.game.data import RandomEffectDesign
+
+
+@_pytree_dataclass
+class FactoredParams:
+    """(per-entity latent table, shared projection)."""
+
+    gamma: jax.Array  # (E, k)
+    projection: jax.Array  # (d, k)
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredConfig:
+    """``MFOptimizationConfiguration.scala:24-46`` ("numInnerIter,latentDim")
+    plus the two sub-configs (random-effect & latent-matrix) the reference
+    parses from its triple-config string."""
+
+    latent_dim: int
+    num_inner_iterations: int = 1
+    random_effect_config: Optional[CoordinateConfig] = None
+    latent_factor_config: Optional[CoordinateConfig] = None
+
+    def __post_init__(self):
+        if self.latent_dim < 1:
+            raise ValueError(f"latent_dim must be >= 1, got {self.latent_dim}")
+        if self.num_inner_iterations < 1:
+            raise ValueError(
+                f"num_inner_iterations must be >= 1, got "
+                f"{self.num_inner_iterations}"
+            )
+
+
+class FactoredRandomEffectCoordinate:
+    """Drop-in coordinate: update(params, partial_scores) / score(params)."""
+
+    def __init__(
+        self,
+        design: RandomEffectDesign,
+        row_features: jax.Array,
+        row_entities: jax.Array,
+        full_offsets_base: jax.Array,
+        re_config: CoordinateConfig,
+        factored: FactoredConfig,
+        seed: int = 0,
+    ):
+        self.design = design
+        self.row_features = row_features
+        self.row_entities = row_entities
+        self.full_offsets_base = full_offsets_base
+        self.config = re_config
+        self.factored = factored
+        self._seed = seed
+
+        latent_cfg = factored.latent_factor_config or re_config
+        self._re_solve = _make_solve(
+            dataclasses.replace(re_config, random_effect=None), batched=True
+        )
+        self._latent_solve = _make_solve(
+            dataclasses.replace(latent_cfg, random_effect=None), batched=False
+        )
+
+        @jax.jit
+        def score_rows(params: FactoredParams, feats, ents):
+            latent = feats @ params.projection  # (n, k)
+            safe = jnp.maximum(ents, 0)
+            per_row = jnp.einsum("nk,nk->n", latent, params.gamma[safe])
+            return jnp.where(ents >= 0, per_row, 0.0)
+
+        self._score = score_rows
+
+    @property
+    def num_entities(self) -> int:
+        return self.design.num_entities
+
+    def initial_params(self) -> FactoredParams:
+        """Gamma zeros; B a Gaussian N(0, 1/d) like the reference's random
+        projection init (``FactoredRandomEffectOptimizationProblem``)."""
+        d = self.design.dim
+        k = self.factored.latent_dim
+        rng = np.random.default_rng(self._seed)
+        b = rng.normal(0.0, 1.0 / np.sqrt(d), size=(d, k))
+        dtype = self.design.features.dtype
+        return FactoredParams(
+            gamma=jnp.zeros((self.num_entities, k), dtype),
+            projection=jnp.asarray(b, dtype),
+        )
+
+    def update(
+        self, params: FactoredParams, partial_scores: jax.Array, key=None
+    ) -> Tuple[FactoredParams, object]:
+        design = self.design
+        offsets = design.gather_offsets(
+            self.full_offsets_base + partial_scores
+        )
+        gamma, b = params.gamma, params.projection
+        result = None
+        for _ in range(self.factored.num_inner_iterations):
+            # (a) latent-space per-entity solves
+            latent_feats = design.features @ b  # (E, R, k)
+            result = self._re_solve(
+                gamma,
+                latent_feats,
+                design.labels,
+                offsets,
+                design.weights,
+                design.mask,
+            )
+            gamma = result.w
+            # (b) shared projection as one GLM over Kronecker features
+            e, r, d = design.features.shape
+            k = gamma.shape[1]
+            kron = jnp.einsum(
+                "erd,ek->erdk", design.features, gamma
+            ).reshape(e * r, d * k)
+            latent_result = self._latent_solve(
+                b.reshape(-1),
+                kron,
+                design.labels.reshape(-1),
+                offsets.reshape(-1),
+                design.weights.reshape(-1),
+                design.mask.reshape(-1),
+            )
+            b = latent_result.w.reshape(d, k)
+        return FactoredParams(gamma=gamma, projection=b), result
+
+    def score(self, params: FactoredParams) -> jax.Array:
+        return self._score(params, self.row_features, self.row_entities)
+
+    def reg_term(self, params: FactoredParams) -> jax.Array:
+        """gamma is penalized under the RE config, B under the latent-factor
+        config — the exact quantities the two inner solves minimize."""
+        from photon_ml_tpu.game.descent import _config_reg_term
+
+        latent_cfg = self.factored.latent_factor_config or self.config
+        return _config_reg_term(self.config, params.gamma) + _config_reg_term(
+            latent_cfg, params.projection
+        )
+
+    def to_full_table(self, params: FactoredParams) -> jax.Array:
+        """Materialize w_e = B gamma_e: (E, d) — the reference's
+        ``RandomEffectModelInProjectedSpace.toRandomEffectModel``."""
+        return params.gamma @ params.projection.T
+
+
+class MatrixFactorizationModel:
+    """Two latent tables; score(row, col) = rowFactors[row] . colFactors[col]
+    with either side missing scoring 0 (``MatrixFactorizationModel.scala``)."""
+
+    def __init__(self, row_factors: jax.Array, col_factors: jax.Array):
+        if row_factors.shape[1] != col_factors.shape[1]:
+            raise ValueError("row/col latent dims differ")
+        self.row_factors = row_factors
+        self.col_factors = col_factors
+
+        @jax.jit
+        def score(rows, cols, rf, cf):
+            safe_r = jnp.maximum(rows, 0)
+            safe_c = jnp.maximum(cols, 0)
+            s = jnp.einsum("nk,nk->n", rf[safe_r], cf[safe_c])
+            return jnp.where((rows >= 0) & (cols >= 0), s, 0.0)
+
+        self._score = score
+
+    @property
+    def latent_dim(self) -> int:
+        return self.row_factors.shape[1]
+
+    def score(self, row_ids: jax.Array, col_ids: jax.Array) -> jax.Array:
+        return self._score(
+            row_ids, col_ids, self.row_factors, self.col_factors
+        )
+
+    @staticmethod
+    def random(
+        num_rows: int, num_cols: int, latent_dim: int, seed: int = 0,
+        dtype=jnp.float32,
+    ) -> "MatrixFactorizationModel":
+        rng = np.random.default_rng(seed)
+        return MatrixFactorizationModel(
+            jnp.asarray(rng.normal(size=(num_rows, latent_dim)), dtype),
+            jnp.asarray(rng.normal(size=(num_cols, latent_dim)), dtype),
+        )

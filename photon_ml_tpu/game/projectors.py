@@ -1,0 +1,140 @@
+"""Per-entity dimensionality reduction: the reference's ``projector/``.
+
+Three projector types (``projector/ProjectorType.scala:20-30``):
+
+  IDENTITY   — no-op.
+  RANDOM=k   — shared Gaussian random projection matrix, N(0, 1/k) for
+               projected dimension k, with an optional intercept
+               passthrough row (``projector/ProjectionMatrix.scala:96-126``).
+  INDEX_MAP  — per-entity compaction onto the union of feature indices
+               actually active in that entity's data
+               (``projector/IndexMapProjector.scala:44``,
+               ``projector/IndexMapProjectorRDD.scala:113-120``).
+
+On TPU a projection is a matmul (RANDOM) or a gather (INDEX_MAP) applied to
+the padded (entities, rows, dim) design once at ingest; coefficients are
+projected back to the original space by the transpose operation
+(``model/RandomEffectModelInProjectedSpace.scala:31-97``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.core.types import _pytree_dataclass
+from photon_ml_tpu.game.data import RandomEffectDesign
+
+
+@_pytree_dataclass
+class RandomProjection:
+    """Shared Gaussian projection (``ProjectionMatrix.scala:33-127``).
+
+    matrix: (d, k) with entries N(0, 1/k); if intercept_index is set, that
+    original dimension maps to a dedicated passthrough output column
+    (the reference appends an identity row for the intercept).
+    """
+
+    matrix: jax.Array  # (d, k)
+
+    @property
+    def projected_dim(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_features(self, features: jax.Array) -> jax.Array:
+        """(..., d) -> (..., k)."""
+        return features @ self.matrix
+
+    def project_coefficients_back(self, coef: jax.Array) -> jax.Array:
+        """(..., k) -> (..., d): w_orig = P w_proj so that
+        x_orig . w_orig == (P^T x_orig) . w_proj."""
+        return coef @ self.matrix.T
+
+
+def build_random_projection(
+    original_dim: int,
+    projected_dim: int,
+    seed: int = 0,
+    intercept_index: Optional[int] = None,
+    dtype=jnp.float32,
+) -> RandomProjection:
+    rng = np.random.default_rng(seed)
+    k = projected_dim
+    m = rng.normal(0.0, 1.0 / np.sqrt(k), size=(original_dim, k))
+    if intercept_index is not None:
+        # intercept passthrough: its own exclusive output column
+        m = np.concatenate([m, np.zeros((original_dim, 1))], axis=1)
+        m[intercept_index, :] = 0.0
+        m[intercept_index, -1] = 1.0
+    return RandomProjection(matrix=jnp.asarray(m, dtype))
+
+
+@_pytree_dataclass
+class IndexMapProjection:
+    """Per-entity feature-index compaction.
+
+    columns: (E, k) int32 — for each entity, the original feature indices
+    kept (padded with -1). k = max active-feature count over entities.
+    """
+
+    columns: jax.Array
+
+    @property
+    def projected_dim(self) -> int:
+        return self.columns.shape[1]
+
+    def project_design(self, design: RandomEffectDesign) -> RandomEffectDesign:
+        """(E, R, d) -> (E, R, k) by per-entity column gather."""
+        safe = jnp.maximum(self.columns, 0)  # (E, k)
+        gathered = jnp.take_along_axis(
+            design.features, safe[:, None, :], axis=2
+        )
+        col_mask = (self.columns >= 0)[:, None, :]
+        return dataclasses.replace(
+            design, features=jnp.where(col_mask, gathered, 0.0)
+        )
+
+    def project_coefficients_back(
+        self, table: jax.Array, original_dim: int
+    ) -> jax.Array:
+        """(E, k) -> (E, d): scatter back to original indices."""
+        e, k = table.shape
+        out = jnp.zeros((e, original_dim), table.dtype)
+        safe = jnp.maximum(self.columns, 0)
+        vals = jnp.where(self.columns >= 0, table, 0.0)
+        return out.at[jnp.arange(e)[:, None], safe].add(vals)
+
+    def project_row_features(
+        self, features: jax.Array, entities: jax.Array
+    ) -> jax.Array:
+        """(n, d) rows -> (n, k) in each row's OWN entity's projected space
+        (entity -1 rows produce zeros; they score 0 anyway)."""
+        safe_e = jnp.maximum(entities, 0)
+        cols = self.columns[safe_e]  # (n, k)
+        safe_c = jnp.maximum(cols, 0)
+        gathered = jnp.take_along_axis(features, safe_c, axis=1)
+        keep = (cols >= 0) & (entities >= 0)[:, None]
+        return jnp.where(keep, gathered, 0.0)
+
+
+def build_index_map_projection(
+    design: RandomEffectDesign, dtype=jnp.int32
+) -> IndexMapProjection:
+    """Union of active feature indices per entity
+    (``IndexMapProjectorRDD.scala:113-120``): a feature is kept for an
+    entity iff it is nonzero in any of that entity's active rows."""
+    feats = np.asarray(design.features)  # (E, R, d)
+    mask = np.asarray(design.mask)[:, :, None]
+    active = (np.abs(feats) > 0) & (mask > 0)  # (E, R, d)
+    per_entity = active.any(axis=1)  # (E, d)
+    k = max(int(per_entity.sum(axis=1).max()), 1)
+    e, d = per_entity.shape
+    cols = np.full((e, k), -1, np.int64)
+    for i in range(e):
+        idx = np.nonzero(per_entity[i])[0]
+        cols[i, : len(idx)] = idx
+    return IndexMapProjection(columns=jnp.asarray(cols, dtype))

@@ -1,0 +1,203 @@
+"""Projectors + factored random effects + MF model."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.game import (
+    CoordinateConfig,
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    GameData,
+    RandomEffectCoordinate,
+    build_random_effect_design,
+)
+from photon_ml_tpu.game.factored import (
+    FactoredConfig,
+    FactoredRandomEffectCoordinate,
+    MatrixFactorizationModel,
+)
+from photon_ml_tpu.game.projectors import (
+    build_index_map_projection,
+    build_random_projection,
+)
+from photon_ml_tpu.models.training import OptimizerType
+
+
+class TestRandomProjection:
+    def test_margin_preserved_through_back_projection(self, rng):
+        proj = build_random_projection(20, 8, seed=1, dtype=jnp.float64)
+        x = jnp.asarray(rng.normal(size=(50, 20)))
+        w_proj = jnp.asarray(rng.normal(size=proj.projected_dim))
+        # x_proj . w_proj == x . back_projected(w_proj) by definition
+        lhs = proj.project_features(x) @ w_proj
+        rhs = x @ proj.project_coefficients_back(w_proj)
+        np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs), atol=1e-12)
+
+    def test_intercept_passthrough(self, rng):
+        proj = build_random_projection(
+            10, 4, seed=2, intercept_index=9, dtype=jnp.float64
+        )
+        assert proj.projected_dim == 5  # 4 + dedicated intercept column
+        x = np.zeros((3, 10))
+        x[:, 9] = 1.0  # intercept-only rows
+        p = np.asarray(proj.project_features(jnp.asarray(x)))
+        np.testing.assert_allclose(p[:, :-1], 0.0, atol=1e-15)
+        np.testing.assert_allclose(p[:, -1], 1.0)
+
+    def test_variance_scaling(self):
+        proj = build_random_projection(1000, 50, seed=3, dtype=jnp.float64)
+        m = np.asarray(proj.matrix)
+        assert m.std() == pytest.approx(1.0 / np.sqrt(50), rel=0.05)
+
+
+class TestIndexMapProjection:
+    def test_compaction_preserves_margins(self, rng):
+        # entity 0 uses features {0,1}, entity 1 uses {2,3,4}
+        n, d = 12, 6
+        user = np.array([0] * 6 + [1] * 6)
+        x = np.zeros((n, d))
+        x[:6, [0, 1]] = rng.normal(size=(6, 2))
+        x[6:, 2:5] = rng.normal(size=(6, 3))
+        data = GameData.create(
+            features={"s": x}, labels=np.zeros(n), entity_ids={"u": user}
+        )
+        design = build_random_effect_design(data, "u", "s", 2, dtype=jnp.float64)
+        proj = build_index_map_projection(design)
+        assert proj.projected_dim == 3  # max active features over entities
+
+        projected = proj.project_design(design)
+        # random per-entity coefficient in projected space
+        table_proj = jnp.asarray(rng.normal(size=(2, 3)))
+        table_full = proj.project_coefficients_back(table_proj, d)
+        # margins must agree between projected and full representations
+        m_proj = np.einsum(
+            "erk,ek->er", np.asarray(projected.features), np.asarray(table_proj)
+        )
+        m_full = np.einsum(
+            "erd,ed->er", np.asarray(design.features), np.asarray(table_full)
+        )
+        np.testing.assert_allclose(m_proj, m_full, atol=1e-12)
+
+    def test_row_feature_projection_matches(self, rng):
+        n, d = 10, 5
+        user = np.array([0] * 5 + [1] * 5)
+        x = rng.normal(size=(n, d))
+        x[:5, 3:] = 0.0  # entity 0: features 0-2
+        x[5:, :3] = 0.0  # entity 1: features 3-4
+        data = GameData.create(
+            features={"s": x}, labels=np.zeros(n), entity_ids={"u": user}
+        )
+        design = build_random_effect_design(data, "u", "s", 2, dtype=jnp.float64)
+        proj = build_index_map_projection(design)
+        rows_proj = np.asarray(
+            proj.project_row_features(
+                jnp.asarray(x), jnp.asarray(user.astype(np.int32))
+            )
+        )
+        table_proj = jnp.asarray(rng.normal(size=(2, proj.projected_dim)))
+        table_full = np.asarray(proj.project_coefficients_back(table_proj, d))
+        m_proj = np.einsum("nk,nk->n", rows_proj, np.asarray(table_proj)[user])
+        m_full = np.einsum("nd,nd->n", x, table_full[user])
+        np.testing.assert_allclose(m_proj, m_full, atol=1e-12)
+
+
+class TestFactoredRandomEffect:
+    def test_low_rank_structure_recovered(self, rng):
+        # true model: w_e = B gamma_e with k=2, d=8 — factored should fit
+        n_users, rpu, d, k = 30, 40, 8, 2
+        n = n_users * rpu
+        user = np.repeat(np.arange(n_users), rpu)
+        x = rng.normal(size=(n, d))
+        b_true = rng.normal(size=(d, k))
+        g_true = rng.normal(size=(n_users, k)) * 2
+        margin = np.einsum("nd,nd->n", x, (g_true @ b_true.T)[user])
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+        data = GameData.create(
+            features={"s": x}, labels=y, entity_ids={"u": user}
+        )
+        design = build_random_effect_design(data, "u", "s", n_users, dtype=jnp.float64)
+        cfg = CoordinateConfig(
+            shard="s",
+            random_effect="u",
+            optimizer=OptimizerType.TRON,
+            reg_weight=0.1,
+            tolerance=1e-8,
+        )
+        coord = FactoredRandomEffectCoordinate(
+            design=design,
+            row_features=jnp.asarray(x),
+            row_entities=jnp.asarray(user.astype(np.int32)),
+            full_offsets_base=jnp.zeros(n),
+            re_config=cfg,
+            factored=FactoredConfig(latent_dim=k, num_inner_iterations=3),
+        )
+        params, _ = coord.update(coord.initial_params(), jnp.zeros(n))
+        from photon_ml_tpu.ops.metrics import area_under_roc_curve
+
+        auc = float(
+            area_under_roc_curve(
+                jnp.asarray(y), coord.score(params), jnp.ones(n)
+            )
+        )
+        assert auc > 0.8
+        full = coord.to_full_table(params)
+        assert full.shape == (n_users, d)
+        # factored table is exactly rank-k
+        assert np.linalg.matrix_rank(np.asarray(full)) <= k
+
+    def test_in_coordinate_descent(self, rng):
+        n_users, rpu = 12, 25
+        n = n_users * rpu
+        user = np.repeat(np.arange(n_users), rpu)
+        xg = rng.normal(size=(n, 3))
+        xu = rng.normal(size=(n, 6))
+        margin = xg @ rng.normal(size=3) + np.einsum(
+            "nd,nd->n", xu, (rng.normal(size=(n_users, 2)) @ rng.normal(size=(2, 6)))[user]
+        )
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+        data = GameData.create(
+            features={"g": xg, "u": xu}, labels=y, entity_ids={"uid": user}
+        )
+        fe = FixedEffectCoordinate(
+            data.fixed_effect_batch("g", jnp.float64),
+            CoordinateConfig(shard="g", reg_weight=0.1, tolerance=1e-8),
+        )
+        design = build_random_effect_design(data, "uid", "u", n_users, dtype=jnp.float64)
+        fre = FactoredRandomEffectCoordinate(
+            design=design,
+            row_features=jnp.asarray(xu),
+            row_entities=jnp.asarray(user.astype(np.int32)),
+            full_offsets_base=jnp.zeros(n),
+            re_config=CoordinateConfig(
+                shard="u", random_effect="uid", reg_weight=0.5, tolerance=1e-8
+            ),
+            factored=FactoredConfig(latent_dim=2, num_inner_iterations=2),
+        )
+        cd = CoordinateDescent(
+            coordinates={"fixed": fe, "factored": fre},
+            labels=jnp.asarray(y),
+            base_offsets=jnp.zeros(n),
+            weights=jnp.ones(n),
+            task=TaskType.LOGISTIC_REGRESSION,
+        )
+        model, hist = cd.run(num_iterations=2)
+        objs = [h.objective for h in hist]
+        assert all(np.isfinite(objs))
+        assert objs[-1] < objs[0]
+
+
+class TestMatrixFactorization:
+    def test_score_and_missing(self, rng):
+        mf = MatrixFactorizationModel.random(5, 7, 3, dtype=jnp.float64)
+        rows = jnp.asarray([0, 2, -1, 4])
+        cols = jnp.asarray([1, -1, 3, 6])
+        s = np.asarray(mf.score(rows, cols))
+        rf, cf = np.asarray(mf.row_factors), np.asarray(mf.col_factors)
+        assert s[0] == pytest.approx(rf[0] @ cf[1])
+        assert s[1] == 0.0 and s[2] == 0.0
+        assert s[3] == pytest.approx(rf[4] @ cf[6])
