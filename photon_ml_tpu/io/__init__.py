@@ -1,0 +1,43 @@
+"""I/O: Avro codec, ingest, model persistence, vocabularies, checkpoints.
+
+Rebuild of the reference's L8 (``io/GLMSuite.scala``, ``avro/AvroUtils.scala``,
+``avro/model/ModelProcessingUtils.scala``, ``util/IndexMap.scala`` family).
+The wire formats stay BayesianLinearModelAvro / TrainingExampleAvro
+compatible so models interchange with the reference's Spark jobs; the codec
+itself is self-contained (no avro package in the image).
+"""
+
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+from photon_ml_tpu.io.schemas import (
+    BAYESIAN_LINEAR_MODEL_SCHEMA,
+    FEATURE_SCHEMA,
+    LATENT_FACTOR_SCHEMA,
+    TRAINING_EXAMPLE_SCHEMA,
+)
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+from photon_ml_tpu.io.ingest import (
+    labeled_batch_from_avro,
+    training_examples_to_arrays,
+)
+from photon_ml_tpu.io.models import (
+    load_glm_model,
+    load_game_model,
+    save_glm_model,
+    save_game_model,
+)
+
+__all__ = [
+    "read_avro_file",
+    "write_avro_file",
+    "FEATURE_SCHEMA",
+    "TRAINING_EXAMPLE_SCHEMA",
+    "BAYESIAN_LINEAR_MODEL_SCHEMA",
+    "LATENT_FACTOR_SCHEMA",
+    "FeatureVocabulary",
+    "labeled_batch_from_avro",
+    "training_examples_to_arrays",
+    "save_glm_model",
+    "load_glm_model",
+    "save_game_model",
+    "load_game_model",
+]

@@ -1,0 +1,100 @@
+"""Ingest: Avro training records -> dense columnar arrays / LabeledBatch.
+
+Rebuild of ``io/GLMSuite.readLabeledPointsFromAvro`` (``GLMSuite.scala:96-353``)
+and the GAME-side ``avro/data/DataProcessingUtils.getGameDataSetFromGenericRecords``
+(``DataProcessingUtils.scala:34-131``): sparse (name, term, value) feature
+lists are indexed against a vocabulary, duplicate (name, term) entries in
+one record are summed (:70-76 dedup-by-sum), the intercept column is set to
+1, and rows land in a dense float matrix (the TPU-side representation —
+sparse CSR batches are a later optimization documented in SURVEY §7).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+
+def training_examples_to_arrays(
+    records: List[dict],
+    vocab: FeatureVocabulary,
+) -> Dict[str, np.ndarray]:
+    """TrainingExampleAvro dicts -> dense columnar arrays.
+
+    Returns {features (n,d), labels, offsets, weights, uids}. Features not
+    in the vocabulary are skipped (the reference drops them the same way);
+    the intercept column (if the vocabulary has one) is set to 1.0.
+    """
+    n = len(records)
+    d = len(vocab)
+    x = np.zeros((n, d), np.float64)
+    labels = np.zeros(n, np.float64)
+    offsets = np.zeros(n, np.float64)
+    weights = np.ones(n, np.float64)
+    uids: List[Optional[str]] = []
+    icpt = vocab.intercept_index
+
+    for i, rec in enumerate(records):
+        labels[i] = rec["label"]
+        if rec.get("offset") is not None:
+            offsets[i] = rec["offset"]
+        if rec.get("weight") is not None:
+            weights[i] = rec["weight"]
+        uids.append(rec.get("uid"))
+        for f in rec["features"]:
+            j = vocab.key_to_index.get(feature_key(f["name"], f["term"]))
+            if j is not None:
+                x[i, j] += f["value"]  # dedup-by-sum semantics
+        if icpt is not None:
+            x[i, icpt] = 1.0
+
+    return {
+        "features": x,
+        "labels": labels,
+        "offsets": offsets,
+        "weights": weights,
+        "uids": np.asarray(uids, object),
+    }
+
+
+def labeled_batch_from_avro(
+    records: List[dict],
+    vocab: FeatureVocabulary,
+    dtype=None,
+) -> LabeledBatch:
+    import jax.numpy as jnp
+
+    cols = training_examples_to_arrays(records, vocab)
+    return LabeledBatch.create(
+        cols["features"],
+        cols["labels"],
+        offsets=cols["offsets"],
+        weights=cols["weights"],
+        dtype=dtype or jnp.float32,
+    )
+
+
+def make_training_example(
+    label: float,
+    features: Dict[Tuple[str, str], float],
+    uid: Optional[str] = None,
+    offset: Optional[float] = None,
+    weight: Optional[float] = None,
+) -> dict:
+    """Helper to synthesize TrainingExampleAvro dicts (the analog of the
+    reference's test builders, ``io/TrainingAvroBuilderFactory.scala``)."""
+    return {
+        "uid": uid,
+        "label": float(label),
+        "features": [
+            {"name": n, "term": t, "value": float(v)}
+            for (n, t), v in features.items()
+        ],
+        "metadataMap": None,
+        "weight": weight,
+        "offset": offset,
+    }

@@ -1,0 +1,223 @@
+"""Model persistence, wire-compatible with the reference.
+
+GLM models: one BayesianLinearModelAvro record (means + optional variances
+as (name, term, value) triples) — ``avro/AvroUtils.scala:53-225`` +
+``avro/model/ModelProcessingUtils.scala``.
+
+GAME models: the reference's HDFS directory layout
+(``ModelProcessingUtils.scala:39-86``):
+
+    <root>/fixed-effect/<coordinate>/{id-info, coefficients/part-00000.avro}
+    <root>/random-effect/<coordinate>/{id-info, coefficients/part-00000.avro}
+
+fixed-effect coefficients hold ONE record; random-effect files hold one
+record per entity with modelId = the raw entity key. id-info records the
+feature-shard id (and random-effect type for RE coordinates).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import Coefficients
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+from photon_ml_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_SCHEMA
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+
+# reference loss-function class names (BayesianLinearModelAvro.lossFunction)
+_LOSS_CLASS = {
+    TaskType.LOGISTIC_REGRESSION: "com.linkedin.photon.ml.function.LogisticLossFunction",
+    TaskType.LINEAR_REGRESSION: "com.linkedin.photon.ml.function.SquaredLossFunction",
+    TaskType.POISSON_REGRESSION: "com.linkedin.photon.ml.function.PoissonLossFunction",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "com.linkedin.photon.ml.function.SmoothedHingeLossFunction",
+}
+_CLASS_LOSS = {v: k for k, v in _LOSS_CLASS.items()}
+
+
+def _coefficients_to_record(
+    model_id: str,
+    means: np.ndarray,
+    variances: Optional[np.ndarray],
+    vocab: FeatureVocabulary,
+    task: Optional[TaskType],
+    sparsify: bool = True,
+) -> dict:
+    def triples(vec):
+        out = []
+        for i, v in enumerate(vec):
+            if sparsify and v == 0.0 and i != vocab.intercept_index:
+                continue
+            name, term = vocab.name_term(i)
+            out.append({"name": name, "term": term, "value": float(v)})
+        return out
+
+    return {
+        "modelId": model_id,
+        "means": triples(means),
+        "variances": None if variances is None else triples(variances),
+        "lossFunction": _LOSS_CLASS.get(task) if task else None,
+    }
+
+
+def _record_to_coefficients(
+    rec: dict, vocab: FeatureVocabulary
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    d = len(vocab)
+    means = np.zeros(d)
+    for t in rec["means"]:
+        idx = vocab.get(t["name"], t["term"])
+        if idx is not None:
+            means[idx] = t["value"]
+    variances = None
+    if rec.get("variances"):
+        variances = np.zeros(d)
+        for t in rec["variances"]:
+            idx = vocab.get(t["name"], t["term"])
+            if idx is not None:
+                variances[idx] = t["value"]
+    return means, variances
+
+
+def save_glm_model(
+    path: str,
+    coefficients: Coefficients,
+    vocab: FeatureVocabulary,
+    task: Optional[TaskType] = None,
+    model_id: str = "",
+):
+    means = np.asarray(coefficients.means)
+    variances = (
+        None
+        if coefficients.variances is None
+        else np.asarray(coefficients.variances)
+    )
+    write_avro_file(
+        path,
+        BAYESIAN_LINEAR_MODEL_SCHEMA,
+        [_coefficients_to_record(model_id, means, variances, vocab, task)],
+    )
+
+
+def load_glm_model(
+    path: str, vocab: FeatureVocabulary
+) -> Tuple[Coefficients, Optional[TaskType]]:
+    import jax.numpy as jnp
+
+    _, records = read_avro_file(path)
+    if len(records) != 1:
+        raise ValueError(f"{path}: expected 1 model record, got {len(records)}")
+    means, variances = _record_to_coefficients(records[0], vocab)
+    task = _CLASS_LOSS.get(records[0].get("lossFunction"))
+    return (
+        Coefficients(
+            means=jnp.asarray(means),
+            variances=None if variances is None else jnp.asarray(variances),
+        ),
+        task,
+    )
+
+
+# ---------------------------------------------------------------------------
+# GAME model directories
+# ---------------------------------------------------------------------------
+
+
+def save_game_model(
+    root: str,
+    params: Dict[str, np.ndarray],
+    shards: Dict[str, str],
+    vocabs: Dict[str, FeatureVocabulary],
+    entity_vocabs: Dict[str, dict],
+    random_effects: Dict[str, Optional[str]],
+    task: Optional[TaskType] = None,
+):
+    """params: coordinate -> (d,) fixed or (E, d) random-effect table.
+    shards: coordinate -> feature shard id; vocabs: coordinate -> vocab;
+    entity_vocabs: coordinate -> {raw_id: index} for RE coordinates;
+    random_effects: coordinate -> RE type name or None (fixed)."""
+    for name, table in params.items():
+        table = np.asarray(table)
+        re_type = random_effects.get(name)
+        kind = "fixed-effect" if re_type is None else "random-effect"
+        cdir = os.path.join(root, kind, name)
+        os.makedirs(os.path.join(cdir, "coefficients"), exist_ok=True)
+        with open(os.path.join(cdir, "id-info"), "w") as f:
+            f.write(f"featureShardId={shards[name]}\n")
+            if re_type is not None:
+                f.write(f"randomEffectType={re_type}\n")
+        vocab = vocabs[name]
+        if re_type is None:
+            records = [
+                _coefficients_to_record(name, table, None, vocab, task)
+            ]
+        else:
+            index_to_id = {
+                v: k for k, v in entity_vocabs[name].items()
+            }
+            records = [
+                _coefficients_to_record(
+                    str(index_to_id.get(e, e)), table[e], None, vocab, task
+                )
+                for e in range(table.shape[0])
+            ]
+        write_avro_file(
+            os.path.join(cdir, "coefficients", "part-00000.avro"),
+            BAYESIAN_LINEAR_MODEL_SCHEMA,
+            records,
+        )
+
+
+def load_game_model(
+    root: str,
+    vocabs: Dict[str, FeatureVocabulary],
+    entity_vocabs: Optional[Dict[str, dict]] = None,
+):
+    """Returns (params, shards, random_effects) mirroring save_game_model.
+    Unknown coordinates on disk are loaded by directory name."""
+    params: Dict[str, np.ndarray] = {}
+    shards: Dict[str, str] = {}
+    random_effects: Dict[str, Optional[str]] = {}
+    for kind in ("fixed-effect", "random-effect"):
+        kdir = os.path.join(root, kind)
+        if not os.path.isdir(kdir):
+            continue
+        for name in sorted(os.listdir(kdir)):
+            cdir = os.path.join(kdir, name)
+            info = {}
+            with open(os.path.join(cdir, "id-info")) as f:
+                for line in f:
+                    if "=" in line:
+                        k, v = line.strip().split("=", 1)
+                        info[k] = v
+            shards[name] = info.get("featureShardId", name)
+            random_effects[name] = info.get("randomEffectType")
+            vocab = vocabs[name]
+            _, records = read_avro_file(
+                os.path.join(cdir, "coefficients", "part-00000.avro")
+            )
+            if kind == "fixed-effect":
+                means, _ = _record_to_coefficients(records[0], vocab)
+                params[name] = means
+            else:
+                evocab = (entity_vocabs or {}).get(name) or {
+                    rec["modelId"]: i for i, rec in enumerate(records)
+                }
+                table = np.zeros((len(evocab), len(vocab)))
+                for rec in records:
+                    raw = rec["modelId"]
+                    e = evocab.get(raw, evocab.get(_maybe_int(raw)))
+                    if e is not None:
+                        table[e], _ = _record_to_coefficients(rec, vocab)
+                params[name] = table
+    return params, shards, random_effects
+
+
+def _maybe_int(s):
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return s
