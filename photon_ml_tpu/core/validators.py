@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Dict
+import os
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,17 +58,21 @@ def sanity_check_data(
     task: TaskType,
     mode: DataValidationType = DataValidationType.VALIDATE_FULL,
     sample_fraction: float = 0.01,
-    seed: int = 0,
+    seed: Optional[int] = None,
 ) -> Dict[str, int]:
     """Raise ValueError on any violation (``DataValidators.sanityCheckData``).
 
     Returns the (all-zero) per-check violation counts on success. SAMPLE mode
-    subsamples rows Bernoulli(sample_fraction) like the reference's 1% check.
+    subsamples rows Bernoulli(sample_fraction) like the reference's 1% check;
+    the sample is drawn fresh (from OS entropy) unless a seed is pinned, so
+    repeated validation passes inspect different rows.
     """
     if mode == DataValidationType.VALIDATE_DISABLED:
         return {}
     checked = batch
     if mode == DataValidationType.VALIDATE_SAMPLE:
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
         keep = (
             jax.random.uniform(jax.random.PRNGKey(seed), batch.mask.shape)
             < sample_fraction
@@ -79,5 +84,10 @@ def sanity_check_data(
     }
     bad = {k: v for k, v in counts.items() if v > 0}
     if bad:
-        raise ValueError(f"input data failed validation: {bad}")
+        detail = (
+            f" (sample seed={seed})"
+            if mode == DataValidationType.VALIDATE_SAMPLE
+            else ""
+        )
+        raise ValueError(f"input data failed validation: {bad}{detail}")
     return counts

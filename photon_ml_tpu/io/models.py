@@ -176,11 +176,16 @@ def load_game_model(
     vocabs: Dict[str, FeatureVocabulary],
     entity_vocabs: Optional[Dict[str, dict]] = None,
 ):
-    """Returns (params, shards, random_effects) mirroring save_game_model.
-    Unknown coordinates on disk are loaded by directory name."""
+    """Returns (params, shards, random_effects, entity_vocabs) mirroring
+    save_game_model. Unknown coordinates on disk are loaded by directory
+    name. The returned entity_vocabs maps each random-effect coordinate to
+    its {raw_id: row} table mapping — when the caller didn't supply one, the
+    mapping is constructed from record order and MUST be used to index the
+    table (row order on disk is not otherwise meaningful)."""
     params: Dict[str, np.ndarray] = {}
     shards: Dict[str, str] = {}
     random_effects: Dict[str, Optional[str]] = {}
+    entity_vocabs_out: Dict[str, dict] = {}
     for kind in ("fixed-effect", "random-effect"):
         kdir = os.path.join(root, kind)
         if not os.path.isdir(kdir):
@@ -203,9 +208,12 @@ def load_game_model(
                 means, _ = _record_to_coefficients(records[0], vocab)
                 params[name] = means
             else:
-                evocab = (entity_vocabs or {}).get(name) or {
-                    rec["modelId"]: i for i, rec in enumerate(records)
-                }
+                if entity_vocabs is not None and name in entity_vocabs:
+                    evocab = entity_vocabs[name]
+                else:
+                    evocab = {
+                        rec["modelId"]: i for i, rec in enumerate(records)
+                    }
                 table = np.zeros((len(evocab), len(vocab)))
                 for rec in records:
                     raw = rec["modelId"]
@@ -213,7 +221,8 @@ def load_game_model(
                     if e is not None:
                         table[e], _ = _record_to_coefficients(rec, vocab)
                 params[name] = table
-    return params, shards, random_effects
+                entity_vocabs_out[name] = dict(evocab)
+    return params, shards, random_effects, entity_vocabs_out
 
 
 def _maybe_int(s):

@@ -42,17 +42,21 @@ def select_best_model(
     """Returns (best model, {reg_weight: metric value}).
 
     Selection direction follows ``ModelSelection.scala``: max for AUC,
-    min for the error metrics.
+    min for the error metrics. Candidates are compared by position, so
+    duplicate reg weights in the sweep stay distinct candidates (the
+    returned scores dict keeps the last value per weight, for display).
     """
     if not trained:
         raise ValueError("no trained models to select from")
     task = trained[0].model.task
     higher_is_better = task.is_classifier
     scores = {}
+    values = []
     for tm in trained:
         _, value = validation_metric(task, tm.model, validation)
+        values.append(float(value))
         scores[tm.reg_weight] = float(value)
-    best = (max if higher_is_better else min)(
-        trained, key=lambda tm: scores[tm.reg_weight]
+    best_i = (max if higher_is_better else min)(
+        range(len(trained)), key=values.__getitem__
     )
-    return best, scores
+    return trained[best_i], scores

@@ -106,13 +106,17 @@ def _pr_curve(labels, scores, weights):
 
 
 def average_precision(labels, scores, weights):
-    """AUPR by step interpolation (sklearn's average_precision convention;
-    the reference's Spark metric is the same curve area)."""
+    """AUPR by step interpolation (sklearn's average_precision convention).
+
+    Intentional divergence from the reference: Spark's ``areaUnderPR``
+    linearly interpolates between PR points (trapezoids), which is known to
+    overestimate the area; the step convention is both the sklearn standard
+    and the conservative choice, so values can differ slightly from
+    reference output on the same data."""
     tp, fp, boundary = _pr_curve(labels, scores, weights)
     total_pos = tp[-1]
     precision = tp / jnp.maximum(tp + fp, 1e-30)
     recall = tp / jnp.maximum(total_pos, 1e-30)
-    recall_prev = jnp.concatenate([jnp.zeros((1,), recall.dtype), recall[:-1]])
     # only integrate across tie-group boundaries
     d_recall = jnp.where(boundary, recall - _prev_boundary(recall, boundary), 0.0)
     return jnp.sum(d_recall * precision)

@@ -211,10 +211,14 @@ def minimize_lbfgs(
         )
         # A dead line search means no further progress is possible. It also
         # leaves w unchanged (alpha=0), so the |df|=0 function-value test
-        # would fire spuriously — the override takes precedence over
-        # everything except a genuinely converged gradient.
+        # would fire spuriously — the override replaces that spurious
+        # FUNCTION_VALUES_CONVERGED (and NOT_CONVERGED), but never a
+        # genuinely converged gradient nor MAX_ITERATIONS, which the
+        # reference checks first (``AbstractOptimizer.scala:49-63``).
         reason = jnp.where(
-            (~ls_ok) & (reason != ConvergenceReason.GRADIENT_CONVERGED),
+            (~ls_ok)
+            & (reason != ConvergenceReason.GRADIENT_CONVERGED)
+            & (reason != ConvergenceReason.MAX_ITERATIONS),
             jnp.int32(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
             reason,
         )
@@ -387,7 +391,9 @@ def minimize_owlqn(
             config.tolerance,
         )
         reason = jnp.where(
-            (~ls_ok) & (reason != ConvergenceReason.GRADIENT_CONVERGED),
+            (~ls_ok)
+            & (reason != ConvergenceReason.GRADIENT_CONVERGED)
+            & (reason != ConvergenceReason.MAX_ITERATIONS),
             jnp.int32(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
             reason,
         )

@@ -232,7 +232,7 @@ class TestModelPersistence:
         )
         assert os.path.isdir(os.path.join(root, "fixed-effect", "global"))
         assert os.path.isdir(os.path.join(root, "random-effect", "per-user"))
-        params, shards, res = load_game_model(
+        params, shards, res, evocabs = load_game_model(
             root,
             vocabs={"global": g_vocab, "per-user": u_vocab},
             entity_vocabs={"per-user": entity_vocab},
@@ -241,3 +241,17 @@ class TestModelPersistence:
         np.testing.assert_allclose(params["per-user"], table, atol=1e-15)
         assert shards == {"global": "shardG", "per-user": "shardU"}
         assert res == {"global": None, "per-user": "userId"}
+        assert evocabs == {"per-user": entity_vocab}
+
+        # Without a caller-supplied entity vocab the row<->entity mapping is
+        # returned (ADVICE r1: it must never be lost) and indexing the table
+        # through it recovers the same per-entity coefficients.
+        params2, _, _, evocabs2 = load_game_model(
+            root, vocabs={"global": g_vocab, "per-user": u_vocab}
+        )
+        ev2 = evocabs2["per-user"]
+        assert set(ev2) == {str(k) for k in entity_vocab}
+        for raw, row in entity_vocab.items():
+            np.testing.assert_allclose(
+                params2["per-user"][ev2[str(raw)]], table[row], atol=1e-15
+            )
