@@ -23,8 +23,10 @@ Architecture vs the reference:
 """
 
 from photon_ml_tpu.game.data import (
+    BucketedRandomEffectDesign,
     GameData,
     RandomEffectDesign,
+    build_bucketed_random_effect_design,
     build_random_effect_design,
 )
 from photon_ml_tpu.game.coordinates import (
@@ -37,7 +39,9 @@ from photon_ml_tpu.game.descent import CoordinateDescent, GameModel
 __all__ = [
     "GameData",
     "RandomEffectDesign",
+    "BucketedRandomEffectDesign",
     "build_random_effect_design",
+    "build_bucketed_random_effect_design",
     "CoordinateConfig",
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",

@@ -31,9 +31,14 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from photon_ml_tpu.core.tasks import TaskType
 from photon_ml_tpu.core.types import LabeledBatch
-from photon_ml_tpu.game.data import RandomEffectDesign
+from photon_ml_tpu.game.data import (
+    BucketedRandomEffectDesign,
+    RandomEffectDesign,
+)
 from photon_ml_tpu.models.training import OptimizerType
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.objective import GLMObjective
@@ -158,17 +163,50 @@ class FixedEffectCoordinate:
         return self._score(w, self.batch.features)
 
 
+@dataclasses.dataclass
+class RandomEffectUpdateSummary:
+    """Per-entity tracker view of one (possibly multi-bucket) update —
+    the fields CoordinateDescent's histogram consumes, concatenated over
+    buckets with sharding-padding lanes removed
+    (``RandomEffectOptimizationTracker.scala:33-110``)."""
+
+    reason: np.ndarray  # (E_active,) int32
+    iterations: np.ndarray  # (E_active,) int32
+
+
+@lru_cache(maxsize=128)
+def _make_bucket_update(config: CoordinateConfig):
+    """jitted (table, entity_index, design arrays) -> (table', result):
+    gather warm starts from the global table, solve the bucket's entities
+    in one vmapped call, scatter solutions back. Sentinel indices
+    (== num_entities) clip on gather and drop on scatter."""
+    solve = _make_solve(config, batched=True)
+
+    @jax.jit
+    def update_bucket(table, entity_index, features, labels, offsets, weights, mask):
+        w0 = jnp.take(table, entity_index, axis=0, mode="clip")
+        result = solve(w0, features, labels, offsets, weights, mask)
+        new_table = table.at[entity_index].set(result.w, mode="drop")
+        return new_table, result
+
+    return update_bucket
+
+
 class RandomEffectCoordinate:
     """Per-entity batched coordinate.
 
     Owns the padded active design plus full-row (features, entity index)
     for scoring. Scoring covers ALL rows — active and passive — through the
     coefficient table (``RandomEffectCoordinate.scala:116-170``).
+
+    Accepts either a single global-cap :class:`RandomEffectDesign` or a
+    :class:`BucketedRandomEffectDesign`; a plain design is treated as one
+    bucket whose lanes ARE the table rows.
     """
 
     def __init__(
         self,
-        design: RandomEffectDesign,
+        design,  # RandomEffectDesign | BucketedRandomEffectDesign
         row_features: jax.Array,  # (n, d) full scoring view
         row_entities: jax.Array,  # (n,) int32, -1 = unknown entity
         full_offsets_base: jax.Array,  # (n,) data offsets
@@ -176,12 +214,25 @@ class RandomEffectCoordinate:
     ):
         if config.random_effect is None:
             raise ValueError("config lacks random_effect; wrong coordinate")
+        if isinstance(design, RandomEffectDesign):
+            design = BucketedRandomEffectDesign(
+                buckets=[design],
+                entity_index=[
+                    np.arange(design.num_entities, dtype=np.int32)
+                ],
+                num_entities=design.num_entities,
+            )
         self.design = design
         self.row_features = row_features
         self.row_entities = row_entities
         self.full_offsets_base = full_offsets_base
         self.config = config
-        self._solve = _make_solve(config, batched=True)
+        self._update_bucket = _make_bucket_update(config)
+        # static per-bucket masks of real (non-sharding-pad) lanes
+        self._valid_lanes = [
+            np.asarray(ei) < design.num_entities
+            for ei in design.entity_index
+        ]
 
         @jax.jit
         def score_rows(table, feats, ents):
@@ -201,24 +252,35 @@ class RandomEffectCoordinate:
 
     def initial_params(self) -> jax.Array:
         return jnp.zeros(
-            (self.num_entities, self.dim), self.design.features.dtype
+            (self.num_entities, self.dim),
+            self.design.buckets[0].features.dtype,
         )
 
     def update(
         self, table: jax.Array, partial_scores: jax.Array, key=None
     ) -> Tuple[jax.Array, object]:
-        offsets = self.design.gather_offsets(
-            self.full_offsets_base + partial_scores
+        full_offsets = self.full_offsets_base + partial_scores
+        reasons, iters = [], []
+        for bucket, entity_index, valid in zip(
+            self.design.buckets, self.design.entity_index, self._valid_lanes
+        ):
+            offsets = bucket.gather_offsets(full_offsets)
+            table, result = self._update_bucket(
+                table,
+                jnp.asarray(entity_index),
+                bucket.features,
+                bucket.labels,
+                offsets,
+                bucket.weights,
+                bucket.mask,
+            )
+            reasons.append(np.asarray(result.reason)[valid])
+            iters.append(np.asarray(result.iterations)[valid])
+        summary = RandomEffectUpdateSummary(
+            reason=np.concatenate(reasons),
+            iterations=np.concatenate(iters),
         )
-        result = self._solve(
-            table,
-            self.design.features,
-            self.design.labels,
-            offsets,
-            self.design.weights,
-            self.design.mask,
-        )
-        return result.w, result
+        return table, summary
 
     def score(self, table: jax.Array) -> jax.Array:
         return self._score(table, self.row_features, self.row_entities)

@@ -125,6 +125,60 @@ class RandomEffectDesign:
         return jnp.take(full_offsets, safe, axis=0) * self.mask
 
 
+def _grouped_rows(eids: np.ndarray, seed: int):
+    """Vectorized per-entity grouping with a uniform random shuffle inside
+    each entity (the reservoir-sample analog; no Python per-entity loop).
+
+    Returns (order, sorted_ids, slot, uniq, counts): `order` are row indices
+    sorted by (entity, random), `slot` is each row's position within its
+    entity, `uniq`/`counts` the entities present and their row counts.
+    """
+    rng = np.random.default_rng(seed)
+    rand = rng.uniform(size=eids.shape[0])
+    order = np.lexsort((rand, eids))
+    sorted_ids = eids[order]
+    valid = sorted_ids >= 0
+    order, sorted_ids = order[valid], sorted_ids[valid]
+    uniq, starts, counts = np.unique(
+        sorted_ids, return_index=True, return_counts=True
+    )
+    slot = np.arange(order.size) - np.repeat(starts, counts)
+    return order, sorted_ids, slot, uniq, counts
+
+
+def _fill_design(
+    data: GameData,
+    shard: str,
+    rows: np.ndarray,
+    ent_rows: np.ndarray,
+    slot_rows: np.ndarray,
+    rescale_rows: np.ndarray,
+    shape_e: int,
+    cap: int,
+    dtype,
+) -> RandomEffectDesign:
+    """Scatter kept rows into padded (shape_e, cap, d) tensors."""
+    x = np.asarray(data.features[shard])
+    d = x.shape[1]
+    feats = np.zeros((shape_e, cap, d), np.float64)
+    labels = np.zeros((shape_e, cap), np.float64)
+    weights = np.zeros((shape_e, cap), np.float64)
+    mask = np.zeros((shape_e, cap), np.float64)
+    row_index = np.full((shape_e, cap), -1, np.int64)
+    feats[ent_rows, slot_rows] = x[rows]
+    labels[ent_rows, slot_rows] = data.labels[rows]
+    weights[ent_rows, slot_rows] = data.weights[rows] * rescale_rows
+    mask[ent_rows, slot_rows] = 1.0
+    row_index[ent_rows, slot_rows] = rows
+    return RandomEffectDesign(
+        features=jnp.asarray(feats, dtype),
+        labels=jnp.asarray(labels, dtype),
+        weights=jnp.asarray(weights, dtype),
+        mask=jnp.asarray(mask, dtype),
+        row_index=jnp.asarray(row_index, jnp.int32),
+    )
+
+
 def build_random_effect_design(
     data: GameData,
     random_effect: str,
@@ -143,52 +197,208 @@ def build_random_effect_design(
         weight is preserved (:299-302);
       - rows of entities with index -1 (unknown) are dropped;
       - `num_entities` fixes the leading axis = the coefficient-table size.
+
+    One global row cap means one hot entity inflates padding for all; use
+    :func:`build_bucketed_random_effect_design` when entity sizes are skewed.
     """
-    x = np.asarray(data.features[shard])
     eids = np.asarray(data.entity_ids[random_effect])
-    n, d = x.shape
-    rng = np.random.default_rng(seed)
-
-    # stable grouping: row indices per entity
-    order = np.argsort(eids, kind="stable")
-    sorted_ids = eids[order]
-    valid = sorted_ids >= 0
-    order, sorted_ids = order[valid], sorted_ids[valid]
-    uniq, starts, counts = np.unique(
-        sorted_ids, return_index=True, return_counts=True
-    )
-
-    max_count = int(counts.max()) if counts.size else 1
     if active_cap is not None and active_cap <= 0:
         raise ValueError(f"active_cap must be positive, got {active_cap}")
+    order, sorted_ids, slot, uniq, counts = _grouped_rows(eids, seed)
+
+    max_count = int(counts.max()) if counts.size else 1
     cap = min(max_count, active_cap) if active_cap is not None else max_count
 
-    feats = np.zeros((num_entities, cap, d), np.float64)
-    labels = np.zeros((num_entities, cap), np.float64)
-    weights = np.zeros((num_entities, cap), np.float64)
-    mask = np.zeros((num_entities, cap), np.float64)
-    row_index = np.full((num_entities, cap), -1, np.int64)
+    cap_of = np.minimum(counts, cap)
+    keep = slot < np.repeat(cap_of, counts)
+    rescale = np.repeat(np.where(counts > cap, counts / cap, 1.0), counts)
+    return _fill_design(
+        data,
+        shard,
+        order[keep],
+        sorted_ids[keep],
+        slot[keep],
+        rescale[keep],
+        num_entities,
+        cap,
+        dtype,
+    )
 
-    for e, s, c in zip(uniq, starts, counts):
-        rows = order[s : s + c]
-        if c > cap:
-            rows = rng.choice(rows, size=cap, replace=False)
-            rescale = c / cap  # preserve total weight (reference :299-302)
-        else:
-            rescale = 1.0
-        k = len(rows)
-        feats[e, :k] = x[rows]
-        labels[e, :k] = data.labels[rows]
-        weights[e, :k] = data.weights[rows] * rescale
-        mask[e, :k] = 1.0
-        row_index[e, :k] = rows
 
-    return RandomEffectDesign(
-        features=jnp.asarray(feats, dtype),
-        labels=jnp.asarray(labels, dtype),
-        weights=jnp.asarray(weights, dtype),
-        mask=jnp.asarray(mask, dtype),
-        row_index=jnp.asarray(row_index, jnp.int32),
+@dataclasses.dataclass
+class BucketedRandomEffectDesign:
+    """Size-bucketed padded designs for one random effect.
+
+    Entities are grouped by row count into a few buckets, each padded only
+    to ITS max count — the TPU analog of the reference's load-balanced
+    entity placement (``data/RandomEffectIdPartitioner.scala:65-99``): the
+    greedy bin-pack balanced per-partition work; here the same skew problem
+    is solved by making padding local to a size class, so one hot entity no
+    longer inflates every entity's padded rows.
+
+    buckets[b] tensors have shape (E_b, R_b, d); entity_index[b] maps bucket
+    lane -> row of the global (num_entities, d) coefficient table. Lanes
+    padded for entity-axis sharding carry sentinel `num_entities`, which
+    gathers clip and scatters drop.
+    """
+
+    buckets: list  # List[RandomEffectDesign]
+    entity_index: list  # List[np.ndarray (E_b,) int32]
+    num_entities: int
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def dim(self) -> int:
+        return self.buckets[0].dim
+
+    @property
+    def active_slots(self) -> int:
+        """Total padded (entity, row) slots across buckets — the memory and
+        FLOP footprint a global-cap design would inflate."""
+        return sum(b.num_entities * b.rows_per_entity for b in self.buckets)
+
+
+def _split_minimizing_padding(sorted_counts: np.ndarray, max_buckets: int):
+    """Optimal contiguous split of ascending per-entity row counts into at
+    most `max_buckets` groups minimizing total padded slots
+    Σ_b |entities_b| · max_count_b (exact DP over distinct counts — the
+    number of distinct entity sizes is small even when entities number
+    millions). Returns [(lo, hi)) index ranges into sorted_counts."""
+    if sorted_counts.size == 0:
+        return []
+    values, first, nums = np.unique(
+        sorted_counts, return_index=True, return_counts=True
+    )
+    m = values.size
+    k = min(max_buckets, m)
+    prefix = np.concatenate([[0], np.cumsum(nums)])
+    INF = float("inf")
+    # dp[j] = min cost covering distinct values [0, j) ; rebuilt per layer
+    dp = np.full(m + 1, INF)
+    dp[0] = 0.0
+    choice = np.zeros((k, m + 1), np.int64)
+    for layer in range(k):
+        nxt = np.full(m + 1, INF)
+        for j in range(1, m + 1):
+            # bucket = distinct values [i, j) with cap values[j-1]
+            costs = dp[:j] + (prefix[j] - prefix[:j]) * values[j - 1]
+            i = int(np.argmin(costs))
+            nxt[j] = costs[i]
+            choice[layer, j] = i
+        dp = nxt
+    # backtrack
+    bounds = []
+    j = m
+    layer = k - 1
+    while j > 0:
+        i = int(choice[layer, j])
+        bounds.append((int(prefix[i]), int(prefix[j])))
+        j = i
+        layer -= 1
+    return bounds[::-1]
+
+
+def build_bucketed_random_effect_design(
+    data: GameData,
+    random_effect: str,
+    shard: str,
+    num_entities: int,
+    num_buckets: int = 4,
+    active_cap: Optional[int] = None,
+    entity_multiple: int = 1,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> BucketedRandomEffectDesign:
+    """Like :func:`build_random_effect_design` but with per-size-class row
+    caps. Entities (those with data) are sorted by row count and split into
+    `num_buckets` contiguous groups; each bucket's row cap is its own max
+    count (still bounded by `active_cap`, with the same weight-preserving
+    rescale). `entity_multiple` pads each bucket's entity axis up to a
+    multiple (the entity-mesh-axis size) so buckets shard evenly."""
+    eids = np.asarray(data.entity_ids[random_effect])
+    if active_cap is not None and active_cap <= 0:
+        raise ValueError(f"active_cap must be positive, got {active_cap}")
+    if entity_multiple <= 0:
+        raise ValueError(f"entity_multiple must be positive, got {entity_multiple}")
+    order, sorted_ids, slot, uniq, counts = _grouped_rows(eids, seed)
+
+    if uniq.size == 0:
+        # no rows with a known entity: one all-masked bucket so callers
+        # (initial_params, update) keep working, like the global builder
+        empty_idx = np.asarray([], np.int64)
+        return BucketedRandomEffectDesign(
+            buckets=[
+                _fill_design(
+                    data, shard, empty_idx, empty_idx, empty_idx,
+                    np.asarray([]), entity_multiple, 1, dtype,
+                )
+            ],
+            entity_index=[
+                np.full(entity_multiple, num_entities, np.int32)
+            ],
+            num_entities=num_entities,
+        )
+
+    # per-entity active cap under the bucket policy
+    by_count = np.argsort(counts, kind="stable")
+    splits = _split_minimizing_padding(counts[by_count], num_buckets)
+    splits = [by_count[lo:hi] for lo, hi in splits]
+
+    cap_of_entity = np.zeros(num_entities, np.int64)
+    bucket_of_entity = np.full(num_entities, -1, np.int64)
+    local_of_entity = np.zeros(num_entities, np.int64)
+    bucket_caps = []
+    bucket_entities = []
+    for b, split in enumerate(splits):
+        ents = uniq[split]
+        cmax = int(counts[split].max())
+        cap_b = min(cmax, active_cap) if active_cap is not None else cmax
+        bucket_caps.append(cap_b)
+        bucket_entities.append(ents)
+        cap_of_entity[ents] = np.minimum(counts[split], cap_b)
+        bucket_of_entity[ents] = b
+        local_of_entity[ents] = np.arange(ents.size)
+
+    keep = slot < cap_of_entity[sorted_ids]
+    full_count = np.zeros(num_entities, np.int64)
+    full_count[uniq] = counts
+    rescale_of_entity = np.where(
+        full_count > cap_of_entity,
+        full_count / np.maximum(cap_of_entity, 1),
+        1.0,
+    )
+
+    rows = order[keep]
+    ents = sorted_ids[keep]
+    slots = slot[keep]
+
+    buckets = []
+    entity_index = []
+    for b, (cap_b, ents_b) in enumerate(zip(bucket_caps, bucket_entities)):
+        sel = bucket_of_entity[ents] == b
+        e_pad = -(-ents_b.size // entity_multiple) * entity_multiple
+        buckets.append(
+            _fill_design(
+                data,
+                shard,
+                rows[sel],
+                local_of_entity[ents[sel]],
+                slots[sel],
+                rescale_of_entity[ents[sel]],
+                e_pad,
+                cap_b,
+                dtype,
+            )
+        )
+        idx = np.full(e_pad, num_entities, np.int64)
+        idx[: ents_b.size] = ents_b
+        entity_index.append(np.asarray(idx, np.int32))
+
+    return BucketedRandomEffectDesign(
+        buckets=buckets, entity_index=entity_index, num_entities=num_entities
     )
 
 

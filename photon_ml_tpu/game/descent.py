@@ -162,8 +162,10 @@ class CoordinateDescent:
                         coordinate=name,
                         objective=obj,
                         seconds=time.perf_counter() - t0,
-                        solver_iterations=float(
-                            np.mean(np.asarray(result.iterations))
+                        solver_iterations=(
+                            float(np.mean(np.asarray(result.iterations)))
+                            if np.asarray(result.iterations).size
+                            else 0.0
                         ),
                         convergence_histogram=hist,
                     )

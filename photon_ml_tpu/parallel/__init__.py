@@ -16,9 +16,13 @@ Here the backend is a ``jax.sharding.Mesh`` with XLA collectives over ICI:
 from photon_ml_tpu.parallel.mesh import (
     batch_sharding,
     default_mesh,
+    entity_sharding,
+    make_game_mesh,
     make_mesh,
     replicated,
     shard_batch,
+    shard_bucketed_design,
+    shard_design,
 )
 from photon_ml_tpu.parallel.distributed import (
     distributed_train_glm,
@@ -27,10 +31,14 @@ from photon_ml_tpu.parallel.distributed import (
 
 __all__ = [
     "make_mesh",
+    "make_game_mesh",
     "default_mesh",
     "batch_sharding",
+    "entity_sharding",
     "replicated",
     "shard_batch",
+    "shard_design",
+    "shard_bucketed_design",
     "distributed_train_glm",
     "shard_map_value_and_grad",
 ]

@@ -16,6 +16,7 @@ import dataclasses
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -55,18 +56,61 @@ def default_mesh() -> Mesh:
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
-    """Shard the leading (row) axis over 'data'; replicate the rest."""
-    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+    """Shard the leading (row) axis over ALL mesh axes flattened; replicate
+    the rest. On a 1D mesh that is plain 'data' sharding; on a GAME
+    ('data', 'entity') mesh the fixed-effect batch still uses every device
+    (the random-effect phase re-views the same devices entity-wise)."""
+    return NamedSharding(
+        mesh, P(tuple(mesh.axis_names), *([None] * (ndim - 1)))
+    )
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def entity_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (entity) axis over 'entity'; replicate the rest."""
+    return NamedSharding(mesh, P(ENTITY_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_design(design, mesh: Mesh):
+    """Place a RandomEffectDesign entity-sharded over the 'entity' axis.
+    The entity count must divide evenly (build with
+    entity_multiple=mesh.shape['entity'])."""
+    n_shards = mesh.shape[ENTITY_AXIS]
+    if design.num_entities % n_shards != 0:
+        raise ValueError(
+            f"{design.num_entities} entities do not shard over "
+            f"{n_shards} 'entity' devices; pad with entity_multiple"
+        )
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, entity_sharding(mesh, np.ndim(x))),
+        design,
+    )
+
+
+def shard_bucketed_design(design, mesh: Mesh):
+    """Entity-shard every bucket of a BucketedRandomEffectDesign (and its
+    lane->table index vectors). Returns a new container; the global
+    coefficient table stays wherever the caller put it (usually
+    replicated — scatters from sharded lanes insert the collectives)."""
+    import dataclasses as _dc
+
+    return _dc.replace(
+        design,
+        buckets=[shard_design(b, mesh) for b in design.buckets],
+        entity_index=[
+            jax.device_put(jnp.asarray(ei), entity_sharding(mesh, 1))
+            for ei in design.entity_index
+        ],
+    )
+
+
 def shard_batch(batch: LabeledBatch, mesh: Mesh) -> LabeledBatch:
-    """Place a batch row-sharded over the 'data' axis (pads rows to a
-    multiple of the axis size first — padding is masked, so invisible)."""
-    n_shards = mesh.shape[DATA_AXIS]
+    """Place a batch row-sharded over all mesh axes (pads rows to a
+    multiple of the device count first — padding is masked, so invisible)."""
+    n_shards = mesh.devices.size
     n = batch.batch_size
     padded = LabeledBatch.pad_to(batch, ((n + n_shards - 1) // n_shards) * n_shards)
     return jax.tree_util.tree_map(

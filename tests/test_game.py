@@ -200,6 +200,138 @@ class TestRandomEffectDesign:
                 assert got[e, r] == ri[e, r]
 
 
+def make_skewed_data(rng, counts, d=3):
+    """GameData whose entity e has counts[e] rows — entity-size skew."""
+    user = np.repeat(np.arange(len(counts)), counts)
+    n = user.size
+    x = rng.normal(size=(n, d))
+    w_user = rng.normal(size=(len(counts), d))
+    margin = np.einsum("nd,nd->n", x, w_user[user])
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(float)
+    return GameData.create(
+        features={"s": x}, labels=y, entity_ids={"u": user}
+    )
+
+
+class TestBucketedDesign:
+    COUNTS = [1, 2, 2, 3, 5, 8, 9, 40]  # one hot entity
+
+    def make_coord(self, data, design, cfg=None):
+        cfg = cfg or CoordinateConfig(
+            shard="s",
+            random_effect="u",
+            reg_weight=0.5,
+            max_iters=30,
+            tolerance=1e-10,
+        )
+        return RandomEffectCoordinate(
+            design=design,
+            row_features=jnp.asarray(data.features["s"], jnp.float64),
+            row_entities=jnp.asarray(data.entity_ids["u"]),
+            full_offsets_base=jnp.zeros(data.num_rows),
+            config=cfg,
+        )
+
+    def test_bucketed_solution_matches_global_cap_design(self, rng):
+        from photon_ml_tpu.game import build_bucketed_random_effect_design
+
+        counts = self.COUNTS
+        data = make_skewed_data(rng, counts)
+        E = len(counts)
+        global_design = build_random_effect_design(
+            data, "u", "s", E, dtype=jnp.float64
+        )
+        bucketed = build_bucketed_random_effect_design(
+            data, "u", "s", E, num_buckets=3, dtype=jnp.float64
+        )
+        c1 = self.make_coord(data, global_design)
+        c2 = self.make_coord(data, bucketed)
+        t1, s1 = c1.update(c1.initial_params(), jnp.zeros(data.num_rows))
+        t2, s2 = c2.update(c2.initial_params(), jnp.zeros(data.num_rows))
+        # identical per-entity subproblems (no cap -> no sampling) so the
+        # solutions must agree to solver tolerance
+        np.testing.assert_allclose(
+            np.asarray(t1), np.asarray(t2), atol=1e-6
+        )
+        assert s2.reason.shape == (E,)
+        # scores through either table agree
+        np.testing.assert_allclose(
+            np.asarray(c1.score(t1)), np.asarray(c2.score(t2)), atol=1e-5
+        )
+
+    def test_bucketing_cuts_padded_waste_on_skew(self, rng):
+        from photon_ml_tpu.game import build_bucketed_random_effect_design
+
+        counts = [1] * 40 + [2] * 30 + [5] * 8 + [200]
+        data = make_skewed_data(rng, counts)
+        E = len(counts)
+        bucketed = build_bucketed_random_effect_design(
+            data, "u", "s", E, num_buckets=4, dtype=jnp.float64
+        )
+        global_slots = E * max(counts)
+        assert bucketed.active_slots < global_slots / 10
+        # every row is in exactly one active slot
+        total_rows = sum(
+            int(np.asarray(b.mask).sum()) for b in bucketed.buckets
+        )
+        assert total_rows == sum(counts)
+
+    def test_entity_multiple_pads_with_sentinels(self, rng):
+        from photon_ml_tpu.game import build_bucketed_random_effect_design
+
+        counts = [3, 4, 5, 6, 7]
+        data = make_skewed_data(rng, counts)
+        E = len(counts)
+        bucketed = build_bucketed_random_effect_design(
+            data, "u", "s", E, num_buckets=2, entity_multiple=4,
+            dtype=jnp.float64,
+        )
+        seen = []
+        for b, ei in zip(bucketed.buckets, bucketed.entity_index):
+            assert b.num_entities % 4 == 0
+            assert ei.shape[0] == b.num_entities
+            real = ei[ei < E]
+            pad = ei[ei >= E]
+            assert np.all(pad == E)
+            seen.extend(real.tolist())
+        assert sorted(seen) == list(range(E))
+
+    def test_all_unknown_entities_degrades_gracefully(self, rng):
+        from photon_ml_tpu.game import build_bucketed_random_effect_design
+
+        data = make_skewed_data(rng, [3, 4])
+        data.entity_ids["u"][:] = -1  # nothing attributable
+        bucketed = build_bucketed_random_effect_design(
+            data, "u", "s", 2, num_buckets=2, dtype=jnp.float64
+        )
+        coord = self.make_coord(data, bucketed)
+        table = coord.initial_params()
+        assert table.shape == (2, 3)
+        new_table, summary = coord.update(table, jnp.zeros(data.num_rows))
+        np.testing.assert_array_equal(np.asarray(new_table), 0.0)
+        assert summary.reason.size == 0
+
+    def test_bucketed_active_cap_preserves_weight(self, rng):
+        from photon_ml_tpu.game import build_bucketed_random_effect_design
+
+        counts = [2, 3, 20, 30]
+        data = make_skewed_data(rng, counts)
+        E = len(counts)
+        bucketed = build_bucketed_random_effect_design(
+            data, "u", "s", E, num_buckets=2, active_cap=10,
+            dtype=jnp.float64,
+        )
+        # reconstruct per-entity total active weight through entity_index
+        totals = np.zeros(E)
+        for b, ei in zip(bucketed.buckets, bucketed.entity_index):
+            w = np.asarray(b.weights).sum(axis=1)
+            for lane, e in enumerate(np.asarray(ei)):
+                if e < E:
+                    totals[e] += w[lane]
+            assert b.rows_per_entity <= 10
+        np.testing.assert_allclose(totals, counts, rtol=1e-12)
+
+
 class TestScoring:
     def test_unknown_entity_scores_zero(self, rng):
         data, user, n_users = make_mixed_effects_data(

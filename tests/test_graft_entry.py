@@ -1,0 +1,39 @@
+"""The driver-entry contract (__graft_entry__.py) — the exact surface the
+round driver checks: entry() must jit-compile single-chip, and
+dryrun_multichip(n) must build an n-device mesh and run one full sharded
+GAME training pass through the real library stack."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+import __graft_entry__ as graft
+
+
+class TestEntry:
+    def test_entry_compiles_and_runs(self):
+        fn, args = graft.entry()
+        out = jax.jit(fn)(*args)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_entry_args_are_jax_friendly(self):
+        _, args = graft.entry()
+        for a in args:
+            assert isinstance(a, jax.Array)
+
+
+class TestDryrunMultichip:
+    def test_dryrun_8_devices(self, devices, capsys):
+        # conftest provisioned 8 virtual CPU devices, so the in-process
+        # path (the one the round driver exercises) runs directly.
+        graft.dryrun_multichip(8)
+        assert "dryrun_multichip ok" in capsys.readouterr().out
+
+    def test_dryrun_odd_device_count(self, devices, capsys):
+        graft.dryrun_multichip(5)  # 1D fallback mesh (no even split)
+        assert "dryrun_multichip ok" in capsys.readouterr().out
