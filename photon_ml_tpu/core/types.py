@@ -38,8 +38,9 @@ class LabeledBatch:
 
     Fields mirror the reference ``LabeledPoint`` (``data/LabeledPoint.scala:29``)
     column-wise:
-      features: (n, d) dense design matrix (sparse inputs are densified or
-                hash-bucketed at ingest; CSR batches live in ops/sparse.py)
+      features: (n, d) dense design matrix, or an ``ops.sparse.SparseFeatures``
+                padded-ELL container for wide feature spaces — every kernel
+                dispatches on the representation
       labels:   (n,) response
       offsets:  (n,) fixed per-example margin added to x.w (GAME residual trick)
       weights:  (n,) importance weights
@@ -82,7 +83,14 @@ class LabeledBatch:
         mask=None,
         dtype=jnp.float32,
     ) -> "LabeledBatch":
-        features = jnp.asarray(features, dtype)
+        from photon_ml_tpu.ops.sparse import is_sparse
+
+        if is_sparse(features):
+            features = dataclasses.replace(
+                features, values=jnp.asarray(features.values, dtype)
+            )
+        else:
+            features = jnp.asarray(features, dtype)
         n = features.shape[-2]
         labels = jnp.asarray(labels, dtype)
         offsets = jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype)
@@ -93,6 +101,8 @@ class LabeledBatch:
     @staticmethod
     def pad_to(batch: "LabeledBatch", n: int) -> "LabeledBatch":
         """Pad a batch to `n` rows with masked (invisible) rows."""
+        from photon_ml_tpu.ops import sparse as sparse_ops
+
         cur = batch.batch_size
         if cur == n:
             return batch
@@ -104,8 +114,13 @@ class LabeledBatch:
             widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
             return jnp.pad(x, widths)
 
+        features = (
+            sparse_ops.pad_rows(batch.features, pad)
+            if sparse_ops.is_sparse(batch.features)
+            else pad_rows(batch.features)
+        )
         return LabeledBatch(
-            features=pad_rows(batch.features),
+            features=features,
             labels=pad_rows(batch.labels),
             offsets=pad_rows(batch.offsets),
             weights=pad_rows(batch.weights),

@@ -31,10 +31,17 @@ class DataValidationType(enum.Enum):
 
 def _row_checks(batch: LabeledBatch, task: TaskType) -> Dict[str, jax.Array]:
     """Per-check boolean (n,) arrays; True = row VIOLATES the check."""
+    from photon_ml_tpu.ops.sparse import is_sparse
+
     m = batch.mask > 0
+    x = batch.features
+    if is_sparse(x):
+        # only stored slots can be non-finite; padding slots hold 0.0
+        feats_finite = jnp.all(jnp.isfinite(x.values), axis=-1)
+    else:
+        feats_finite = jnp.all(jnp.isfinite(x), axis=-1)
     checks = {
-        "finite_features": m
-        & ~jnp.all(jnp.isfinite(batch.features), axis=-1),
+        "finite_features": m & ~feats_finite,
         "finite_label": m & ~jnp.isfinite(batch.labels),
         "finite_offset": m & ~jnp.isfinite(batch.offsets),
         "finite_weight": m & ~jnp.isfinite(batch.weights),

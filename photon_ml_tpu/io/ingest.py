@@ -1,12 +1,14 @@
-"""Ingest: Avro training records -> dense columnar arrays / LabeledBatch.
+"""Ingest: Avro training records -> columnar arrays / LabeledBatch.
 
 Rebuild of ``io/GLMSuite.readLabeledPointsFromAvro`` (``GLMSuite.scala:96-353``)
 and the GAME-side ``avro/data/DataProcessingUtils.getGameDataSetFromGenericRecords``
 (``DataProcessingUtils.scala:34-131``): sparse (name, term, value) feature
 lists are indexed against a vocabulary, duplicate (name, term) entries in
-one record are summed (:70-76 dedup-by-sum), the intercept column is set to
-1, and rows land in a dense float matrix (the TPU-side representation —
-sparse CSR batches are a later optimization documented in SURVEY §7).
+one record are summed (:70-76 dedup-by-sum), and the intercept column is
+set to 1. Rows land either in a dense float matrix (narrow feature spaces)
+or, with ``sparse=True``, in a padded-ELL ``ops.sparse.SparseFeatures``
+container — the representation for the reference's >200k-feature regime
+(``util/PalDBIndexMap.scala:43``) where densifying is infeasible.
 """
 
 from __future__ import annotations
@@ -19,25 +21,27 @@ from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
 
 
-def training_examples_to_arrays(
-    records: List[dict],
-    vocab: FeatureVocabulary,
-) -> Dict[str, np.ndarray]:
-    """TrainingExampleAvro dicts -> dense columnar arrays.
+def _scalar_columns_and_triplets(
+    records: List[dict], vocab: FeatureVocabulary
+):
+    """Shared record walk for both representations.
 
-    Returns {features (n,d), labels, offsets, weights, uids}. Features not
-    in the vocabulary are skipped (the reference drops them the same way);
-    the intercept column (if the vocabulary has one) is set to 1.0.
+    Returns ({labels, offsets, weights, uids}, (rows, cols, vals)) where
+    the COO triplets carry dedup-by-sum-able entries: features not in the
+    vocabulary are skipped (the reference drops them the same way), raw
+    features aliasing the intercept key are ignored, and the intercept
+    column (if the vocabulary has one) appears exactly once per row with
+    value 1.0.
     """
     n = len(records)
-    d = len(vocab)
-    x = np.zeros((n, d), np.float64)
     labels = np.zeros(n, np.float64)
     offsets = np.zeros(n, np.float64)
     weights = np.ones(n, np.float64)
     uids: List[Optional[str]] = []
     icpt = vocab.intercept_index
-
+    rows: List[int] = []
+    cols: List[int] = []
+    vals: List[float] = []
     for i, rec in enumerate(records):
         labels[i] = rec["label"]
         if rec.get("offset") is not None:
@@ -47,27 +51,86 @@ def training_examples_to_arrays(
         uids.append(rec.get("uid"))
         for f in rec["features"]:
             j = vocab.key_to_index.get(feature_key(f["name"], f["term"]))
-            if j is not None:
-                x[i, j] += f["value"]  # dedup-by-sum semantics
+            if j is not None and j != icpt:
+                rows.append(i)
+                cols.append(j)
+                vals.append(f["value"])
         if icpt is not None:
-            x[i, icpt] = 1.0
-
-    return {
-        "features": x,
+            rows.append(i)
+            cols.append(icpt)
+            vals.append(1.0)
+    columns = {
         "labels": labels,
         "offsets": offsets,
         "weights": weights,
         "uids": np.asarray(uids, object),
     }
+    return columns, (np.asarray(rows), np.asarray(cols), np.asarray(vals))
+
+
+def training_examples_to_arrays(
+    records: List[dict],
+    vocab: FeatureVocabulary,
+) -> Dict[str, np.ndarray]:
+    """TrainingExampleAvro dicts -> dense columnar arrays.
+
+    Returns {features (n,d), labels, offsets, weights, uids}; duplicate
+    (name, term) entries in one record sum (dedup-by-sum semantics).
+    """
+    columns, (rows, cols, vals) = _scalar_columns_and_triplets(records, vocab)
+    x = np.zeros((len(records), len(vocab)), np.float64)
+    np.add.at(x, (rows.astype(np.int64), cols.astype(np.int64)), vals)
+    return {"features": x, **columns}
+
+
+def training_examples_to_sparse(
+    records: List[dict],
+    vocab: FeatureVocabulary,
+    nnz_per_row: int = 0,
+    dtype=None,
+):
+    """TrainingExampleAvro dicts -> (SparseFeatures, columns dict).
+
+    Same semantics as :func:`training_examples_to_arrays` (vocabulary
+    filter, dedup-by-sum, intercept injection) without ever materializing
+    the (n, d) matrix."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.sparse import from_coo
+
+    columns, (rows, cols, vals) = _scalar_columns_and_triplets(records, vocab)
+    features = from_coo(
+        rows,
+        cols,
+        vals,
+        len(records),
+        len(vocab),
+        nnz_per_row=nnz_per_row,
+        dtype=dtype or jnp.float32,
+    )
+    return features, columns
 
 
 def labeled_batch_from_avro(
     records: List[dict],
     vocab: FeatureVocabulary,
     dtype=None,
+    sparse: bool = False,
+    nnz_per_row: int = 0,
 ) -> LabeledBatch:
     import jax.numpy as jnp
 
+    if sparse:
+        features, cols = training_examples_to_sparse(
+            records, vocab, nnz_per_row=nnz_per_row, dtype=dtype or jnp.float32
+        )
+        return LabeledBatch.create(
+            features,
+            cols["labels"],
+            offsets=cols["offsets"],
+            weights=cols["weights"],
+            dtype=dtype or jnp.float32,
+        )
     cols = training_examples_to_arrays(records, vocab)
     return LabeledBatch.create(
         cols["features"],

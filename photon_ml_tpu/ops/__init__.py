@@ -7,10 +7,13 @@ from photon_ml_tpu.ops.losses import (
     loss_for_task,
 )
 from photon_ml_tpu.ops.objective import GLMObjective, RegularizationContext
+from photon_ml_tpu.ops.sparse import SparseFeatures
 from photon_ml_tpu.ops.stats import BasicStatisticalSummary, summarize_features
-from photon_ml_tpu.ops import metrics
+from photon_ml_tpu.ops import metrics, sparse
 
 __all__ = [
+    "SparseFeatures",
+    "sparse",
     "RegularizationContext",
     "BasicStatisticalSummary",
     "summarize_features",

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.core.normalization import NormalizationContext, no_normalization
 from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.ops.sparse import colsum, matvec, rmatvec
 
 
 def _maybe_psum(x, axis_name):
@@ -118,15 +119,16 @@ class GLMObjective:
         return self._dmargin_dot(w, batch) + batch.offsets
 
     def _dmargin_dot(self, v: jax.Array, batch: LabeledBatch) -> jax.Array:
-        """(d margin / d w) @ v for each row — normalized-feature dot."""
+        """(d margin / d w) @ v for each row — normalized-feature dot.
+        Dispatches dense (MXU matmul) / sparse ELL (gather kernel)."""
         norm = self.normalization
         eff = norm.effective_coefficients(v)
-        return batch.features @ eff + norm.margin_shift(v)
+        return matvec(batch.features, eff) + norm.margin_shift(v)
 
     def _backproject(self, a: jax.Array, batch: LabeledBatch) -> jax.Array:
         """X'^T @ a where X' is the (virtually) normalized design matrix."""
         norm = self.normalization
-        g = batch.features.T @ a
+        g = rmatvec(batch.features, a)
         if norm.factors is not None:
             g = g * norm.factors
         if norm.shifts is not None:
@@ -184,9 +186,9 @@ class GLMObjective:
         z = self.margins(w, batch)
         c = batch.effective_weights() * self.loss.d2(z, batch.labels)  # (n,)
         x = batch.features
-        d_x2 = jnp.einsum("n,nd->d", c, x * x)
+        d_x2 = colsum(x, c, square=True)
         if norm.shifts is not None:
-            d_x = jnp.einsum("n,nd->d", c, x)
+            d_x = colsum(x, c)
             s = norm.shifts
             diag = d_x2 - 2.0 * s * d_x + s * s * jnp.sum(c)
         else:

@@ -1,0 +1,200 @@
+"""Sparse (wide) feature batches: padded row-wise (ELL) format + kernels.
+
+The reference reaches >200k-feature spaces with Breeze sparse vectors and an
+off-heap feature index (``util/PalDBIndexMap.scala:43``; tree-aggregation
+depth bumps above 200k features, ``cli/game/training/Driver.scala:336-341``).
+On TPU, CSR's ragged rows are hostile to XLA's static shapes, so the batch
+format here is ELL: every row holds up to ``k`` (column, value) pairs, padded
+with column id ``d`` (one past the last feature) and value 0 — padding is
+algebraically invisible because gathers fill 0 and scatters drop
+out-of-bounds ids. The three kernels below are exactly the contractions the
+dense objective needs (margins, gradient back-projection, Hessian diagonal),
+so ``GLMObjective`` runs unchanged on either representation:
+
+    matvec:   z_i = sum_k v_ik * w[c_ik]              (gather + row reduce)
+    rmatvec:  g_j = sum_{ik: c_ik=j} v_ik * a_i       (scatter-add)
+    colsum:   s_j = sum_{ik: c_ik=j} f(v_ik) * c_i    (scatter-add)
+
+All are single XLA ops (gather / scatter-add) that shard cleanly over the
+'data' mesh axis: indices/values are row-leading, so batch sharding and the
+psum-reduced partials work exactly as for dense features.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseFeatures:
+    """(n, k) padded sparse design matrix with static width ``d``.
+
+    indices: (n, k) int32 column ids; padding slots hold ``d`` (out of
+             bounds — gather-fills 0.0, scatter-drops).
+    values:  (n, k) float payloads; padding slots hold 0.0.
+    d:       number of feature columns (static aux data, not a leaf).
+    """
+
+    indices: jax.Array
+    values: jax.Array
+    d: int
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.indices.shape[-2], self.d)
+
+    @property
+    def ndim(self) -> int:  # row-leading container, like a (n, d) matrix
+        return 2
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz_per_row(self) -> int:
+        return self.indices.shape[-1]
+
+    def __matmul__(self, w: jax.Array) -> jax.Array:
+        return matvec(self, w)
+
+
+def _flatten(sf: SparseFeatures):
+    return (sf.indices, sf.values), sf.d
+
+
+def _unflatten(d, children):
+    return SparseFeatures(indices=children[0], values=children[1], d=d)
+
+
+jax.tree_util.register_pytree_node(SparseFeatures, _flatten, _unflatten)
+
+
+# -- kernels (dispatch on representation) -----------------------------------
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseFeatures)
+
+
+def matvec(x, w: jax.Array) -> jax.Array:
+    """margins contraction: (n, d) @ (d,) -> (n,)."""
+    if not is_sparse(x):
+        return x @ w
+    gathered = w.at[x.indices].get(mode="fill", fill_value=0.0)
+    return jnp.sum(x.values * gathered, axis=-1)
+
+
+def rmatvec(x, a: jax.Array) -> jax.Array:
+    """gradient back-projection: (n, d)^T @ (n,) -> (d,)."""
+    if not is_sparse(x):
+        return x.T @ a
+    upd = (x.values * a[..., None]).reshape(-1)
+    return (
+        jnp.zeros((x.d,), upd.dtype)
+        .at[x.indices.reshape(-1)]
+        .add(upd, mode="drop")
+    )
+
+
+def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
+    """sum_i c_i * x_ij (or x_ij^2) -> (d,): the Hessian-diagonal sums."""
+    if not is_sparse(x):
+        v = x * x if square else x
+        return jnp.einsum("n,nd->d", c, v)
+    v = x.values * x.values if square else x.values
+    upd = (v * c[..., None]).reshape(-1)
+    return (
+        jnp.zeros((x.d,), upd.dtype)
+        .at[x.indices.reshape(-1)]
+        .add(upd, mode="drop")
+    )
+
+
+def pad_rows(sf: SparseFeatures, pad: int) -> SparseFeatures:
+    """Append `pad` all-padding rows (index d, value 0), preserving the
+    padding invariant that plain zero-padding would break."""
+    return SparseFeatures(
+        indices=jnp.pad(sf.indices, ((0, pad), (0, 0)), constant_values=sf.d),
+        values=jnp.pad(sf.values, ((0, pad), (0, 0))),
+        d=sf.d,
+    )
+
+
+def row_density(x) -> jax.Array:
+    """Per-row stored-entry count (diagnostic)."""
+    if not is_sparse(x):
+        return jnp.sum(x != 0, axis=-1)
+    return jnp.sum(x.indices < x.d, axis=-1)
+
+
+# -- construction ------------------------------------------------------------
+
+
+def from_coo(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    num_cols: int,
+    nnz_per_row: int = 0,
+    dtype=jnp.float32,
+) -> SparseFeatures:
+    """Build from COO triplets (host-side). Duplicate (row, col) entries are
+    summed (the reference's dedup-by-sum, ``DataProcessingUtils.scala:70-76``).
+    ``nnz_per_row`` pads/caps the row width; 0 means the max observed."""
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals, np.float64)
+    # dedup-by-sum on (row, col)
+    flat = rows * num_cols + cols
+    uniq, inv = np.unique(flat, return_inverse=True)
+    summed = np.zeros(uniq.size, np.float64)
+    np.add.at(summed, inv, vals)
+    r = (uniq // num_cols).astype(np.int64)
+    c = (uniq % num_cols).astype(np.int64)
+    counts = np.bincount(r, minlength=num_rows)
+    k = int(counts.max()) if counts.size and counts.max() > 0 else 1
+    if nnz_per_row:
+        if k > nnz_per_row:
+            raise ValueError(
+                f"a row has {k} entries, above nnz_per_row={nnz_per_row}"
+            )
+        k = nnz_per_row
+    indices = np.full((num_rows, k), num_cols, np.int64)
+    values = np.zeros((num_rows, k), np.float64)
+    # slot of each entry within its row (entries are sorted by flat id)
+    starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    slot = np.arange(uniq.size) - starts[r]
+    indices[r, slot] = c
+    values[r, slot] = summed
+    return SparseFeatures(
+        indices=jnp.asarray(indices, jnp.int32),
+        values=jnp.asarray(values, dtype),
+        d=num_cols,
+    )
+
+
+def from_dense(x: np.ndarray, nnz_per_row: int = 0, dtype=jnp.float32) -> SparseFeatures:
+    """Sparsify a dense matrix (testing / oracles)."""
+    x = np.asarray(x)
+    r, c = np.nonzero(x)
+    return from_coo(
+        r, c, x[r, c], x.shape[0], x.shape[1], nnz_per_row, dtype
+    )
+
+
+def to_dense(sf: SparseFeatures) -> np.ndarray:
+    """Densify (small problems / tests only)."""
+    ind = np.asarray(sf.indices)
+    val = np.asarray(sf.values)
+    n, k = ind.shape
+    out = np.zeros((n, sf.d), val.dtype)
+    keep = ind < sf.d
+    np.add.at(out, (np.repeat(np.arange(n), k)[keep.reshape(-1)], ind[keep]), val[keep])
+    return out

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.core.types import LabeledBatch, _pytree_dataclass
+from photon_ml_tpu.ops import sparse as sparse_ops
 
 
 @_pytree_dataclass
@@ -49,8 +50,12 @@ class BasicStatisticalSummary:
 def summarize_features(
     batch: LabeledBatch, axis_name: Optional[str] = None
 ) -> BasicStatisticalSummary:
-    """Single-pass masked column statistics (unweighted rows, like colStats)."""
+    """Single-pass masked column statistics (unweighted rows, like colStats).
+    Sparse batches take the scatter-kernel path (implicit zeros included in
+    every statistic, matching the dense semantics)."""
     x = batch.features
+    if sparse_ops.is_sparse(x):
+        return _summarize_sparse(batch, axis_name)
     m = batch.mask[:, None]
     # where (not *): padding rows may legitimately hold NaN/Inf (validators
     # exempt masked rows) and NaN * 0 would poison every sum
@@ -94,3 +99,71 @@ def _psum_min(v, axis_name):
 
 def _psum_max(v, axis_name):
     return jax.lax.pmax(v, axis_name) if axis_name is not None else v
+
+
+def _summarize_sparse(
+    batch: LabeledBatch, axis_name: Optional[str] = None
+) -> BasicStatisticalSummary:
+    """Column statistics over a padded-ELL sparse design without
+    densifying: sums/moments via scatter-add, min/max via scatter-min/max
+    corrected for each column's implicit zeros (a column stored in fewer
+    unmasked rows than exist contains zeros, exactly as a dense matrix
+    would)."""
+    import dataclasses
+
+    x = batch.features
+    d = x.d
+    m = batch.mask
+    dtype = x.values.dtype
+
+    def _psum(v):
+        return jax.lax.psum(v, axis_name) if axis_name is not None else v
+
+    n = _psum(jnp.sum(m))
+    s1 = _psum(sparse_ops.colsum(x, m))
+    s2 = _psum(sparse_ops.colsum(x, m, square=True))
+    absx = dataclasses.replace(x, values=jnp.abs(x.values))
+    sabs = _psum(sparse_ops.colsum(absx, m))
+    nzx = dataclasses.replace(x, values=(x.values != 0.0).astype(dtype))
+    nnz = _psum(sparse_ops.colsum(nzx, m))
+    # stored-slot count per column (for implicit-zero detection); padding
+    # slots carry index d, so the all-ones payload scatter-drops them
+    onesx = dataclasses.replace(x, values=jnp.ones_like(x.values))
+    stored = _psum(sparse_ops.colsum(onesx, m))
+
+    big = jnp.asarray(jnp.inf, dtype)
+    entry_ok = (x.indices < d) & (m[:, None] > 0)
+    flat_idx = jnp.where(entry_ok, x.indices, d).reshape(-1)
+    mn_stored = (
+        jnp.full((d,), big)
+        .at[flat_idx]
+        .min(jnp.where(entry_ok, x.values, big).reshape(-1), mode="drop")
+    )
+    mx_stored = (
+        jnp.full((d,), -big)
+        .at[flat_idx]
+        .max(jnp.where(entry_ok, x.values, -big).reshape(-1), mode="drop")
+    )
+    mn_stored = _psum_min(mn_stored, axis_name)
+    mx_stored = _psum_max(mx_stored, axis_name)
+    has_zero = stored < n  # some unmasked row lacks a stored entry
+    mn = jnp.where(has_zero, jnp.minimum(mn_stored, 0.0), mn_stored)
+    mx = jnp.where(has_zero, jnp.maximum(mx_stored, 0.0), mx_stored)
+    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    var = (s2 - n * mean * mean) / jnp.maximum(n - 1.0, 1.0)
+    var = jnp.where(jnp.isfinite(var) & (var > 0.0), var, 0.0)
+    return BasicStatisticalSummary(
+        mean=mean,
+        variance=var,
+        count=n,
+        min=mn,
+        max=mx,
+        norm_l1=sabs,
+        norm_l2=jnp.sqrt(s2),
+        mean_abs=sabs / safe_n,
+        num_nonzeros=nnz,
+    )
