@@ -1,0 +1,23 @@
+"""Drivers / CLI (the reference's L7): staged GLM training, GAME training
+with grid sweeps, and scoring — the product surface over the library
+(``Driver.scala``, ``cli/game/training/Driver.scala``,
+``cli/game/scoring/Driver.scala``)."""
+
+from photon_ml_tpu.cli.config import (
+    CoordinateSpec,
+    GLMDriverParams,
+    GameDriverParams,
+    ScoringParams,
+    load_params,
+)
+from photon_ml_tpu.cli.stages import DriverStage, StageTracker
+
+__all__ = [
+    "GLMDriverParams",
+    "GameDriverParams",
+    "CoordinateSpec",
+    "ScoringParams",
+    "load_params",
+    "DriverStage",
+    "StageTracker",
+]

@@ -1,0 +1,218 @@
+"""Typed driver configuration.
+
+One typed config system replacing the reference's three tiers (SURVEY §5.6):
+scopt CLI flags (``PhotonMLCmdLineParser.scala``, ``Params.scala:36-183``),
+the per-coordinate string mini-DSLs
+(``GLMOptimizationConfiguration.scala:32-80``,
+``RandomEffectDataConfiguration.scala:71-118``), and the GAME grid arrays
+(semicolon-separated configs cartesian-multiplied at
+``cli/game/training/Driver.scala:317-384``). Semantics preserved — grids,
+updating sequences, output modes — as dataclasses loadable from JSON, with
+every knob also overridable as a CLI flag by the driver mains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from photon_ml_tpu.core.normalization import NormalizationType
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.validators import DataValidationType
+from photon_ml_tpu.models.training import GLMTrainingConfig, OptimizerType
+from photon_ml_tpu.ops.objective import RegularizationContext
+
+MODEL_OUTPUT_MODES = ("ALL", "BEST", "NONE")
+
+
+@dataclasses.dataclass
+class GLMDriverParams:
+    """Core GLM train-driver knobs (``Params.scala:36-183``)."""
+
+    train_input: List[str]
+    output_dir: str
+    task: str = "LOGISTIC_REGRESSION"
+    optimizer: str = "LBFGS"
+    reg_type: str = "L2"
+    reg_weights: List[float] = dataclasses.field(default_factory=lambda: [1.0])
+    elastic_net_alpha: float = 0.5
+    normalization: str = "NONE"
+    max_iters: int = 80
+    tolerance: float = 1e-7
+    add_intercept: bool = True
+    sparse: bool = False
+    validate_input: List[str] = dataclasses.field(default_factory=list)
+    data_validation: str = "VALIDATE_FULL"
+    feature_file: Optional[str] = None  # pinned vocabulary (one key per line)
+    constraint_file: Optional[str] = None  # coefficient bounds JSON
+    date_range: Optional[str] = None  # "yyyymmdd-yyyymmdd"
+    date_range_days_ago: Optional[str] = None  # "N-M"
+    model_output_mode: str = "ALL"
+    overwrite: bool = False
+    compute_variances: bool = False
+    log_level: str = "DEBUG"
+    diagnostics: bool = False
+    # float64 matches the reference's double-precision solves; silently
+    # degrades to float32 when x64 is disabled (default on TPU backends)
+    precision: str = "float64"
+
+    def validate(self) -> None:
+        if not self.train_input:
+            raise ValueError("train_input is required")
+        if self.model_output_mode not in MODEL_OUTPUT_MODES:
+            raise ValueError(
+                f"model_output_mode must be one of {MODEL_OUTPUT_MODES}"
+            )
+        if self.date_range and self.date_range_days_ago:
+            raise ValueError(
+                "date_range and date_range_days_ago are mutually exclusive"
+            )
+        self.to_training_config().validate()
+
+    def to_training_config(self) -> GLMTrainingConfig:
+        return GLMTrainingConfig(
+            task=TaskType[self.task],
+            optimizer=OptimizerType[self.optimizer],
+            reg_weights=tuple(self.reg_weights),
+            regularization=RegularizationContext(
+                self.reg_type, alpha=self.elastic_net_alpha
+            )
+            if self.reg_type != "NONE"
+            else RegularizationContext("NONE"),
+            normalization=NormalizationType[self.normalization],
+            max_iters=self.max_iters,
+            tolerance=self.tolerance,
+            compute_variances=self.compute_variances,
+            # set by the driver once the vocabulary exists
+            intercept_index=None,
+        )
+
+
+@dataclasses.dataclass
+class CoordinateSpec:
+    """One GAME coordinate's optimization + data knobs — the typed analog
+    of "maxIter,tol,lambda,downSampleRate,optimizer,regType" plus the data
+    config DSL. ``reg_weights`` is a GRID axis: the driver trains the
+    cartesian product over all coordinates' grids
+    (``cli/game/training/Driver.scala:317-320``)."""
+
+    shard: str  # feature bag id
+    random_effect: Optional[str] = None  # metadataMap key; None = fixed
+    optimizer: str = "TRON"
+    reg_weights: List[float] = dataclasses.field(default_factory=lambda: [50.0])
+    l1_ratio: float = 0.0
+    max_iters: int = 20
+    tolerance: float = 1e-5
+    down_sampling_rate: Optional[float] = None
+    active_cap: Optional[int] = None
+    num_buckets: int = 4
+    projector: Optional[str] = None  # RANDOM=<k> | INDEX_MAP | IDENTITY
+
+
+@dataclasses.dataclass
+class GameDriverParams:
+    """GAME train-driver knobs (``cli/game/training/Params.scala:81-292``)."""
+
+    train_input: List[str]
+    output_dir: str
+    coordinates: Dict[str, CoordinateSpec]
+    updating_sequence: List[str]
+    task: str = "LOGISTIC_REGRESSION"
+    num_iterations: int = 1
+    validate_input: List[str] = dataclasses.field(default_factory=list)
+    validate_per_coordinate: bool = True
+    feature_shards: Dict[str, Optional[str]] = dataclasses.field(
+        default_factory=dict
+    )  # shard id -> feature list file (None = build from train data)
+    add_intercept: bool = True
+    date_range: Optional[str] = None
+    date_range_days_ago: Optional[str] = None
+    model_output_mode: str = "BEST"
+    overwrite: bool = False
+    log_level: str = "DEBUG"
+    precision: str = "float64"
+
+    def validate(self) -> None:
+        if not self.train_input:
+            raise ValueError("train_input is required")
+        if not self.updating_sequence:
+            raise ValueError("updating_sequence is required")
+        for name in self.updating_sequence:
+            if name not in self.coordinates:
+                raise ValueError(
+                    f"updating_sequence names unknown coordinate {name!r}"
+                )
+        if self.model_output_mode not in MODEL_OUTPUT_MODES:
+            raise ValueError(
+                f"model_output_mode must be one of {MODEL_OUTPUT_MODES}"
+            )
+        fixed = [
+            n
+            for n, c in self.coordinates.items()
+            if c.random_effect is None
+        ]
+        if len(fixed) > 1:
+            raise ValueError(
+                f"at most one fixed-effect coordinate supported, got {fixed}"
+            )
+
+    def grid(self) -> List[Dict[str, float]]:
+        """Cartesian product over each coordinate's reg-weight grid
+        (``Driver.scala:317-320``): a list of {coordinate: reg_weight}."""
+        import itertools
+
+        names = list(self.updating_sequence)
+        axes = [self.coordinates[n].reg_weights for n in names]
+        return [dict(zip(names, combo)) for combo in itertools.product(*axes)]
+
+
+@dataclasses.dataclass
+class ScoringParams:
+    """Scoring-driver knobs (``cli/game/scoring/Params.scala``)."""
+
+    input: List[str]
+    model_dir: str
+    output_dir: str
+    model_kind: str = "game"  # "glm" | "game"
+    task: str = "LOGISTIC_REGRESSION"
+    evaluate: bool = False  # requires labels in the input
+    sparse: bool = False
+    date_range: Optional[str] = None
+    date_range_days_ago: Optional[str] = None
+    overwrite: bool = False
+    log_level: str = "DEBUG"
+
+    def validate(self) -> None:
+        if not self.input:
+            raise ValueError("input is required")
+        if self.model_kind not in ("glm", "game"):
+            raise ValueError("model_kind must be 'glm' or 'game'")
+
+
+def _from_dict(cls, data: dict):
+    """Build a params dataclass from a JSON dict, with nested
+    CoordinateSpec parsing and unknown-key rejection."""
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(f"unknown {cls.__name__} keys: {sorted(unknown)}")
+    kwargs = dict(data)
+    if cls is GameDriverParams and "coordinates" in kwargs:
+        kwargs["coordinates"] = {
+            name: spec
+            if isinstance(spec, CoordinateSpec)
+            else _from_dict(CoordinateSpec, spec)
+            for name, spec in kwargs["coordinates"].items()
+        }
+    return cls(**kwargs)
+
+
+def load_params(source, cls):
+    """Load driver params from a dict or a JSON file path."""
+    if isinstance(source, cls):
+        return source
+    if isinstance(source, dict):
+        return _from_dict(cls, source)
+    with open(source) as f:
+        return _from_dict(cls, json.load(f))

@@ -1,0 +1,350 @@
+"""GAME training driver.
+
+Rebuild of ``cli/game/training/Driver.scala:47-541``: prepare per-shard
+feature maps, convert Avro records to a GAME dataset (feature bags + entity
+columns), build one coordinate per updating-sequence entry, train the
+cartesian product of the per-coordinate reg-weight grids
+(``Driver.scala:317-384``), log training objective and (optionally) a
+validation metric after every coordinate update
+(``CoordinateDescent.scala:173-189``), and save models under the
+reference's output layout with BEST/ALL selection
+(``Driver.scala:393-441``). Run as
+
+    python -m photon_ml_tpu.cli.game_train --config params.json
+
+or programmatically via :func:`run_game_training`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.cli.config import (
+    CoordinateSpec,
+    GameDriverParams,
+    load_params,
+)
+from photon_ml_tpu.cli.train import (
+    prepare_output_dir,
+    read_records,
+    resolve_date_range,
+)
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.game import (
+    CoordinateConfig,
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    GameModel,
+    RandomEffectCoordinate,
+    build_bucketed_random_effect_design,
+)
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.scoring import score_game_data
+from photon_ml_tpu.io.ingest import game_data_from_avro
+from photon_ml_tpu.io.models import save_game_model
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+from photon_ml_tpu.models.training import OptimizerType
+from photon_ml_tpu.ops import metrics as metrics_mod
+from photon_ml_tpu.utils.dates import expand_date_paths
+from photon_ml_tpu.utils.logging import PhotonLogger, timed
+
+
+def _coordinate_config(
+    name: str, spec: CoordinateSpec, task: TaskType, reg_weight: float
+) -> CoordinateConfig:
+    return CoordinateConfig(
+        shard=spec.shard,
+        task=task,
+        optimizer=OptimizerType[spec.optimizer],
+        reg_weight=reg_weight,
+        l1_ratio=spec.l1_ratio,
+        max_iters=spec.max_iters,
+        tolerance=spec.tolerance,
+        down_sampling_rate=spec.down_sampling_rate,
+        random_effect=spec.random_effect,
+        active_cap=spec.active_cap,
+    )
+
+
+def build_coordinates(
+    params: GameDriverParams,
+    data: GameData,
+    task: TaskType,
+    reg_combo: Dict[str, float],
+    entity_counts: Dict[str, int],
+    dtype=jnp.float64,
+):
+    """One training coordinate per updating-sequence entry."""
+    coords = {}
+    for name in params.updating_sequence:
+        spec = params.coordinates[name]
+        cfg = _coordinate_config(name, spec, task, reg_combo[name])
+        if spec.random_effect is None:
+            coords[name] = FixedEffectCoordinate(
+                data.fixed_effect_batch(spec.shard, dtype), cfg
+            )
+        else:
+            design = build_bucketed_random_effect_design(
+                data,
+                spec.random_effect,
+                spec.shard,
+                entity_counts[spec.random_effect],
+                num_buckets=spec.num_buckets,
+                active_cap=spec.active_cap,
+                dtype=dtype,
+            )
+            coords[name] = RandomEffectCoordinate(
+                design=design,
+                row_features=jnp.asarray(data.features[spec.shard], dtype),
+                row_entities=jnp.asarray(data.entity_ids[spec.random_effect]),
+                full_offsets_base=jnp.asarray(data.offsets, dtype),
+                config=cfg,
+            )
+    return coords
+
+
+@dataclasses.dataclass
+class GameTrainingRun:
+    params: GameDriverParams
+    shard_vocabs: Dict[str, FeatureVocabulary]
+    entity_vocabs: Dict[str, dict]
+    # one entry per grid combo: (combo, model, history, validation metric)
+    sweep: List[dict]
+    best_index: int
+    output_dirs: List[str]
+
+
+def run_game_training(params) -> GameTrainingRun:
+    from photon_ml_tpu.cli.train import driver_dtype
+
+    params = load_params(params, GameDriverParams)
+    params.validate()
+    prepare_output_dir(params.output_dir, params.overwrite)
+    logger = PhotonLogger(
+        os.path.join(params.output_dir, "log-message.txt"),
+        level=params.log_level,
+    )
+    task = TaskType[params.task]
+    dtype = driver_dtype(params.precision)
+    logger.info(
+        f"GAME training driver: task={params.task} "
+        f"sequence={params.updating_sequence} iters={params.num_iterations}"
+    )
+
+    # ---- prepare feature maps + dataset ---------------------------------
+    with timed(logger, "prepare data"):
+        date_range = resolve_date_range(params)
+        records = read_records(expand_date_paths(params.train_input, date_range))
+        logger.info(f"read {len(records)} training records")
+
+        shard_ids = {
+            spec.shard for spec in params.coordinates.values()
+        }
+        shard_vocabs: Dict[str, FeatureVocabulary] = {}
+        for shard in shard_ids:
+            feature_file = params.feature_shards.get(shard)
+            if feature_file:
+                shard_vocabs[shard] = FeatureVocabulary.load(feature_file)
+            else:
+                shard_vocabs[shard] = FeatureVocabulary.from_records(
+                    records, add_intercept=params.add_intercept
+                )
+        entity_keys = sorted(
+            {
+                spec.random_effect
+                for spec in params.coordinates.values()
+                if spec.random_effect is not None
+            }
+        )
+        data, entity_vocabs, _uids = game_data_from_avro(
+            records, shard_vocabs, entity_keys
+        )
+        entity_counts = {k: len(v) for k, v in entity_vocabs.items()}
+        logger.info(
+            f"shards: { {s: len(v) for s, v in shard_vocabs.items()} } "
+            f"entities: {entity_counts}"
+        )
+
+        vdata = None
+        if params.validate_input:
+            vrecords = read_records(
+                expand_date_paths(params.validate_input, date_range)
+            )
+            vdata, _, _ = game_data_from_avro(
+                vrecords, shard_vocabs, entity_keys, entity_vocabs=entity_vocabs
+            )
+            logger.info(f"read {len(vrecords)} validation records")
+
+    # ---- grid sweep ------------------------------------------------------
+    shards_by_coord = {
+        n: params.coordinates[n].shard for n in params.updating_sequence
+    }
+    res_by_coord = {
+        n: params.coordinates[n].random_effect
+        for n in params.updating_sequence
+    }
+
+    def validation_metric(model: GameModel) -> float:
+        margins = score_game_data(
+            model.params, shards_by_coord, res_by_coord, vdata
+        ) + jnp.asarray(vdata.offsets)
+        labels = jnp.asarray(vdata.labels)
+        weights = jnp.asarray(vdata.weights)
+        if task.is_classifier:
+            return float(
+                metrics_mod.area_under_roc_curve(labels, margins, weights)
+            )
+        if task == TaskType.POISSON_REGRESSION:
+            return -float(
+                metrics_mod.total_poisson_loss(labels, margins, weights)
+            )
+        return -float(
+            metrics_mod.root_mean_squared_error(labels, margins, weights)
+        )
+
+    sweep: List[dict] = []
+    for combo in params.grid():
+        with timed(logger, f"train combo {combo}"):
+            coords = build_coordinates(
+                params, data, task, combo, entity_counts, dtype=dtype
+            )
+            cd = CoordinateDescent(
+                coordinates=coords,
+                labels=jnp.asarray(data.labels, dtype),
+                base_offsets=jnp.asarray(data.offsets, dtype),
+                weights=jnp.asarray(data.weights, dtype),
+                task=task,
+            )
+            vfn = (
+                validation_metric
+                if (vdata is not None and params.validate_per_coordinate)
+                else None
+            )
+            model, history = cd.run(
+                params.num_iterations, validation_fn=vfn
+            )
+            for h in history:
+                logger.info(
+                    f"combo={combo} iter={h.iteration} coord={h.coordinate} "
+                    f"objective={h.objective:.6g}"
+                    + (
+                        f" validation={h.validation_metric:.6g}"
+                        if h.validation_metric is not None
+                        else ""
+                    )
+                    + f" ({h.seconds:.2f}s)"
+                )
+            if vfn is not None:
+                final_metric = history[-1].validation_metric
+            elif vdata is not None:
+                final_metric = validation_metric(model)
+            else:
+                final_metric = None
+            sweep.append(
+                {
+                    "combo": combo,
+                    "model": model,
+                    "history": history,
+                    "validation_metric": final_metric,
+                }
+            )
+
+    # best = highest validation metric (metrics are oriented so higher is
+    # better); without validation data the last combo wins, like the
+    # reference's fallback
+    if vdata is not None:
+        best_index = int(
+            np.argmax([s["validation_metric"] for s in sweep])
+        )
+    else:
+        best_index = len(sweep) - 1
+    logger.info(
+        f"best combo: {sweep[best_index]['combo']} "
+        f"(validation={sweep[best_index]['validation_metric']})"
+    )
+
+    # ---- save models (``Driver.scala:393-441`` output modes) ------------
+    output_dirs: List[str] = []
+    with timed(logger, "save models"):
+        to_save: List[int] = []
+        if params.model_output_mode == "BEST":
+            to_save = [best_index]
+        elif params.model_output_mode == "ALL":
+            to_save = list(range(len(sweep)))
+        for rank, idx in enumerate(to_save):
+            entry = sweep[idx]
+            subdir = (
+                os.path.join(params.output_dir, "best")
+                if params.model_output_mode == "BEST"
+                else os.path.join(params.output_dir, "all", str(idx))
+            )
+            save_game_model(
+                subdir,
+                params={
+                    n: np.asarray(p) for n, p in entry["model"].params.items()
+                },
+                shards=shards_by_coord,
+                vocabs={
+                    n: shard_vocabs[shards_by_coord[n]]
+                    for n in params.updating_sequence
+                },
+                entity_vocabs={
+                    n: entity_vocabs[res_by_coord[n]]
+                    for n in params.updating_sequence
+                    if res_by_coord[n] is not None
+                },
+                random_effects=res_by_coord,
+                task=task,
+            )
+            with open(os.path.join(subdir, "model-spec.json"), "w") as f:
+                json.dump(
+                    {
+                        "combo": entry["combo"],
+                        "validation_metric": entry["validation_metric"],
+                        "task": params.task,
+                        "updating_sequence": params.updating_sequence,
+                    },
+                    f,
+                    indent=2,
+                )
+            output_dirs.append(subdir)
+        for shard, vocab in shard_vocabs.items():
+            vocab.save(
+                os.path.join(params.output_dir, f"feature-index-{shard}.txt")
+            )
+    logger.close()
+
+    return GameTrainingRun(
+        params=params,
+        shard_vocabs=shard_vocabs,
+        entity_vocabs=entity_vocabs,
+        sweep=sweep,
+        best_index=best_index,
+        output_dirs=output_dirs,
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli.game_train",
+        description="Train GAME (fixed + random effects) models.",
+    )
+    p.add_argument("--config", required=True, help="JSON GameDriverParams")
+    p.add_argument("--overwrite", action="store_true", default=None)
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        base = json.load(f)
+    if args.overwrite is not None:
+        base["overwrite"] = args.overwrite
+    run_game_training(base)
+
+
+if __name__ == "__main__":
+    main()

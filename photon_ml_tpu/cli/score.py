@@ -1,0 +1,245 @@
+"""Scoring driver: load a trained model, score Avro data, write ScoredItems.
+
+Rebuild of ``cli/game/scoring/Driver.scala:40-254``: load the GAME model
+directory (or a single GLM model file), convert input records, score (total
+= sum of sub-model scores + offset), write ScoringResultAvro records, and
+optionally evaluate AUC / RMSE when labels are present (:166-185). Run as
+
+    python -m photon_ml_tpu.cli.score --config params.json
+
+or programmatically via :func:`run_scoring`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.cli.config import ScoringParams, load_params
+from photon_ml_tpu.cli.train import (
+    prepare_output_dir,
+    read_records,
+    resolve_date_range,
+)
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.game.scoring import score_game_data
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.ingest import game_data_from_avro, labeled_batch_from_avro
+from photon_ml_tpu.io.models import load_game_model, load_glm_model
+from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+from photon_ml_tpu.ops import metrics as metrics_mod
+from photon_ml_tpu.utils.dates import expand_date_paths
+from photon_ml_tpu.utils.logging import PhotonLogger, timed
+
+
+@dataclasses.dataclass
+class ScoringRun:
+    params: ScoringParams
+    scores: np.ndarray
+    labels: Optional[np.ndarray]
+    metrics: Dict[str, float]
+    output_path: str
+
+
+def _resolve_game_dirs(root: str):
+    """(model_root, vocab_root): model_root holds fixed-effect/random-effect
+    subdirs — the training-output root itself, its 'best' child, or the
+    first 'all/<i>' child; vocab_root holds the feature-index-*.txt files
+    (the training-output root, walking up from model_root)."""
+
+    def has_model(d):
+        return os.path.isdir(os.path.join(d, "fixed-effect")) or os.path.isdir(
+            os.path.join(d, "random-effect")
+        )
+
+    candidates = [root, os.path.join(root, "best")]
+    all_dir = os.path.join(root, "all")
+    if os.path.isdir(all_dir):
+        candidates += [
+            os.path.join(all_dir, s) for s in sorted(os.listdir(all_dir))
+        ]
+    model_root = next((c for c in candidates if has_model(c)), None)
+    if model_root is None:
+        raise FileNotFoundError(
+            f"no GAME model (fixed-effect/random-effect dirs) under {root}"
+        )
+
+    def has_vocabs(d):
+        return any(
+            f.startswith("feature-index-") and f.endswith(".txt")
+            for f in os.listdir(d)
+        )
+
+    vocab_root = model_root
+    while not has_vocabs(vocab_root):
+        parent = os.path.dirname(vocab_root.rstrip(os.sep))
+        if not parent or parent == vocab_root:
+            raise FileNotFoundError(
+                f"no feature-index-*.txt vocab files found at or above "
+                f"{model_root}"
+            )
+        vocab_root = parent
+    return model_root, vocab_root
+
+
+def run_scoring(params) -> ScoringRun:
+    params = load_params(params, ScoringParams)
+    params.validate()
+    prepare_output_dir(params.output_dir, params.overwrite)
+    logger = PhotonLogger(
+        os.path.join(params.output_dir, "log-message.txt"),
+        level=params.log_level,
+    )
+    task = TaskType[params.task]
+    date_range = resolve_date_range(params)
+    records = read_records(expand_date_paths(params.input, date_range))
+    logger.info(f"scoring {len(records)} records with {params.model_kind} "
+                f"model from {params.model_dir}")
+
+    with timed(logger, "score"):
+        if params.model_kind == "glm":
+            vocab = FeatureVocabulary.load(
+                os.path.join(params.model_dir, "feature-index.txt")
+            )
+            model_path = os.path.join(params.model_dir, "best-model.avro")
+            if not os.path.exists(model_path):
+                mdir = os.path.join(params.model_dir, "models")
+                candidates = sorted(
+                    f for f in os.listdir(mdir) if f.endswith(".avro")
+                )
+                model_path = os.path.join(mdir, candidates[0])
+            coefficients, model_task = load_glm_model(model_path, vocab)
+            if model_task is not None:
+                task = model_task
+            batch = labeled_batch_from_avro(
+                records, vocab, sparse=params.sparse, dtype=jnp.float64
+            )
+            from photon_ml_tpu.ops.sparse import matvec
+
+            margins = (
+                matvec(batch.features, jnp.asarray(coefficients.means, jnp.float64))
+                + batch.offsets
+            )
+            labels = np.asarray(batch.labels)
+            weights = np.asarray(batch.effective_weights())
+            uids = np.asarray([r.get("uid") for r in records], object)
+        else:
+            # GAME directory layout; shard vocabs saved next to the model
+            model_root, vocab_root = _resolve_game_dirs(params.model_dir)
+            vocab_files = {
+                f[len("feature-index-"):-len(".txt")]: os.path.join(vocab_root, f)
+                for f in os.listdir(vocab_root)
+                if f.startswith("feature-index-") and f.endswith(".txt")
+            }
+            shard_vocabs = {
+                shard: FeatureVocabulary.load(path)
+                for shard, path in vocab_files.items()
+            }
+            # coordinate -> shard comes from id-info; vocabs keyed per
+            # coordinate for load_game_model
+            coord_shards: Dict[str, str] = {}
+            for kind in ("fixed-effect", "random-effect"):
+                kdir = os.path.join(model_root, kind)
+                if not os.path.isdir(kdir):
+                    continue
+                for name in os.listdir(kdir):
+                    with open(os.path.join(kdir, name, "id-info")) as f:
+                        for line in f:
+                            if line.startswith("featureShardId="):
+                                coord_shards[name] = line.strip().split("=", 1)[1]
+            coord_vocabs = {
+                name: shard_vocabs[shard]
+                for name, shard in coord_shards.items()
+            }
+            model_params, shards, random_effects, entity_vocabs = (
+                load_game_model(model_root, coord_vocabs)
+            )
+            entity_keys = sorted(
+                {re for re in random_effects.values() if re is not None}
+            )
+            # entity vocab per RE type: merge coordinate vocabs (they are
+            # keyed by coordinate in the model, by RE type in the data)
+            re_vocabs: Dict[str, dict] = {}
+            for name, re_key in random_effects.items():
+                if re_key is not None:
+                    re_vocabs.setdefault(re_key, entity_vocabs[name])
+            data, _, uids = game_data_from_avro(
+                records,
+                shard_vocabs,
+                entity_keys,
+                entity_vocabs=re_vocabs,
+            )
+            margins = (
+                score_game_data(model_params, shards, random_effects, data)
+                + jnp.asarray(data.offsets)
+            )
+            labels = np.asarray(data.labels)
+            weights = np.asarray(data.weights)
+
+        scores = np.asarray(margins, np.float64)
+
+    # ---- write ScoredItems (``ScoredItem.scala`` / scoring Driver) -------
+    out_path = os.path.join(params.output_dir, "scores", "part-00000.avro")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    has_labels = any(r.get("label") is not None for r in records)
+    score_records = [
+        {
+            "predictionScore": float(s),
+            "uid": None if u is None else str(u),
+            "label": float(l) if has_labels else None,
+            "metadataMap": None,
+        }
+        for s, u, l in zip(scores, uids, labels)
+    ]
+    write_avro_file(out_path, SCORING_RESULT_SCHEMA, score_records)
+    logger.info(f"wrote {len(score_records)} scored items to {out_path}")
+
+    # ---- optional evaluation (:166-185) ----------------------------------
+    eval_metrics: Dict[str, float] = {}
+    if params.evaluate:
+        if not has_labels:
+            raise ValueError("evaluate=True but input records carry no labels")
+        eval_metrics = metrics_mod.evaluate(
+            task,
+            jnp.asarray(labels),
+            jnp.asarray(scores),
+            jnp.asarray(weights),
+        )
+        with open(os.path.join(params.output_dir, "metrics.json"), "w") as f:
+            json.dump(eval_metrics, f, indent=2)
+        logger.info(f"evaluation: {eval_metrics}")
+    logger.close()
+
+    return ScoringRun(
+        params=params,
+        scores=scores,
+        labels=labels if has_labels else None,
+        metrics=eval_metrics,
+        output_path=out_path,
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli.score",
+        description="Score data with a trained GLM or GAME model.",
+    )
+    p.add_argument("--config", required=True, help="JSON ScoringParams")
+    p.add_argument("--overwrite", action="store_true", default=None)
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        base = json.load(f)
+    if args.overwrite is not None:
+        base["overwrite"] = args.overwrite
+    run_scoring(base)
+
+
+if __name__ == "__main__":
+    main()

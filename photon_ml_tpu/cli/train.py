@@ -1,0 +1,334 @@
+"""Core GLM training driver.
+
+Rebuild of the reference's staged train pipeline (``Driver.scala:76-570``):
+INIT (config + output guard + logger) -> PREPROCESSED (Avro ingest, feature
+indexing, data validation, feature summarization, normalization) -> TRAINED
+(descending-lambda sweep with warm starts) -> VALIDATED (named metrics per
+lambda, best-model selection) -> model/text/summary outputs. Run as
+
+    python -m photon_ml_tpu.cli.train --config params.json [--flag value ...]
+
+or programmatically via :func:`run_glm_training`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.cli.config import GLMDriverParams, load_params
+from photon_ml_tpu.cli.stages import DriverStage, StageTracker
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.core.validators import DataValidationType, sanity_check_data
+from photon_ml_tpu.io.avro import read_avro_dir, read_avro_file
+from photon_ml_tpu.io.ingest import labeled_batch_from_avro
+from photon_ml_tpu.io.models import save_glm_model
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+from photon_ml_tpu.models.selection import select_best_model
+from photon_ml_tpu.models.training import TrainedModel, train_glm
+from photon_ml_tpu.ops import metrics as metrics_mod
+from photon_ml_tpu.ops.stats import summarize_features
+from photon_ml_tpu.utils.dates import DateRange, expand_date_paths
+from photon_ml_tpu.utils.logging import PhotonLogger, timed
+
+
+def driver_dtype(precision: str):
+    """float64 when requested AND enabled; float32 otherwise (no warnings)."""
+    import jax
+    import jax.numpy as jnp
+
+    if precision == "float64" and jax.config.jax_enable_x64:
+        return jnp.float64
+    return jnp.float32
+
+
+def read_records(paths: List[str]) -> List[dict]:
+    """Read TrainingExampleAvro records from files and/or directories."""
+    records: List[dict] = []
+    for p in paths:
+        if os.path.isdir(p):
+            _, recs = read_avro_dir(p)
+        else:
+            _, recs = read_avro_file(p)
+        records.extend(recs)
+    if not records:
+        raise ValueError(f"no records found in {paths}")
+    return records
+
+
+def resolve_date_range(params) -> Optional[DateRange]:
+    if params.date_range:
+        return DateRange.from_dates(params.date_range)
+    if params.date_range_days_ago:
+        return DateRange.from_days_ago(params.date_range_days_ago)
+    return None
+
+
+def prepare_output_dir(path: str, overwrite: bool) -> None:
+    """Refuse a pre-existing output directory unless overwriting — the
+    reference's guard rail (``Driver.scala:520-526``)."""
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(
+                f"output dir {path} exists; pass overwrite to replace"
+            )
+    else:
+        os.makedirs(path)
+
+
+def write_model_text(
+    path: str, means: np.ndarray, vocab: FeatureVocabulary
+) -> None:
+    """Plain-text model (``GLMSuite.writeModelsInText``,
+    ``GLMSuite.scala:355-400``): one "name\\tterm\\tvalue" line per nonzero
+    coefficient (intercept always written)."""
+    with open(path, "w", encoding="utf-8") as f:
+        for i, v in enumerate(np.asarray(means)):
+            if v == 0.0 and i != vocab.intercept_index:
+                continue
+            name, term = vocab.name_term(i)
+            f.write(f"{name}\t{term}\t{float(v)}\n")
+
+
+def write_feature_summary(
+    path: str, summary, vocab: FeatureVocabulary
+) -> None:
+    """Per-feature summary TSV (the reference writes a feature-summary
+    output from the same statistics, ``GLMSuite.scala:402+``)."""
+    cols = ("mean", "variance", "min", "max", "norm_l1", "norm_l2",
+            "mean_abs", "num_nonzeros")
+    arrays = {c: np.asarray(getattr(summary, c)) for c in cols}
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("name\tterm\t" + "\t".join(cols) + "\n")
+        for i in range(len(vocab)):
+            name, term = vocab.name_term(i)
+            f.write(
+                f"{name}\t{term}\t"
+                + "\t".join(str(float(arrays[c][i])) for c in cols)
+                + "\n"
+            )
+
+
+@dataclasses.dataclass
+class GLMTrainingRun:
+    """Everything a caller (or test) needs to inspect a completed run."""
+
+    params: GLMDriverParams
+    stages: List[DriverStage]
+    vocab: FeatureVocabulary
+    models: List[TrainedModel]
+    best: Optional[TrainedModel]
+    best_index: Optional[int]
+    # positional, aligned with `models` (duplicate lambdas stay distinct)
+    validation_metrics: List[Dict[str, float]]
+    num_training_rows: int
+    num_features: int
+    summary: object
+
+
+def run_glm_training(params) -> GLMTrainingRun:
+    params = load_params(params, GLMDriverParams)
+    params.validate()
+    prepare_output_dir(params.output_dir, params.overwrite)
+    tracker = StageTracker()
+    logger = PhotonLogger(
+        os.path.join(params.output_dir, "log-message.txt"),
+        level=params.log_level,
+    )
+    logger.info(f"GLM training driver: task={params.task} "
+                f"optimizer={params.optimizer} reg={params.reg_type} "
+                f"lambdas={params.reg_weights}")
+
+    # ---- PREPROCESS ------------------------------------------------------
+    with timed(logger, "preprocess"):
+        date_range = resolve_date_range(params)
+        train_paths = expand_date_paths(params.train_input, date_range)
+        records = read_records(train_paths)
+        logger.info(f"read {len(records)} training records")
+
+        if params.feature_file:
+            vocab = FeatureVocabulary.load(params.feature_file)
+        else:
+            vocab = FeatureVocabulary.from_records(
+                records, add_intercept=params.add_intercept
+            )
+        logger.info(f"feature space: {len(vocab)} columns "
+                    f"(intercept={vocab.intercept_index})")
+
+        batch = labeled_batch_from_avro(
+            records, vocab, sparse=params.sparse,
+            dtype=driver_dtype(params.precision),
+        )
+        task = TaskType[params.task]
+        sanity_check_data(
+            batch, task, DataValidationType[params.data_validation]
+        )
+        summary = summarize_features(batch)
+        write_feature_summary(
+            os.path.join(params.output_dir, "feature-summary.tsv"),
+            summary,
+            vocab,
+        )
+    tracker.advance(DriverStage.PREPROCESSED)
+
+    # ---- TRAIN -----------------------------------------------------------
+    tracker.assert_at_least(DriverStage.PREPROCESSED)
+    with timed(logger, "train"):
+        cfg = dataclasses.replace(
+            params.to_training_config(),
+            intercept_index=vocab.intercept_index,
+        )
+        if params.constraint_file:
+            from photon_ml_tpu.io.constraints import load_constraint_bounds
+
+            lb, ub = load_constraint_bounds(params.constraint_file, vocab)
+            cfg = dataclasses.replace(
+                cfg, lower_bounds=lb, upper_bounds=ub
+            )
+        models = list(train_glm(batch, cfg))
+        for tm in models:
+            logger.info(
+                f"lambda={tm.reg_weight}: iters={int(tm.result.iterations)} "
+                f"value={float(tm.result.value):.6g}"
+            )
+    tracker.advance(DriverStage.TRAINED)
+
+    # ---- VALIDATE --------------------------------------------------------
+    best = None
+    best_index = None
+    validation_metrics: List[Dict[str, float]] = []
+    if params.validate_input:
+        tracker.assert_at_least(DriverStage.TRAINED)
+        with timed(logger, "validate"):
+            vrecords = read_records(
+                expand_date_paths(params.validate_input, date_range)
+            )
+            vbatch = labeled_batch_from_avro(
+                vrecords, vocab, sparse=params.sparse,
+                dtype=driver_dtype(params.precision),
+            )
+            for tm in models:
+                margins = tm.model.compute_margin(
+                    vbatch.features, vbatch.offsets
+                )
+                validation_metrics.append(
+                    metrics_mod.evaluate(
+                        task,
+                        vbatch.labels,
+                        margins,
+                        vbatch.effective_weights(),
+                    )
+                )
+            best, _scores = select_best_model(models, vbatch)
+            best_index = next(
+                i for i, tm in enumerate(models) if tm is best
+            )
+            logger.info(
+                f"best lambda={best.reg_weight} (model #{best_index}, "
+                f"metrics={validation_metrics[best_index]})"
+            )
+        tracker.advance(DriverStage.VALIDATED)
+
+    # ---- OUTPUT ----------------------------------------------------------
+    with timed(logger, "write models"):
+        vocab.save(os.path.join(params.output_dir, "feature-index.txt"))
+        if params.model_output_mode != "NONE":
+            to_write = (
+                [best]
+                if params.model_output_mode == "BEST" and best is not None
+                else models
+            )
+            mdir = os.path.join(params.output_dir, "models")
+            os.makedirs(mdir, exist_ok=True)
+            for i, tm in enumerate(to_write):
+                stem = os.path.join(mdir, f"{i}_lambda_{tm.reg_weight:g}")
+                save_glm_model(
+                    stem + ".avro", tm.model.coefficients, vocab, task
+                )
+                write_model_text(
+                    stem + ".txt", tm.model.coefficients.means, vocab
+                )
+            if best is not None:
+                save_glm_model(
+                    os.path.join(params.output_dir, "best-model.avro"),
+                    best.model.coefficients,
+                    vocab,
+                    task,
+                )
+        if validation_metrics:
+            with open(
+                os.path.join(params.output_dir, "validation-metrics.json"), "w"
+            ) as f:
+                json.dump(
+                    {
+                        f"{i}_lambda_{tm.reg_weight:g}": m
+                        for i, (tm, m) in enumerate(
+                            zip(models, validation_metrics)
+                        )
+                    },
+                    f,
+                    indent=2,
+                )
+    logger.close()
+
+    return GLMTrainingRun(
+        params=params,
+        stages=tracker.history,
+        vocab=vocab,
+        models=models,
+        best=best,
+        best_index=best_index,
+        validation_metrics=validation_metrics,
+        num_training_rows=len(records),
+        num_features=len(vocab),
+        summary=summary,
+    )
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli.train",
+        description="Train GLMs (logistic/linear/Poisson/smoothed-hinge) "
+        "over a regularization path.",
+    )
+    p.add_argument("--config", help="JSON file of GLMDriverParams")
+    p.add_argument("--train-input", nargs="+")
+    p.add_argument("--validate-input", nargs="+")
+    p.add_argument("--output-dir")
+    p.add_argument("--task")
+    p.add_argument("--optimizer")
+    p.add_argument("--reg-type")
+    p.add_argument("--reg-weights", nargs="+", type=float)
+    p.add_argument("--normalization")
+    p.add_argument("--max-iters", type=int)
+    p.add_argument("--tolerance", type=float)
+    p.add_argument("--sparse", action="store_true", default=None)
+    p.add_argument("--overwrite", action="store_true", default=None)
+    return p
+
+
+def params_from_args(args, cls) -> dict:
+    base = {}
+    if args.config:
+        with open(args.config) as f:
+            base = json.load(f)
+    for key, value in vars(args).items():
+        if key == "config" or value is None:
+            continue
+        base[key] = value
+    return base
+
+
+def main(argv=None) -> None:
+    args = build_arg_parser().parse_args(argv)
+    run_glm_training(params_from_args(args, GLMDriverParams))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,9 @@ class CoordinateUpdateRecord:
     seconds: float
     solver_iterations: float  # mean over entities for random effects
     convergence_histogram: Dict[str, int]
+    # validation metric after this update, when a validation_fn is supplied
+    # (``CoordinateDescent.scala:173-189``)
+    validation_metric: Optional[float] = None
 
 
 def _config_reg_term(cfg, params) -> jax.Array:
@@ -115,9 +118,12 @@ class CoordinateDescent:
         num_iterations: int,
         initial_model: Optional[GameModel] = None,
         seed: int = 0,
+        validation_fn=None,
     ):
         """Returns (model, history). Objective is logged after every
-        coordinate update like ``CoordinateDescent.scala:160-170``."""
+        coordinate update like ``CoordinateDescent.scala:160-170``;
+        `validation_fn(model) -> float`, when given, is evaluated after
+        every coordinate update too (``CoordinateDescent.scala:173-189``)."""
         names = list(self.coordinates)
         model = (
             initial_model.copy()
@@ -156,12 +162,19 @@ class CoordinateDescent:
                     ConvergenceReason(int(r)).name: int(c)
                     for r, c in zip(*np.unique(reasons, return_counts=True))
                 }
+                seconds = time.perf_counter() - t0  # update+rescore only
+                vmetric = (
+                    float(validation_fn(model))
+                    if validation_fn is not None
+                    else None
+                )
                 history.append(
                     CoordinateUpdateRecord(
                         iteration=it,
                         coordinate=name,
                         objective=obj,
-                        seconds=time.perf_counter() - t0,
+                        seconds=seconds,
+                        validation_metric=vmetric,
                         solver_iterations=(
                             float(np.mean(np.asarray(result.iterations)))
                             if np.asarray(result.iterations).size

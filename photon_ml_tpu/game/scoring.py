@@ -1,0 +1,59 @@
+"""Model-level GAME scoring, detached from training coordinates.
+
+Rebuild of the scoring side of the GAME model hierarchy
+(``model/FixedEffectModel.scala:31-88`` broadcast-dot,
+``model/RandomEffectModel.scala:117-146`` cogroup-with-default-0) for data
+that was NOT part of training — validation sets and the scoring driver
+(``cli/game/scoring/Driver.scala:139-141``: total score = sum of sub-model
+scores). Training-time scoring lives on the coordinates themselves, which
+own device-resident designs; this path works from a plain parameter dict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import GameData
+
+
+@jax.jit
+def _fixed_scores(w, feats):
+    return feats @ w
+
+
+@jax.jit
+def _random_scores(table, feats, ents):
+    safe = jnp.maximum(ents, 0)
+    per_row = jnp.einsum("nd,nd->n", feats, table[safe])
+    return jnp.where(ents >= 0, per_row, 0.0)
+
+
+def score_game_data(
+    params: Dict[str, jax.Array],
+    shards: Dict[str, str],
+    random_effects: Dict[str, Optional[str]],
+    data: GameData,
+    dtype=jnp.float64,
+) -> jax.Array:
+    """Sum of all coordinates' scores for every row (margins WITHOUT the
+    data offsets; add ``data.offsets`` for the full margin). Rows whose
+    entity is unknown to a random effect contribute 0 for that coordinate
+    (``RandomEffectModel.scala:117-146``)."""
+    n = data.num_rows
+    total = jnp.zeros((n,), dtype)
+    for name, p in params.items():
+        shard = shards[name]
+        feats = jnp.asarray(data.features[shard], dtype)
+        re_key = random_effects.get(name)
+        if re_key is None:
+            total = total + _fixed_scores(jnp.asarray(p, dtype), feats)
+        else:
+            ents = jnp.asarray(data.entity_ids[re_key])
+            total = total + _random_scores(
+                jnp.asarray(p, dtype), feats, ents
+            )
+    return total

@@ -1,4 +1,4 @@
-"""I/O: Avro codec, ingest, model persistence, vocabularies, checkpoints.
+"""I/O: Avro codec, ingest, model persistence, vocabularies, constraints.
 
 Rebuild of the reference's L8 (``io/GLMSuite.scala``, ``avro/AvroUtils.scala``,
 ``avro/model/ModelProcessingUtils.scala``, ``util/IndexMap.scala`` family).
@@ -15,9 +15,16 @@ from photon_ml_tpu.io.schemas import (
     TRAINING_EXAMPLE_SCHEMA,
 )
 from photon_ml_tpu.io.vocab import FeatureVocabulary
+from photon_ml_tpu.io.constraints import (
+    constraint_bounds,
+    load_constraint_bounds,
+    parse_constraint_string,
+)
 from photon_ml_tpu.io.ingest import (
+    game_data_from_avro,
     labeled_batch_from_avro,
     training_examples_to_arrays,
+    training_examples_to_sparse,
 )
 from photon_ml_tpu.io.models import (
     load_glm_model,
@@ -36,6 +43,11 @@ __all__ = [
     "FeatureVocabulary",
     "labeled_batch_from_avro",
     "training_examples_to_arrays",
+    "training_examples_to_sparse",
+    "game_data_from_avro",
+    "constraint_bounds",
+    "parse_constraint_string",
+    "load_constraint_bounds",
     "save_glm_model",
     "load_glm_model",
     "save_game_model",

@@ -1,0 +1,109 @@
+"""Coefficient box-constraint JSON, with wildcard rules.
+
+Rebuild of ``io/GLMSuite.createConstraintMap`` (``GLMSuite.scala:202-281``):
+the constraint file is a JSON array of
+``{"name": ..., "term": ..., "lowerBound": x, "upperBound": y}`` entries
+(bounds optional; missing = unbounded on that side). Wildcards:
+
+  - ``term == "*"``: the bound applies to every feature with that name;
+  - ``name == "*" and term == "*"``: the bound applies to ALL features
+    not covered by a more specific entry (any other use of a ``*`` name
+    is rejected, matching the reference);
+  - the intercept is never constrained.
+
+Specific (name, term) entries override name-wildcards, which override the
+global wildcard. Produces the per-index (lower, upper) bound vectors the
+solvers clip against (``OptimizationUtils.projectCoefficientsToHypercube``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+
+WILDCARD = "*"
+
+
+def parse_constraint_string(text: str) -> List[dict]:
+    """Parse + validate the JSON constraint array."""
+    data = json.loads(text)
+    if not isinstance(data, list):
+        raise ValueError("constraint JSON must be an array of objects")
+    out = []
+    for entry in data:
+        if not isinstance(entry, dict) or "name" not in entry:
+            raise ValueError(f"bad constraint entry: {entry!r}")
+        name = entry["name"]
+        term = entry.get("term", "")
+        if name == WILDCARD and term != WILDCARD:
+            raise ValueError(
+                f"a wildcard name requires a wildcard term: {entry!r} "
+                "(reference GLMSuite.scala:202-281)"
+            )
+        lb = entry.get("lowerBound")
+        ub = entry.get("upperBound")
+        lb = -math.inf if lb is None else float(lb)
+        ub = math.inf if ub is None else float(ub)
+        if lb > ub:
+            raise ValueError(f"lowerBound > upperBound in {entry!r}")
+        out.append({"name": name, "term": term, "lower": lb, "upper": ub})
+    return out
+
+
+def constraint_bounds(
+    entries: List[dict], vocab: FeatureVocabulary
+) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """Apply parsed entries to a vocabulary -> (lower, upper) (d,) arrays,
+    or (None, None) when nothing constrains anything."""
+    if not entries:
+        return None, None
+    d = len(vocab)
+    lower = np.full(d, -np.inf)
+    upper = np.full(d, np.inf)
+    icpt = vocab.intercept_index
+
+    # precedence: global wildcard, then name wildcard, then exact
+    for tier in ("global", "name", "exact"):
+        for e in entries:
+            is_global = e["name"] == WILDCARD and e["term"] == WILDCARD
+            is_name_wild = e["term"] == WILDCARD and not is_global
+            if (
+                (tier == "global" and not is_global)
+                or (tier == "name" and not is_name_wild)
+                or (tier == "exact" and (is_global or is_name_wild))
+            ):
+                continue
+            if is_global:
+                idxs = range(d)
+            elif is_name_wild:
+                idxs = [
+                    i
+                    for i in range(d)
+                    if vocab.name_term(i)[0] == e["name"]
+                ]
+            else:
+                j = vocab.get(e["name"], e["term"])
+                idxs = [] if j is None else [j]
+            for i in idxs:
+                if i == icpt:
+                    continue
+                lower[i] = e["lower"]
+                upper[i] = e["upper"]
+    if icpt is not None:
+        lower[icpt] = -np.inf
+        upper[icpt] = np.inf
+    if not np.isfinite(lower).any() and not np.isfinite(upper).any():
+        return None, None  # nothing actually constrained anything
+    return lower, upper
+
+
+def load_constraint_bounds(
+    path: str, vocab: FeatureVocabulary
+) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    with open(path, encoding="utf-8") as f:
+        return constraint_bounds(parse_constraint_string(f.read()), vocab)

@@ -111,6 +111,86 @@ def training_examples_to_sparse(
     return features, columns
 
 
+def game_data_from_avro(
+    records: List[dict],
+    shard_vocabs: Dict[str, "FeatureVocabulary"],
+    entity_keys: List[str],
+    entity_vocabs: Optional[Dict[str, dict]] = None,
+):
+    """TrainingExampleAvro records -> (GameData, entity_vocabs, uids).
+
+    The GAME analog of ``DataProcessingUtils.getGameDataSetFromGenericRecords``
+    (``DataProcessingUtils.scala:34-131``): each feature shard gets its own
+    (n, d_shard) matrix indexed by its vocabulary (a feature lands in every
+    shard whose vocabulary contains it — the reference's section-key bags),
+    and each entity key is read from the record's metadataMap into an int32
+    index column (unknown entity -> -1, scoring 0). When ``entity_vocabs``
+    is given (scoring against a trained model) it is applied; otherwise
+    vocabularies are built from the data (training).
+    """
+    from photon_ml_tpu.game.data import GameData
+
+    n = len(records)
+    labels = np.zeros(n, np.float64)
+    offsets = np.zeros(n, np.float64)
+    weights = np.ones(n, np.float64)
+    uids: List[Optional[str]] = []
+    features = {
+        shard: np.zeros((n, len(vocab)), np.float64)
+        for shard, vocab in shard_vocabs.items()
+    }
+    raw_entities: Dict[str, List[str]] = {k: [] for k in entity_keys}
+    for i, rec in enumerate(records):
+        labels[i] = rec.get("label", 0.0)
+        if rec.get("offset") is not None:
+            offsets[i] = rec["offset"]
+        if rec.get("weight") is not None:
+            weights[i] = rec["weight"]
+        uids.append(rec.get("uid"))
+        meta = rec.get("metadataMap") or {}
+        for k in entity_keys:
+            raw_entities[k].append(str(meta.get(k, "")))
+        for f in rec["features"]:
+            key = feature_key(f["name"], f["term"])
+            for shard, vocab in shard_vocabs.items():
+                j = vocab.key_to_index.get(key)
+                if j is not None and j != vocab.intercept_index:
+                    features[shard][i, j] += f["value"]
+    for shard, vocab in shard_vocabs.items():
+        if vocab.intercept_index is not None:
+            features[shard][:, vocab.intercept_index] = 1.0
+
+    from photon_ml_tpu.game.data import (
+        apply_entity_vocabulary,
+        build_entity_vocabulary,
+    )
+
+    entity_ids: Dict[str, np.ndarray] = {}
+    out_vocabs: Dict[str, dict] = {}
+    for k in entity_keys:
+        raw = np.asarray(raw_entities[k], object)
+        known = np.asarray([r != "" for r in raw_entities[k]])
+        if entity_vocabs is not None and k in entity_vocabs:
+            vocab_k = dict(entity_vocabs[k])
+            idx = apply_entity_vocabulary(vocab_k, raw)
+        else:
+            # build only from rows that actually carry the key
+            vocab_k, _ = build_entity_vocabulary(raw[known])
+            idx = apply_entity_vocabulary(vocab_k, raw)
+        idx = np.where(known, idx, -1).astype(np.int32)
+        entity_ids[k] = idx
+        out_vocabs[k] = vocab_k
+
+    data = GameData.create(
+        features=features,
+        labels=labels,
+        offsets=offsets,
+        weights=weights,
+        entity_ids=entity_ids,
+    )
+    return data, out_vocabs, np.asarray(uids, object)
+
+
 def labeled_batch_from_avro(
     records: List[dict],
     vocab: FeatureVocabulary,

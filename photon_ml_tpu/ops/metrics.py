@@ -150,3 +150,62 @@ def peak_f1(labels, scores, weights):
 def akaike_information_criterion(total_loss_value, num_effective_params):
     """AIC = 2k + 2 * negative-log-likelihood (total loss)."""
     return 2.0 * num_effective_params + 2.0 * total_loss_value
+
+
+def per_datum_log_likelihood(task, labels, margins, weights):
+    """(n,) weighted per-example log-likelihood (``Evaluation.scala``'s
+    per-datum LL; the negative pointwise loss)."""
+    from photon_ml_tpu.ops.losses import loss_for_task
+
+    return -weights * loss_for_task(task).value(margins, labels)
+
+
+# reference metric names (``Evaluation.scala:30-48``)
+ROOT_MEAN_SQUARED_ERROR = "ROOT_MEAN_SQUARED_ERROR"
+MEAN_SQUARED_ERROR = "MEAN_SQUARED_ERROR"
+MEAN_ABSOLUTE_ERROR = "MEAN_ABSOLUTE_ERROR"
+AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS = (
+    "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+)
+AREA_UNDER_PRECISION_RECALL = "AREA_UNDER_PRECISION_RECALL"
+PEAK_F1_SCORE = "PEAK_F1_SCORE"
+DATA_LOG_LIKELIHOOD = "DATA_LOG_LIKELIHOOD"
+AKAIKE_INFORMATION_CRITERION = "AKAIKE_INFORMATION_CRITERION"
+
+
+def evaluate(task, labels, margins, weights, num_effective_params=None):
+    """Named-metric map for one model on one dataset — the
+    ``Evaluation.evaluate`` facade (``Evaluation.scala:50-140``). Inputs are
+    raw margins (w.x + offset); mean-link transforms happen here. Returns
+    {metric name: float}."""
+    from photon_ml_tpu.core.tasks import TaskType
+    from photon_ml_tpu.ops.losses import loss_for_task
+
+    loss = loss_for_task(task)
+    means = loss.mean(margins)
+    out = {}
+    if task.is_classifier:
+        out[AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] = float(
+            area_under_roc_curve(labels, margins, weights)
+        )
+        out[AREA_UNDER_PRECISION_RECALL] = float(
+            average_precision(labels, margins, weights)
+        )
+        out[PEAK_F1_SCORE] = float(peak_f1(labels, margins, weights))
+    else:
+        out[ROOT_MEAN_SQUARED_ERROR] = float(
+            root_mean_squared_error(labels, means, weights)
+        )
+        out[MEAN_SQUARED_ERROR] = float(
+            mean_squared_error(labels, means, weights)
+        )
+        out[MEAN_ABSOLUTE_ERROR] = float(
+            mean_absolute_error(labels, means, weights)
+        )
+    total_ll = float(jnp.sum(per_datum_log_likelihood(task, labels, margins, weights)))
+    out[DATA_LOG_LIKELIHOOD] = total_ll / max(float(jnp.sum(weights)), 1e-30)
+    if num_effective_params is not None:
+        out[AKAIKE_INFORMATION_CRITERION] = float(
+            akaike_information_criterion(-total_ll, num_effective_params)
+        )
+    return out

@@ -1,0 +1,4 @@
+from photon_ml_tpu.utils.logging import PhotonLogger, timed
+from photon_ml_tpu.utils.dates import DateRange, expand_date_paths
+
+__all__ = ["PhotonLogger", "timed", "DateRange", "expand_date_paths"]

@@ -1,0 +1,431 @@
+"""End-to-end driver integration tests — the analog of the reference's
+``DriverIntegTest.scala:47-670`` and ``DriverGameIntegTest.scala:343-400``:
+synthesize Avro fixtures, run the real drivers (ingest -> train -> save ->
+load -> score -> metric), and assert on stages, outputs, and quality. No
+hand assembly of the pipeline."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.score import run_scoring
+from photon_ml_tpu.cli.stages import DriverStage
+from photon_ml_tpu.cli.train import run_glm_training
+from photon_ml_tpu.cli.game_train import run_game_training
+from photon_ml_tpu.io.avro import read_avro_file, write_avro_file
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+
+def _sigmoid(z):
+    return 1 / (1 + np.exp(-z))
+
+
+def make_glm_records(rng, n, d, w_true, noise=0.0):
+    x = rng.normal(size=(n, d))
+    y = (rng.uniform(size=n) < _sigmoid(x @ w_true + noise)).astype(float)
+    records = []
+    for i in range(n):
+        records.append(
+            {
+                "uid": f"row{i}",
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": None,
+                "weight": None,
+                "offset": None,
+            }
+        )
+    return records
+
+
+def make_game_records(rng, n_users, rows_per_user, d_g, d_u, truth=None):
+    """Mixed-effects fixture: global features gf*, per-user features uf*,
+    userId in metadataMap (the Yahoo-music-style shape of
+    ``DriverGameIntegTest``). Pass ``truth=(w_g, w_u)`` to draw additional
+    data from the SAME model (e.g. a validation split)."""
+    if truth is None:
+        w_g = rng.normal(size=d_g)
+        w_u = rng.normal(size=(n_users, d_u)) * 2.0
+    else:
+        w_g, w_u = truth
+    records = []
+    i = 0
+    for u in range(n_users):
+        for _ in range(rows_per_user):
+            xg = rng.normal(size=d_g)
+            xu = rng.normal(size=d_u)
+            margin = xg @ w_g + xu @ w_u[u]
+            y = float(rng.uniform() < _sigmoid(margin))
+            feats = [
+                {"name": f"gf{j}", "term": "", "value": float(xg[j])}
+                for j in range(d_g)
+            ] + [
+                {"name": f"uf{j}", "term": "", "value": float(xu[j])}
+                for j in range(d_u)
+            ]
+            records.append(
+                {
+                    "uid": f"row{i}",
+                    "label": y,
+                    "features": feats,
+                    "metadataMap": {"userId": f"user{u}"},
+                    "weight": None,
+                    "offset": None,
+                }
+            )
+            i += 1
+    return records, (w_g, w_u)
+
+
+def write_records(path, records):
+    write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, records)
+    return path
+
+
+def write_feature_file(path, names):
+    from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+    FeatureVocabulary(
+        [feature_key(n, "") for n in names], add_intercept=True
+    ).save(path)
+    return path
+
+
+@pytest.fixture
+def glm_fixture(rng, tmp_path):
+    w_true = rng.normal(size=6) * 1.5
+    train = write_records(
+        str(tmp_path / "train.avro"), make_glm_records(rng, 600, 6, w_true)
+    )
+    valid = write_records(
+        str(tmp_path / "valid.avro"), make_glm_records(rng, 300, 6, w_true)
+    )
+    return train, valid, tmp_path
+
+
+class TestGLMDriver:
+    def test_full_pipeline_with_validation(self, rng, glm_fixture):
+        train, valid, tmp = glm_fixture
+        run = run_glm_training(
+            {
+                "train_input": [train],
+                "validate_input": [valid],
+                "output_dir": str(tmp / "out"),
+                "task": "LOGISTIC_REGRESSION",
+                "optimizer": "TRON",
+                "reg_type": "L2",
+                "reg_weights": [10.0, 1.0],
+                "max_iters": 50,
+                "tolerance": 1e-9,
+            }
+        )
+        assert run.stages == [
+            DriverStage.INIT,
+            DriverStage.PREPROCESSED,
+            DriverStage.TRAINED,
+            DriverStage.VALIDATED,
+        ]
+        assert run.num_training_rows == 600
+        assert run.num_features == 7  # 6 + intercept
+        assert len(run.models) == 2
+        assert run.best is not None
+        auc = run.validation_metrics[run.best_index][
+            "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+        ]
+        assert auc > 0.85
+        out = tmp / "out"
+        assert (out / "best-model.avro").exists()
+        assert (out / "feature-index.txt").exists()
+        assert (out / "feature-summary.tsv").exists()
+        assert (out / "validation-metrics.json").exists()
+        assert (out / "log-message.txt").exists()
+        txts = [f for f in os.listdir(out / "models") if f.endswith(".txt")]
+        assert len(txts) == 2  # model text per lambda
+
+    def test_output_dir_guard(self, rng, glm_fixture):
+        train, _, tmp = glm_fixture
+        cfg = {
+            "train_input": [train],
+            "output_dir": str(tmp / "out2"),
+            "reg_weights": [1.0],
+            "max_iters": 5,
+        }
+        run_glm_training(cfg)
+        with pytest.raises(FileExistsError):
+            run_glm_training(cfg)
+        run_glm_training({**cfg, "overwrite": True})  # explicit overwrite ok
+
+    def test_constraints_respected(self, rng, glm_fixture):
+        train, _, tmp = glm_fixture
+        constraints = [
+            {"name": "f0", "term": "", "lowerBound": -0.1, "upperBound": 0.1},
+            {"name": "*", "term": "*", "lowerBound": -5, "upperBound": 5},
+        ]
+        cpath = tmp / "constraints.json"
+        cpath.write_text(json.dumps(constraints))
+        run = run_glm_training(
+            {
+                "train_input": [train],
+                "output_dir": str(tmp / "outc"),
+                "optimizer": "LBFGS",
+                "reg_type": "NONE",
+                "reg_weights": [0.0],
+                "constraint_file": str(cpath),
+                "max_iters": 60,
+            }
+        )
+        w = np.asarray(run.models[0].model.coefficients.means)
+        f0 = run.vocab.get("f0", "")
+        assert -0.1 - 1e-9 <= w[f0] <= 0.1 + 1e-9
+        assert np.all(w >= -5 - 1e-9) and np.all(w <= 5 + 1e-9)
+
+    def test_glm_scoring_round_trip(self, rng, glm_fixture):
+        train, valid, tmp = glm_fixture
+        run_glm_training(
+            {
+                "train_input": [train],
+                "validate_input": [valid],
+                "output_dir": str(tmp / "outm"),
+                "optimizer": "TRON",
+                "reg_weights": [1.0],
+                "max_iters": 50,
+                "tolerance": 1e-9,
+            }
+        )
+        srun = run_scoring(
+            {
+                "input": [valid],
+                "model_dir": str(tmp / "outm"),
+                "output_dir": str(tmp / "scores"),
+                "model_kind": "glm",
+                "evaluate": True,
+            }
+        )
+        assert srun.scores.shape == (300,)
+        auc = srun.metrics["AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"]
+        assert auc > 0.85
+        _, recs = read_avro_file(srun.output_path)
+        assert len(recs) == 300
+        assert recs[0]["uid"].startswith("row")
+        assert np.isfinite(recs[0]["predictionScore"])
+
+    def test_sparse_driver_matches_dense(self, rng, glm_fixture):
+        train, valid, tmp = glm_fixture
+        common = {
+            "train_input": [train],
+            "validate_input": [valid],
+            "optimizer": "TRON",
+            "reg_weights": [1.0],
+            "max_iters": 60,
+            "tolerance": 1e-10,
+        }
+        dense = run_glm_training(
+            {**common, "output_dir": str(tmp / "outd")}
+        )
+        sparse = run_glm_training(
+            {**common, "output_dir": str(tmp / "outs"), "sparse": True}
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse.models[0].model.coefficients.means),
+            np.asarray(dense.models[0].model.coefficients.means),
+            atol=1e-8,
+        )
+
+
+@pytest.fixture
+def game_fixture(rng, tmp_path):
+    trecords, truth = make_game_records(
+        rng, n_users=12, rows_per_user=25, d_g=4, d_u=2
+    )
+    vrecords, _ = make_game_records(
+        rng, n_users=12, rows_per_user=10, d_g=4, d_u=2, truth=truth
+    )
+    train = write_records(str(tmp_path / "gtrain.avro"), trecords)
+    valid = write_records(str(tmp_path / "gvalid.avro"), vrecords)
+    gshard = write_feature_file(
+        str(tmp_path / "global.features"), [f"gf{j}" for j in range(4)]
+    )
+    ushard = write_feature_file(
+        str(tmp_path / "user.features"), [f"uf{j}" for j in range(2)]
+    )
+    return train, valid, gshard, ushard, tmp_path
+
+
+def game_params(train, valid, gshard, ushard, out, **over):
+    base = {
+        "train_input": [train],
+        "validate_input": [valid] if valid else [],
+        "output_dir": out,
+        "task": "LOGISTIC_REGRESSION",
+        "num_iterations": 2,
+        "updating_sequence": ["global", "per-user"],
+        "feature_shards": {"gshard": gshard, "ushard": ushard},
+        "coordinates": {
+            "global": {
+                "shard": "gshard",
+                "optimizer": "TRON",
+                "reg_weights": [0.1],
+                "max_iters": 20,
+                "tolerance": 1e-8,
+            },
+            "per-user": {
+                "shard": "ushard",
+                "random_effect": "userId",
+                "optimizer": "TRON",
+                "reg_weights": [1.0],
+                "max_iters": 20,
+                "tolerance": 1e-8,
+                "num_buckets": 2,
+            },
+        },
+    }
+    base.update(over)
+    return base
+
+
+class TestGameDriver:
+    def test_fixed_plus_random(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        run = run_game_training(
+            game_params(train, valid, gs, us, str(tmp / "gout"))
+        )
+        assert len(run.sweep) == 1
+        hist = run.sweep[0]["history"]
+        objs = [h.objective for h in hist]
+        assert all(b <= a + 1e-6 for a, b in zip(objs, objs[1:]))
+        # per-coordinate validation metric logged on every update
+        assert all(h.validation_metric is not None for h in hist)
+        assert run.sweep[0]["validation_metric"] > 0.80
+        best_dir = run.output_dirs[0]
+        assert os.path.isdir(os.path.join(best_dir, "fixed-effect", "global"))
+        assert os.path.isdir(
+            os.path.join(best_dir, "random-effect", "per-user")
+        )
+
+    def test_fixed_only(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        params = game_params(train, valid, gs, us, str(tmp / "gout2"))
+        params["updating_sequence"] = ["global"]
+        params["coordinates"] = {
+            "global": params["coordinates"]["global"]
+        }
+        run = run_game_training(params)
+        assert set(run.sweep[0]["model"].params) == {"global"}
+
+    def test_random_only(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        params = game_params(train, valid, gs, us, str(tmp / "gout3"))
+        params["updating_sequence"] = ["per-user"]
+        params["coordinates"] = {
+            "per-user": params["coordinates"]["per-user"]
+        }
+        run = run_game_training(params)
+        model = run.sweep[0]["model"]
+        assert model.params["per-user"].shape == (12, 3)  # 2 + intercept
+
+    def test_grid_sweep_selects_best(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        params = game_params(
+            train, valid, gs, us, str(tmp / "gout4"),
+            model_output_mode="ALL",
+        )
+        params["coordinates"]["per-user"]["reg_weights"] = [1000.0, 1.0]
+        run = run_game_training(params)
+        assert len(run.sweep) == 2
+        combos = [s["combo"]["per-user"] for s in run.sweep]
+        assert combos == [1000.0, 1.0]
+        # the sane reg weight must win on validation
+        assert run.sweep[run.best_index]["combo"]["per-user"] == 1.0
+        assert len(run.output_dirs) == 2  # ALL mode writes every combo
+
+        # scoring an ALL-mode output dir must resolve a real model (not
+        # silently score zeros) whether pointed at the root or a sub-model
+        for model_dir, out in [
+            (str(tmp / "gout4"), str(tmp / "gs4a")),
+            (run.output_dirs[1], str(tmp / "gs4b")),
+        ]:
+            srun = run_scoring(
+                {
+                    "input": [valid],
+                    "model_dir": model_dir,
+                    "output_dir": out,
+                    "model_kind": "game",
+                }
+            )
+            assert np.abs(srun.scores).max() > 0.0
+
+    def test_game_scoring_round_trip(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        run = run_game_training(
+            game_params(train, valid, gs, us, str(tmp / "gout5"))
+        )
+        srun = run_scoring(
+            {
+                "input": [valid],
+                "model_dir": str(tmp / "gout5"),
+                "output_dir": str(tmp / "gscores"),
+                "model_kind": "game",
+                "evaluate": True,
+            }
+        )
+        auc = srun.metrics["AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"]
+        # scoring the model the driver saved must reproduce the driver's
+        # own final validation metric
+        np.testing.assert_allclose(
+            auc, run.sweep[run.best_index]["validation_metric"], atol=1e-9
+        )
+
+    def test_unknown_entity_scores_zero_in_scoring(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        run_game_training(
+            game_params(train, None, gs, us, str(tmp / "gout6"))
+        )
+        # scoring data with an unseen user: random-effect contributes 0
+        recs, _ = make_game_records(rng, n_users=1, rows_per_user=5, d_g=4, d_u=2)
+        for r in recs:
+            r["metadataMap"] = {"userId": "brand-new-user"}
+        spath = write_records(str(tmp / "unseen.avro"), recs)
+        srun = run_scoring(
+            {
+                "input": [spath],
+                "model_dir": str(tmp / "gout6"),
+                "output_dir": str(tmp / "gscores6"),
+                "model_kind": "game",
+            }
+        )
+        assert np.all(np.isfinite(srun.scores))
+
+
+class TestUtils:
+    def test_date_range_expansion(self, tmp_path):
+        from photon_ml_tpu.utils.dates import DateRange, expand_date_paths
+
+        for day in ("2024/01/30", "2024/01/31", "2024/02/01"):
+            (tmp_path / day).mkdir(parents=True)
+        got = expand_date_paths(
+            [str(tmp_path)], DateRange.from_dates("20240131-20240202")
+        )
+        assert got == [
+            str(tmp_path / "2024/01/31"),
+            str(tmp_path / "2024/02/01"),
+        ]
+        with pytest.raises(FileNotFoundError):
+            expand_date_paths(
+                [str(tmp_path)], DateRange.from_dates("20230101-20230102")
+            )
+
+    def test_logger_writes_file(self, tmp_path):
+        from photon_ml_tpu.utils.logging import PhotonLogger
+
+        path = tmp_path / "log.txt"
+        with PhotonLogger(str(path), level="INFO") as log:
+            log.debug("hidden")
+            log.info("visible")
+        text = path.read_text()
+        assert "visible" in text and "hidden" not in text
