@@ -132,6 +132,10 @@ class GameDriverParams:
     overwrite: bool = False
     log_level: str = "DEBUG"
     precision: str = "float64"
+    # checkpoint the full training state every N outer iterations
+    # (0 = disabled); resume=True continues a previous run in-place
+    checkpoint_every: int = 0
+    resume: bool = False
 
     def validate(self) -> None:
         if not self.train_input:
@@ -155,6 +159,12 @@ class GameDriverParams:
         if len(fixed) > 1:
             raise ValueError(
                 f"at most one fixed-effect coordinate supported, got {fixed}"
+            )
+        if self.resume and self.checkpoint_every <= 0:
+            raise ValueError(
+                "resume=True requires checkpoint_every > 0; without "
+                "checkpoints a resumed run would silently retrain from "
+                "scratch over the existing output directory"
             )
 
     def grid(self) -> List[Dict[str, float]]:

@@ -126,7 +126,7 @@ def run_game_training(params) -> GameTrainingRun:
 
     params = load_params(params, GameDriverParams)
     params.validate()
-    prepare_output_dir(params.output_dir, params.overwrite)
+    prepare_output_dir(params.output_dir, params.overwrite or params.resume)
     logger = PhotonLogger(
         os.path.join(params.output_dir, "log-message.txt"),
         level=params.log_level,
@@ -210,7 +210,7 @@ def run_game_training(params) -> GameTrainingRun:
         )
 
     sweep: List[dict] = []
-    for combo in params.grid():
+    for combo_index, combo in enumerate(params.grid()):
         with timed(logger, f"train combo {combo}"):
             coords = build_coordinates(
                 params, data, task, combo, entity_counts, dtype=dtype
@@ -227,8 +227,21 @@ def run_game_training(params) -> GameTrainingRun:
                 if (vdata is not None and params.validate_per_coordinate)
                 else None
             )
+            # keyed by grid INDEX: reg-weight strings are not unique
+            # (duplicate weights are supported sweep candidates)
+            ckpt_dir = (
+                os.path.join(
+                    params.output_dir, "checkpoints", f"combo-{combo_index}"
+                )
+                if params.checkpoint_every > 0
+                else None
+            )
             model, history = cd.run(
-                params.num_iterations, validation_fn=vfn
+                params.num_iterations,
+                validation_fn=vfn,
+                checkpoint_dir=ckpt_dir,
+                checkpoint_every=max(params.checkpoint_every, 1),
+                resume=params.resume,
             )
             for h in history:
                 logger.info(

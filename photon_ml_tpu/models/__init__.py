@@ -1,5 +1,10 @@
 """Supervised GLM models + training API (reference L4, ``supervised/``)."""
 
+from photon_ml_tpu.models.bootstrap import (
+    BootstrapResult,
+    CoefficientSummary,
+    bootstrap_train_glm,
+)
 from photon_ml_tpu.models.glm import GeneralizedLinearModel, TaskType
 from photon_ml_tpu.models.training import (
     GLMTrainingConfig,
@@ -15,4 +20,7 @@ __all__ = [
     "OptimizerType",
     "TrainedModel",
     "train_glm",
+    "bootstrap_train_glm",
+    "BootstrapResult",
+    "CoefficientSummary",
 ]

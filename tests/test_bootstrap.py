@@ -1,0 +1,120 @@
+"""Bootstrap training: N replicas in one vmapped device call, coefficient
+summaries matching classical theory, and metric distributions — the
+contracts of ``BootstrapTraining.scala:29-194`` + CoefficientSummary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.models import (
+    GLMTrainingConfig,
+    OptimizerType,
+    TaskType,
+    bootstrap_train_glm,
+    train_glm,
+)
+from photon_ml_tpu.models.bootstrap import _resample_weights
+from photon_ml_tpu.ops import RegularizationContext
+
+
+class TestResampleWeights:
+    def test_counts_are_multinomial(self, rng):
+        n, R = 50, 64
+        base = jnp.ones(n)
+        mask = jnp.ones(n)
+        w = np.asarray(
+            _resample_weights(jax.random.PRNGKey(0), base, mask, R)
+        )
+        assert w.shape == (R, n)
+        # each replica draws exactly n rows with replacement
+        np.testing.assert_array_equal(w.sum(axis=1), n)
+        assert np.all(w == np.round(w))  # integer counts
+        assert np.any(w == 0) and np.any(w > 1)  # real resampling happened
+
+    def test_masked_rows_never_drawn(self, rng):
+        n, R = 40, 32
+        mask = jnp.asarray((np.arange(n) < 30).astype(float))
+        w = np.asarray(
+            _resample_weights(jax.random.PRNGKey(1), jnp.ones(n), mask, R)
+        )
+        assert np.all(w[:, 30:] == 0.0)
+        # draw count == REAL row count (padding must not inflate the
+        # effective sample size and bias CIs narrow)
+        np.testing.assert_array_equal(w.sum(axis=1), 30)
+
+
+class TestBootstrapGLM:
+    def test_linear_regression_summary_matches_theory(self, rng):
+        """Bootstrap stddev must approximate the classical OLS standard
+        error, the replica mean must track the full fit, and the CI must
+        cover the truth (a 3-sigma sanity band per coefficient)."""
+        n, d = 400, 5
+        x = rng.normal(size=(n, d))
+        w_true = rng.normal(size=d)
+        sigma = 0.5
+        y = x @ w_true + sigma * rng.normal(size=n)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LINEAR_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1e-6,),
+            tolerance=1e-12,
+            max_iters=60,
+        )
+        R = 200
+        res = bootstrap_train_glm(batch, cfg, num_replicas=R, seed=7)
+        assert res.coefficients.shape == (R, d)
+
+        (full,) = train_glm(batch, cfg)
+        w_full = np.asarray(full.model.coefficients.means)
+        np.testing.assert_allclose(
+            res.summary.mean, w_full, atol=4.0 * res.summary.stddev.max()
+        )
+        # classical SE: sigma * sqrt(diag((X'X)^-1))
+        se = sigma * np.sqrt(np.diag(np.linalg.inv(x.T @ x)))
+        np.testing.assert_allclose(res.summary.stddev, se, rtol=0.5)
+        # truth within the 95% CI (allow 1 miss of 5 at this confidence)
+        covered = (res.summary.lower <= w_true) & (w_true <= res.summary.upper)
+        assert covered.sum() >= d - 1
+        assert np.all(res.summary.min <= res.summary.lower + 1e-12)
+        assert np.all(res.summary.max >= res.summary.upper - 1e-12)
+
+    def test_logistic_metric_distributions(self, rng):
+        n, d = 300, 4
+        x = rng.normal(size=(n, d))
+        w_true = rng.normal(size=d) * 2
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(x @ w_true)))).astype(float)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+        xe = rng.normal(size=(150, d))
+        ye = (rng.uniform(size=150) < 1 / (1 + np.exp(-(xe @ w_true)))).astype(float)
+        ebatch = LabeledBatch.create(xe, ye, dtype=jnp.float64)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            tolerance=1e-9,
+            max_iters=40,
+        )
+        res = bootstrap_train_glm(
+            batch, cfg, num_replicas=50, seed=3, evaluation_batch=ebatch
+        )
+        aucs = res.metric_distributions[
+            "AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"
+        ]
+        assert aucs.shape == (50,)
+        assert aucs.mean() > 0.85
+        assert aucs.std() > 0.0  # a real distribution, not one value
+
+    def test_rejects_multi_lambda(self, rng):
+        batch = LabeledBatch.create(
+            rng.normal(size=(20, 2)), rng.normal(size=20), dtype=jnp.float64
+        )
+        cfg = GLMTrainingConfig(
+            task=TaskType.LINEAR_REGRESSION, reg_weights=(1.0, 2.0)
+        )
+        with pytest.raises(ValueError, match="exactly"):
+            bootstrap_train_glm(batch, cfg, num_replicas=3)

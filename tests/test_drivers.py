@@ -381,6 +381,31 @@ class TestGameDriver:
             auc, run.sweep[run.best_index]["validation_metric"], atol=1e-9
         )
 
+    def test_driver_checkpoint_resume(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        out = str(tmp / "gout7")
+        base = game_params(
+            train, None, gs, us, out,
+            checkpoint_every=1, num_iterations=1,
+        )
+        run_game_training(base)
+        ck_root = os.path.join(out, "checkpoints")
+        assert os.path.isdir(ck_root) and os.listdir(ck_root)
+        # resume in-place to 2 iterations; must match a straight 2-iter run
+        resumed = run_game_training(
+            {**base, "num_iterations": 2, "resume": True}
+        )
+        straight = run_game_training(
+            game_params(
+                train, None, gs, us, str(tmp / "gout7b"), num_iterations=2
+            )
+        )
+        for name, p in straight.sweep[0]["model"].params.items():
+            np.testing.assert_array_equal(
+                np.asarray(resumed.sweep[0]["model"].params[name]),
+                np.asarray(p),
+            )
+
     def test_unknown_entity_scores_zero_in_scoring(self, rng, game_fixture):
         train, valid, gs, us, tmp = game_fixture
         run_game_training(
