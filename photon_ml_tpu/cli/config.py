@@ -185,6 +185,9 @@ class ScoringParams:
     model_dir: str
     output_dir: str
     model_kind: str = "game"  # "glm" | "game"
+    # explicit .avro model file (glm only) — overrides the best-model.avro /
+    # models/ resolution inside model_dir
+    model_path: Optional[str] = None
     task: str = "LOGISTIC_REGRESSION"
     evaluate: bool = False  # requires labels in the input
     sparse: bool = False

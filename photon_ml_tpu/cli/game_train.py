@@ -148,14 +148,28 @@ def run_game_training(params) -> GameTrainingRun:
             spec.shard for spec in params.coordinates.values()
         }
         shard_vocabs: Dict[str, FeatureVocabulary] = {}
+        fallback_shards = []
+        fallback_vocab = None
         for shard in shard_ids:
             feature_file = params.feature_shards.get(shard)
             if feature_file:
                 shard_vocabs[shard] = FeatureVocabulary.load(feature_file)
             else:
-                shard_vocabs[shard] = FeatureVocabulary.from_records(
-                    records, add_intercept=params.add_intercept
-                )
+                fallback_shards.append(shard)
+                if fallback_vocab is None:
+                    fallback_vocab = FeatureVocabulary.from_records(
+                        records, add_intercept=params.add_intercept
+                    )
+                shard_vocabs[shard] = fallback_vocab
+        if len(fallback_shards) > 1:
+            # The from-records fallback is the FULL feature space, so these
+            # shards collapse into identical bags — unlike the reference's
+            # partitioned feature sections. Surface it loudly.
+            logger.warn(
+                f"shards {sorted(fallback_shards)} have no feature_shards "
+                "file and all fall back to the full from-records vocabulary; "
+                "they will share an identical feature space"
+            )
         entity_keys = sorted(
             {
                 spec.random_effect

@@ -108,18 +108,34 @@ def run_scoring(params) -> ScoringRun:
             vocab = FeatureVocabulary.load(
                 os.path.join(params.model_dir, "feature-index.txt")
             )
-            model_path = os.path.join(params.model_dir, "best-model.avro")
+            if params.model_path:
+                model_path = params.model_path
+            else:
+                model_path = os.path.join(params.model_dir, "best-model.avro")
             if not os.path.exists(model_path):
                 mdir = os.path.join(params.model_dir, "models")
                 candidates = sorted(
                     f for f in os.listdir(mdir) if f.endswith(".avro")
+                )
+                if len(candidates) != 1:
+                    raise FileNotFoundError(
+                        f"no best-model.avro in {params.model_dir} and "
+                        f"{len(candidates)} candidates in models/ — set "
+                        "model_path to the .avro you want scored (an "
+                        "arbitrary lambda would be silently scored "
+                        f"otherwise): {candidates}"
+                    )
+                logger.warn(
+                    f"best-model.avro absent; using the only model in "
+                    f"models/: {candidates[0]}"
                 )
                 model_path = os.path.join(mdir, candidates[0])
             coefficients, model_task = load_glm_model(model_path, vocab)
             if model_task is not None:
                 task = model_task
             batch = labeled_batch_from_avro(
-                records, vocab, sparse=params.sparse, dtype=jnp.float64
+                records, vocab, sparse=params.sparse, dtype=jnp.float64,
+                allow_null_labels=True,
             )
             from photon_ml_tpu.ops.sparse import matvec
 
@@ -175,6 +191,7 @@ def run_scoring(params) -> ScoringRun:
                 shard_vocabs,
                 entity_keys,
                 entity_vocabs=re_vocabs,
+                allow_null_labels=True,
             )
             margins = (
                 score_game_data(model_params, shards, random_effects, data)
@@ -188,15 +205,18 @@ def run_scoring(params) -> ScoringRun:
     # ---- write ScoredItems (``ScoredItem.scala`` / scoring Driver) -------
     out_path = os.path.join(params.output_dir, "scores", "part-00000.avro")
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    has_labels = any(r.get("label") is not None for r in records)
+    label_present = np.asarray(
+        [r.get("label") is not None for r in records], bool
+    )
+    has_labels = bool(label_present.any())
     score_records = [
         {
             "predictionScore": float(s),
             "uid": None if u is None else str(u),
-            "label": float(l) if has_labels else None,
+            "label": float(l) if p else None,
             "metadataMap": None,
         }
-        for s, u, l in zip(scores, uids, labels)
+        for s, u, l, p in zip(scores, uids, labels, label_present)
     ]
     write_avro_file(out_path, SCORING_RESULT_SCHEMA, score_records)
     logger.info(f"wrote {len(score_records)} scored items to {out_path}")
@@ -206,11 +226,23 @@ def run_scoring(params) -> ScoringRun:
     if params.evaluate:
         if not has_labels:
             raise ValueError("evaluate=True but input records carry no labels")
+        ev_labels, ev_scores, ev_weights = labels, scores, weights
+        if not label_present.all():
+            # unlabeled rows carry a coerced 0.0 label — drop them from
+            # the evaluation arrays entirely (this is a host-side metric
+            # pass, so the dynamic shape is fine)
+            logger.warn(
+                f"{int((~label_present).sum())} of {len(records)} records "
+                "have no label; excluding them from evaluation"
+            )
+            ev_labels = labels[label_present]
+            ev_scores = scores[label_present]
+            ev_weights = weights[label_present]
         eval_metrics = metrics_mod.evaluate(
             task,
-            jnp.asarray(labels),
-            jnp.asarray(scores),
-            jnp.asarray(weights),
+            jnp.asarray(ev_labels),
+            jnp.asarray(ev_scores),
+            jnp.asarray(ev_weights),
         )
         with open(os.path.join(params.output_dir, "metrics.json"), "w") as f:
             json.dump(eval_metrics, f, indent=2)

@@ -1,0 +1,58 @@
+"""Diagnostics subsystem (reference L10): model goodness-of-fit, error
+independence, feature importance, learning curves, bootstrap confidence
+intervals, and the HTML diagnostic report.
+
+Rebuild of ``diagnostics/**`` (~4,200 reference LoC): the statistical
+content is preserved — Hosmer–Lemeshow chi-square calibration, Kendall-tau
+prediction/error independence, expected-magnitude + variance feature
+importances, cumulative-portion learning curves with warm starts, and
+bootstrap aggregation — while the Spark RDD choreography is replaced by
+vectorized array passes (binning via bincount, the tau pair scan as one
+O(m^2) broadcast) and the Scala renderer class hierarchy by a small
+logical-report -> HTML pass.
+"""
+
+from photon_ml_tpu.diagnostics.hl import (
+    HosmerLemeshowReport,
+    hosmer_lemeshow,
+)
+from photon_ml_tpu.diagnostics.importance import (
+    FeatureImportanceReport,
+    feature_importance,
+)
+from photon_ml_tpu.diagnostics.independence import (
+    KendallTauReport,
+    PredictionErrorIndependenceReport,
+    kendall_tau,
+    prediction_error_independence,
+)
+from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic
+from photon_ml_tpu.diagnostics.bootstrap_diag import (
+    BootstrapDiagnosticReport,
+    bootstrap_diagnostic,
+)
+from photon_ml_tpu.diagnostics.reports import (
+    DiagnosticReport,
+    ModelDiagnosticReport,
+    SystemReport,
+)
+from photon_ml_tpu.diagnostics.html import render_html
+
+__all__ = [
+    "HosmerLemeshowReport",
+    "hosmer_lemeshow",
+    "FeatureImportanceReport",
+    "feature_importance",
+    "KendallTauReport",
+    "PredictionErrorIndependenceReport",
+    "kendall_tau",
+    "prediction_error_independence",
+    "FittingReport",
+    "fitting_diagnostic",
+    "BootstrapDiagnosticReport",
+    "bootstrap_diagnostic",
+    "DiagnosticReport",
+    "ModelDiagnosticReport",
+    "SystemReport",
+    "render_html",
+]

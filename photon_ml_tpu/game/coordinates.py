@@ -128,7 +128,9 @@ class FixedEffectCoordinate:
         return self.batch.num_features
 
     def initial_params(self) -> jax.Array:
-        return jnp.zeros((self.dim,), self.batch.features.dtype)
+        from photon_ml_tpu.models.training import solve_dtype
+
+        return jnp.zeros((self.dim,), solve_dtype(self.batch))
 
     def update(
         self, w: jax.Array, partial_scores: jax.Array, key=None
@@ -251,9 +253,11 @@ class RandomEffectCoordinate:
         return self.design.dim
 
     def initial_params(self) -> jax.Array:
+        from photon_ml_tpu.models.training import solve_dtype
+
         return jnp.zeros(
             (self.num_entities, self.dim),
-            self.design.buckets[0].features.dtype,
+            solve_dtype(self.design.buckets[0]),
         )
 
     def update(

@@ -21,8 +21,25 @@ from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
 
 
+def _read_label(rec: dict, i: int, allow_null_labels: bool) -> float:
+    """Label policy shared by GLM and GAME ingest: scoring input may carry
+    null labels (coerced to 0.0 when the caller opts in); training input
+    fails loudly rather than learn from silently-zeroed labels."""
+    v = rec.get("label")
+    if v is None:
+        if not allow_null_labels:
+            raise ValueError(
+                f"record {i} has a null/missing label; training input "
+                "requires labels (pass allow_null_labels=True only for "
+                "scoring)"
+            )
+        return 0.0
+    return v
+
+
 def _scalar_columns_and_triplets(
-    records: List[dict], vocab: FeatureVocabulary
+    records: List[dict], vocab: FeatureVocabulary,
+    allow_null_labels: bool = False,
 ):
     """Shared record walk for both representations.
 
@@ -43,7 +60,7 @@ def _scalar_columns_and_triplets(
     cols: List[int] = []
     vals: List[float] = []
     for i, rec in enumerate(records):
-        labels[i] = rec["label"]
+        labels[i] = _read_label(rec, i, allow_null_labels)
         if rec.get("offset") is not None:
             offsets[i] = rec["offset"]
         if rec.get("weight") is not None:
@@ -71,13 +88,16 @@ def _scalar_columns_and_triplets(
 def training_examples_to_arrays(
     records: List[dict],
     vocab: FeatureVocabulary,
+    allow_null_labels: bool = False,
 ) -> Dict[str, np.ndarray]:
     """TrainingExampleAvro dicts -> dense columnar arrays.
 
     Returns {features (n,d), labels, offsets, weights, uids}; duplicate
     (name, term) entries in one record sum (dedup-by-sum semantics).
     """
-    columns, (rows, cols, vals) = _scalar_columns_and_triplets(records, vocab)
+    columns, (rows, cols, vals) = _scalar_columns_and_triplets(
+        records, vocab, allow_null_labels=allow_null_labels
+    )
     x = np.zeros((len(records), len(vocab)), np.float64)
     np.add.at(x, (rows.astype(np.int64), cols.astype(np.int64)), vals)
     return {"features": x, **columns}
@@ -88,6 +108,7 @@ def training_examples_to_sparse(
     vocab: FeatureVocabulary,
     nnz_per_row: int = 0,
     dtype=None,
+    allow_null_labels: bool = False,
 ):
     """TrainingExampleAvro dicts -> (SparseFeatures, columns dict).
 
@@ -98,7 +119,9 @@ def training_examples_to_sparse(
 
     from photon_ml_tpu.ops.sparse import from_coo
 
-    columns, (rows, cols, vals) = _scalar_columns_and_triplets(records, vocab)
+    columns, (rows, cols, vals) = _scalar_columns_and_triplets(
+        records, vocab, allow_null_labels=allow_null_labels
+    )
     features = from_coo(
         rows,
         cols,
@@ -116,6 +139,7 @@ def game_data_from_avro(
     shard_vocabs: Dict[str, "FeatureVocabulary"],
     entity_keys: List[str],
     entity_vocabs: Optional[Dict[str, dict]] = None,
+    allow_null_labels: bool = False,
 ):
     """TrainingExampleAvro records -> (GameData, entity_vocabs, uids).
 
@@ -141,7 +165,7 @@ def game_data_from_avro(
     }
     raw_entities: Dict[str, List[str]] = {k: [] for k in entity_keys}
     for i, rec in enumerate(records):
-        labels[i] = rec.get("label", 0.0)
+        labels[i] = _read_label(rec, i, allow_null_labels)
         if rec.get("offset") is not None:
             offsets[i] = rec["offset"]
         if rec.get("weight") is not None:
@@ -197,12 +221,15 @@ def labeled_batch_from_avro(
     dtype=None,
     sparse: bool = False,
     nnz_per_row: int = 0,
+    allow_null_labels: bool = False,
 ) -> LabeledBatch:
     import jax.numpy as jnp
 
     if sparse:
         features, cols = training_examples_to_sparse(
-            records, vocab, nnz_per_row=nnz_per_row, dtype=dtype or jnp.float32
+            records, vocab, nnz_per_row=nnz_per_row,
+            dtype=dtype or jnp.float32,
+            allow_null_labels=allow_null_labels,
         )
         return LabeledBatch.create(
             features,
@@ -211,7 +238,9 @@ def labeled_batch_from_avro(
             weights=cols["weights"],
             dtype=dtype or jnp.float32,
         )
-    cols = training_examples_to_arrays(records, vocab)
+    cols = training_examples_to_arrays(
+        records, vocab, allow_null_labels=allow_null_labels
+    )
     return LabeledBatch.create(
         cols["features"],
         cols["labels"],

@@ -58,17 +58,22 @@ class BootstrapResult:
     metric_distributions: Dict[str, np.ndarray]  # name -> (num_replicas,)
 
 
-def _resample_weights(key, base_weights, mask, num_replicas: int):
-    """(R, n) multinomial bootstrap weights: each replica draws m rows with
-    replacement from the m unmasked rows (NOT the padded length — padding
-    must not inflate the effective sample size); a row's draw count
-    multiplies its weight. Total replica draw count == the real row count,
-    like the reference's sampleRDDWithReplacement."""
+def _resample_weights(
+    key, base_weights, mask, num_replicas: int, portion: float = 1.0
+):
+    """(R, n) multinomial bootstrap weights: each replica draws
+    ``portion * m`` rows with replacement from the m unmasked rows (NOT the
+    padded length — padding must not inflate the effective sample size); a
+    row's draw count multiplies its weight. At portion=1 the replica draw
+    count equals the real row count, like the reference's
+    sampleRDDWithReplacement; the bootstrap *diagnostic* uses portion=0.7
+    (``BootstrapTrainingDiagnostic.scala:146``)."""
     n = base_weights.shape[0]
     m = int(np.asarray(mask > 0).sum())
+    draws = max(1, int(round(m * portion)))
     logits = jnp.where(mask > 0, 0.0, -jnp.inf)
     idx = jax.random.categorical(
-        key, logits, shape=(num_replicas, m)
+        key, logits, shape=(num_replicas, draws)
     )
     counts = jax.vmap(lambda i: jnp.bincount(i, length=n))(idx)
     return base_weights * counts
@@ -81,6 +86,7 @@ def bootstrap_train_glm(
     seed: int = 0,
     confidence: float = 0.95,
     evaluation_batch: Optional[LabeledBatch] = None,
+    portion: float = 1.0,
 ) -> BootstrapResult:
     """Fit ``num_replicas`` bootstrap resamples of one training config
     (single reg weight) in one vmapped solve.
@@ -101,11 +107,12 @@ def bootstrap_train_glm(
 
     key = jax.random.PRNGKey(seed)
     weights_r = _resample_weights(
-        key, batch.weights * batch.mask, batch.mask, num_replicas
+        key, batch.weights * batch.mask, batch.mask, num_replicas, portion
     )
 
-    dtype = batch.features.dtype if not hasattr(batch.features, "values") \
-        else batch.features.values.dtype
+    from photon_ml_tpu.models.training import solve_dtype
+
+    dtype = solve_dtype(batch)
     w0 = jnp.zeros((batch.num_features,), dtype)
     lam_arr = jnp.asarray(lam, dtype)
 

@@ -190,6 +190,14 @@ def _build_solver(config: GLMTrainingConfig):
 _summarize_jit = jax.jit(summarize_features)
 
 
+def solve_dtype(batch: LabeledBatch):
+    """Solver-state dtype for a batch: at least float32. Features may be
+    stored bfloat16 (halved HBM + host->device bytes; the MXU upconverts
+    inside the matmul), but optimizer state, gradients, and line-search
+    scalars need f32 accumulation to converge to reference tolerances."""
+    return jnp.promote_types(batch.features.dtype, jnp.float32)
+
+
 def prepare_normalization(
     config: GLMTrainingConfig, batch: LabeledBatch
 ) -> NormalizationContext:
@@ -226,7 +234,7 @@ def train_glm(
     solve, variances_fn = _build_solver(config)
 
     d = batch.num_features
-    dtype = batch.features.dtype
+    dtype = solve_dtype(batch)
     if initial_coefficients is not None:
         w = norm.inverse_transform_model_coefficients(
             initial_coefficients, config.intercept_index

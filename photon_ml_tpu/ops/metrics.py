@@ -147,9 +147,15 @@ def peak_f1(labels, scores, weights):
 # -- information criteria (``Evaluation.scala:98-140``) ---------------------
 
 
-def akaike_information_criterion(total_loss_value, num_effective_params):
-    """AIC = 2k + 2 * negative-log-likelihood (total loss)."""
-    return 2.0 * num_effective_params + 2.0 * total_loss_value
+def akaike_information_criterion(total_loss_value, num_effective_params, n=None):
+    """AICc = 2k + 2 * negative-log-likelihood, plus the reference's
+    small-sample correction 2k(k+1)/(n-k-1) when n is given
+    (``Evaluation.scala:103-105``)."""
+    k = num_effective_params
+    base = 2.0 * k + 2.0 * total_loss_value
+    if n is None:
+        return base
+    return base + 2.0 * k * (k + 1) / (n - k - 1.0)
 
 
 def per_datum_log_likelihood(task, labels, margins, weights):
@@ -202,10 +208,21 @@ def evaluate(task, labels, margins, weights, num_effective_params=None):
         out[MEAN_ABSOLUTE_ERROR] = float(
             mean_absolute_error(labels, means, weights)
         )
-    total_ll = float(jnp.sum(per_datum_log_likelihood(task, labels, margins, weights)))
-    out[DATA_LOG_LIKELIHOOD] = total_ll / max(float(jnp.sum(weights)), 1e-30)
+    # Reference convention (``Evaluation.scala:91-105``): DATA_LOG_LIKELIHOOD
+    # is the UNWEIGHTED per-datum mean (sample weights do not scale it) and
+    # AIC is the small-sample-corrected AICc over mean * n. Zero-weight rows
+    # are padding, though — they must not enter n or the mean.
+    present = weights > 0
+    n = float(jnp.sum(present))
+    unweighted_ll = per_datum_log_likelihood(
+        task, labels, margins, present.astype(margins.dtype)
+    )
+    mean_ll = float(jnp.sum(unweighted_ll)) / n if n else 0.0
+    out[DATA_LOG_LIKELIHOOD] = mean_ll
     if num_effective_params is not None:
         out[AKAIKE_INFORMATION_CRITERION] = float(
-            akaike_information_criterion(-total_ll, num_effective_params)
+            akaike_information_criterion(
+                -mean_ll * n, num_effective_params, n=n
+            )
         )
     return out

@@ -136,6 +136,28 @@ class TestIngest:
         assert cols["features"][0, vocab.get("a")] == 3.5  # summed
         assert cols["features"][0, vocab.intercept_index] == 1.0
 
+    def test_null_label_scoring_vs_training(self):
+        # nullable-label Avro (the realistic scoring input): scoring opts
+        # in via allow_null_labels and gets 0.0; training fails loudly
+        rec = make_training_example(0.0, {("a", ""): 1.0})
+        rec["label"] = None
+        vocab = FeatureVocabulary([feature_key("a", "")])
+        cols = training_examples_to_arrays(
+            [rec], vocab, allow_null_labels=True
+        )
+        assert cols["labels"][0] == 0.0
+        with pytest.raises(ValueError, match="null/missing label"):
+            training_examples_to_arrays([rec], vocab)
+
+        from photon_ml_tpu.io.ingest import game_data_from_avro
+
+        data, _, _ = game_data_from_avro(
+            [rec], {"global": vocab}, [], allow_null_labels=True
+        )
+        assert np.asarray(data.labels)[0] == 0.0
+        with pytest.raises(ValueError, match="null/missing label"):
+            game_data_from_avro([rec], {"global": vocab}, [])
+
     def test_unknown_features_skipped(self):
         rec = make_training_example(1.0, {("known", ""): 1.0, ("junk", ""): 9.0})
         vocab = FeatureVocabulary([feature_key("known", "")])

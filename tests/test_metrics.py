@@ -104,6 +104,61 @@ class TestRegressionMetrics:
         )
 
 
+class TestEvaluateFacade:
+    def test_log_likelihood_ignores_weights(self, rng):
+        # reference convention (Evaluation.scala:91-103): DATA_LOG_LIKELIHOOD
+        # is the unweighted per-datum mean; AIC uses mean * n
+        from photon_ml_tpu.core.tasks import TaskType
+
+        y = (rng.uniform(size=200) < 0.5).astype(float)
+        m = rng.normal(size=200)
+        w = rng.uniform(0.1, 5.0, size=200)
+        out_w = metrics.evaluate(
+            TaskType.LOGISTIC_REGRESSION, jnp.asarray(y), jnp.asarray(m),
+            jnp.asarray(w), num_effective_params=3,
+        )
+        out_1 = metrics.evaluate(
+            TaskType.LOGISTIC_REGRESSION, jnp.asarray(y), jnp.asarray(m),
+            jnp.ones(200), num_effective_params=3,
+        )
+        assert out_w[metrics.DATA_LOG_LIKELIHOOD] == pytest.approx(
+            out_1[metrics.DATA_LOG_LIKELIHOOD]
+        )
+        # AICc = 2(k - mean_ll*n) + 2k(k+1)/(n-k-1)  (Evaluation.scala:103-105)
+        k, n = 3, 200
+        expected_aic = (
+            2 * (k - out_w[metrics.DATA_LOG_LIKELIHOOD] * n)
+            + 2 * k * (k + 1) / (n - k - 1)
+        )
+        assert out_w[metrics.AKAIKE_INFORMATION_CRITERION] == pytest.approx(
+            expected_aic
+        )
+
+    def test_log_likelihood_ignores_padding(self, rng):
+        # zero-weight rows are padding: they must not enter n or the mean
+        from photon_ml_tpu.core.tasks import TaskType
+
+        y = (rng.uniform(size=100) < 0.5).astype(float)
+        m = rng.normal(size=100)
+        base = metrics.evaluate(
+            TaskType.LOGISTIC_REGRESSION, jnp.asarray(y), jnp.asarray(m),
+            jnp.ones(100), num_effective_params=2,
+        )
+        y_pad = np.concatenate([y, np.zeros(30)])
+        m_pad = np.concatenate([m, rng.normal(size=30) * 50])
+        w_pad = np.concatenate([np.ones(100), np.zeros(30)])
+        padded = metrics.evaluate(
+            TaskType.LOGISTIC_REGRESSION, jnp.asarray(y_pad),
+            jnp.asarray(m_pad), jnp.asarray(w_pad), num_effective_params=2,
+        )
+        assert padded[metrics.DATA_LOG_LIKELIHOOD] == pytest.approx(
+            base[metrics.DATA_LOG_LIKELIHOOD]
+        )
+        assert padded[metrics.AKAIKE_INFORMATION_CRITERION] == pytest.approx(
+            base[metrics.AKAIKE_INFORMATION_CRITERION]
+        )
+
+
 class TestStats:
     def test_summary_matches_numpy(self, rng):
         x = rng.normal(size=(50, 7)) * 3 + 1
