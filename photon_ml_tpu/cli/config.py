@@ -52,7 +52,12 @@ class GLMDriverParams:
     overwrite: bool = False
     compute_variances: bool = False
     log_level: str = "DEBUG"
+    # model diagnostics (HL, error independence, importances) -> HTML
+    # report + DIAGNOSED stage; requires validate_input
     diagnostics: bool = False
+    # additionally run the EXPENSIVE training diagnostics: learning-curve
+    # refits + bootstrap CIs (``Params.trainingDiagnosticsEnabled``)
+    training_diagnostics: bool = False
     # float64 matches the reference's double-precision solves; silently
     # degrades to float32 when x64 is disabled (default on TPU backends)
     precision: str = "float64"
@@ -67,6 +72,15 @@ class GLMDriverParams:
         if self.date_range and self.date_range_days_ago:
             raise ValueError(
                 "date_range and date_range_days_ago are mutually exclusive"
+            )
+        if self.training_diagnostics and not self.diagnostics:
+            raise ValueError(
+                "training_diagnostics requires diagnostics=True"
+            )
+        if self.diagnostics and not self.validate_input:
+            raise ValueError(
+                "diagnostics requires validate_input (the model diagnostics "
+                "run against validation data, Driver.scala:424-474)"
             )
         self.to_training_config().validate()
 

@@ -110,6 +110,10 @@ def run_scoring(params) -> ScoringRun:
             )
             if params.model_path:
                 model_path = params.model_path
+                if not os.path.exists(model_path):
+                    raise FileNotFoundError(
+                        f"model_path {model_path!r} does not exist"
+                    )
             else:
                 model_path = os.path.join(params.model_dir, "best-model.avro")
             if not os.path.exists(model_path):

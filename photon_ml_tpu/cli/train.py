@@ -235,6 +235,34 @@ def run_glm_training(params) -> GLMTrainingRun:
             )
         tracker.advance(DriverStage.VALIDATED)
 
+    # ---- DIAGNOSE (``Driver.scala:424-474``) -----------------------------
+    if params.diagnostics:
+        tracker.assert_at_least(DriverStage.VALIDATED)
+        with timed(logger, "diagnose"):
+            from photon_ml_tpu.diagnostics.driver import (
+                build_diagnostic_report,
+            )
+            from photon_ml_tpu.diagnostics.html import render_html
+
+            report = build_diagnostic_report(
+                params_dict=dataclasses.asdict(params),
+                models=models,
+                validation_metrics=validation_metrics,
+                train_batch=batch,
+                validation_batch=vbatch,
+                vocab=vocab,
+                summary=summary,
+                training_config=cfg,
+                training_diagnostics=params.training_diagnostics,
+            )
+            report_path = os.path.join(
+                params.output_dir, "model-diagnostic.html"
+            )
+            with open(report_path, "w", encoding="utf-8") as f:
+                f.write(render_html(report))
+            logger.info(f"wrote diagnostic report to {report_path}")
+        tracker.advance(DriverStage.DIAGNOSED)
+
     # ---- OUTPUT ----------------------------------------------------------
     with timed(logger, "write models"):
         vocab.save(os.path.join(params.output_dir, "feature-index.txt"))
@@ -310,6 +338,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--tolerance", type=float)
     p.add_argument("--sparse", action="store_true", default=None)
     p.add_argument("--overwrite", action="store_true", default=None)
+    p.add_argument("--diagnostics", action="store_true", default=None)
+    p.add_argument(
+        "--training-diagnostics", action="store_true", default=None
+    )
     return p
 
 

@@ -1,0 +1,132 @@
+"""Driver-side diagnostic orchestration.
+
+Rebuild of ``Driver.diagnose()`` (``Driver.scala:424-474``) + the
+``writeDiagnostics`` HTML emission (``Driver.scala:549-569``): per trained
+model, run prediction-error independence, both feature importances, and
+(for logistic models) Hosmer–Lemeshow on the VALIDATION data; when
+training diagnostics are enabled, add the learning-curve fitting
+diagnostic and bootstrap confidence intervals over the TRAINING data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.diagnostics.bootstrap_diag import bootstrap_diagnostic
+from photon_ml_tpu.diagnostics.fitting import fitting_diagnostic
+from photon_ml_tpu.diagnostics.hl import hosmer_lemeshow
+from photon_ml_tpu.diagnostics.importance import feature_importance
+from photon_ml_tpu.diagnostics.independence import (
+    prediction_error_independence,
+)
+from photon_ml_tpu.diagnostics.reports import (
+    DiagnosticReport,
+    ModelDiagnosticReport,
+    SystemReport,
+)
+
+# beyond this many features the per-feature summary table is omitted from
+# the report (the numbers still live in feature-summary.tsv)
+MAX_SUMMARY_FEATURES = 200
+
+
+def build_diagnostic_report(
+    params_dict: Dict[str, object],
+    models,  # Sequence[TrainedModel]
+    validation_metrics: List[Dict[str, float]],
+    train_batch,
+    validation_batch,
+    vocab,
+    summary,
+    training_config,
+    training_diagnostics: bool = False,
+    seed: int = 0,
+) -> DiagnosticReport:
+    """Assemble the full DiagnosticReport for a completed training run."""
+    task: TaskType = training_config.task
+
+    summary_table = None
+    feature_names = None
+    if summary is not None and len(vocab) <= MAX_SUMMARY_FEATURES:
+        cols = ("mean", "variance", "min", "max", "mean_abs", "num_nonzeros")
+        summary_table = {
+            c: [float(v) for v in np.asarray(getattr(summary, c))]
+            for c in cols
+        }
+        feature_names = [
+            "{} / {}".format(*vocab.name_term(i))
+            for i in range(len(vocab))
+        ]
+
+    system = SystemReport(
+        params=params_dict,
+        num_features=len(vocab),
+        summary_table=summary_table,
+        feature_names=feature_names,
+    )
+    report = DiagnosticReport(system=system)
+
+    fit_by_lambda = {}
+    if training_diagnostics:
+        fit_by_lambda = fitting_diagnostic(
+            train_batch, training_config, seed=seed
+        )
+
+    vweights = np.asarray(validation_batch.effective_weights())
+    vlabels = np.asarray(validation_batch.labels)
+    for i, tm in enumerate(models):
+        means = np.asarray(
+            tm.model.compute_mean(
+                validation_batch.features, validation_batch.offsets
+            )
+        )
+        coef = np.asarray(tm.model.coefficients.means)
+        hl = None
+        if task == TaskType.LOGISTIC_REGRESSION:
+            hl = hosmer_lemeshow(
+                vlabels, means, num_dimensions=len(vocab), weights=vweights
+            )
+        bootstrap = None
+        if training_diagnostics:
+            single = dataclasses.replace(
+                training_config, reg_weights=(tm.reg_weight,)
+            )
+            bootstrap = bootstrap_diagnostic(
+                train_batch,
+                single,
+                coef,
+                vocab,
+                summary=summary,
+                evaluation_batch=validation_batch,
+                seed=seed,
+            )
+        report.models.append(
+            ModelDiagnosticReport(
+                model_description=(
+                    f"{task.name} @ lambda = {tm.reg_weight:g}"
+                ),
+                reg_weight=tm.reg_weight,
+                metrics=(
+                    validation_metrics[i]
+                    if i < len(validation_metrics)
+                    else {}
+                ),
+                prediction_error_independence=prediction_error_independence(
+                    vlabels, means, weights=vweights, seed=seed
+                ),
+                hosmer_lemeshow=hl,
+                mean_impact_importance=feature_importance(
+                    coef, vocab, summary, kind="EXPECTED_MAGNITUDE"
+                ),
+                variance_impact_importance=feature_importance(
+                    coef, vocab, summary, kind="VARIANCE"
+                ),
+                fit_report=fit_by_lambda.get(tm.reg_weight),
+                bootstrap_report=bootstrap,
+            )
+        )
+    return report

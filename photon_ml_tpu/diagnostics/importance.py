@@ -104,12 +104,16 @@ def feature_importance(
             )
         )
 
-    # importance at evenly spaced ranks, reported by fractile percent
-    # (``AbstractFeatureImportanceDiagnostic.scala:94-103``)
+    # importance at evenly spaced ranks, reported by fractile percent.
+    # Intentional divergence: ``AbstractFeatureImportanceDiagnostic.scala:94-97``
+    # divides the rank by MAX_RANKED_FEATURES (50) while iterating 0..100
+    # fractiles, so its curve saturates at the minimum importance beyond the
+    # 50% fractile — an apparent bug; we use the fractile count so the curve
+    # spans the whole ranking.
     sorted_imp = importance[order]
     rank_to_importance = {}
     for f in range(NUM_IMPORTANCE_FRACTILES + 1):
-        pos = min(d - 1, f * d // MAX_RANKED_FEATURES)
+        pos = min(d - 1, f * d // NUM_IMPORTANCE_FRACTILES)
         rank_to_importance[100.0 * f / NUM_IMPORTANCE_FRACTILES] = float(
             sorted_imp[pos]
         )

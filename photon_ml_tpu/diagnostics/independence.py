@@ -57,6 +57,12 @@ def kendall_tau(a, b) -> KendallTauReport:
     standard tau variance approximation, and the two-sided-mass "p value"
     convention the reference uses (cdf(|z|) - cdf(-|z|): LARGE means
     dependence detected).
+
+    Note: the reference classifies each pair into exactly one category
+    with ties in the FIRST variable taking precedence, so pairs tied in
+    BOTH variables count toward Ta but never Tb. Its tau_beta therefore
+    differs slightly from the textbook/scipy tau-b whenever double ties
+    exist; we reproduce the reference's arithmetic.
     """
     a = np.asarray(a, np.float64)
     b = np.asarray(b, np.float64)

@@ -153,7 +153,9 @@ def akaike_information_criterion(total_loss_value, num_effective_params, n=None)
     (``Evaluation.scala:103-105``)."""
     k = num_effective_params
     base = 2.0 * k + 2.0 * total_loss_value
-    if n is None:
+    if n is None or n <= k + 1:
+        # the correction's denominator n-k-1 is <= 0: AICc is undefined in
+        # this regime, fall back to the uncorrected AIC
         return base
     return base + 2.0 * k * (k + 1) / (n - k - 1.0)
 

@@ -82,6 +82,9 @@ class SolverResult:
     reason: jax.Array  # int32 ConvergenceReason code
     values: jax.Array  # (max_iters+1,) objective per iteration
     grad_norms: jax.Array  # (max_iters+1,) ||grad|| per iteration
+    # total inner CG iterations == Hessian-vector products (TRON only;
+    # None for first-order solvers). Feeds FLOP/MFU accounting.
+    cg_iterations: Optional[jax.Array] = None
 
 
 def project_to_hypercube(

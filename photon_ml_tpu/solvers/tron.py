@@ -126,7 +126,7 @@ def _truncated_cg(
     final = lax.while_loop(
         lambda s: (~s.done) & (s.i < max_cg), body, init
     )
-    return final.step, final.r
+    return final.step, final.r, final.i
 
 
 class _TronState(NamedTuple):
@@ -141,6 +141,7 @@ class _TronState(NamedTuple):
     grad_norm_initial: jax.Array
     values: jax.Array
     grad_norms: jax.Array
+    cg_total: jax.Array
 
 
 def minimize_tron(
@@ -172,10 +173,11 @@ def minimize_tron(
         grad_norm_initial=gnorm0,
         values=values,
         grad_norms=grad_norms,
+        cg_total=jnp.int32(0),
     )
 
     def body(s: _TronState) -> _TronState:
-        step, r = _truncated_cg(
+        step, r, cg_iters = _truncated_cg(
             lambda v: hvp_fn(s.w, v),
             s.grad,
             s.delta,
@@ -261,6 +263,7 @@ def minimize_tron(
             grad_norm_initial=s.grad_norm_initial,
             values=values,
             grad_norms=grad_norms,
+            cg_total=s.cg_total + cg_iters,
         )
 
     final = lax.while_loop(
@@ -274,4 +277,5 @@ def minimize_tron(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        cg_iterations=final.cg_total,
     )

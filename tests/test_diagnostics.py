@@ -1,0 +1,361 @@
+"""Diagnostics subsystem: statistical kernels vs oracles + driver e2e.
+
+Oracles: scipy.stats.kendalltau (tau-b), scipy.stats.chi2, hand-computed
+HL tables, and behavioral checks (learning curves improve with data,
+bootstrap intervals cover the full-data fit).
+"""
+
+import dataclasses
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.diagnostics import (
+    bootstrap_diagnostic,
+    feature_importance,
+    fitting_diagnostic,
+    hosmer_lemeshow,
+    kendall_tau,
+    prediction_error_independence,
+    render_html,
+)
+from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+from photon_ml_tpu.models import (
+    GLMTrainingConfig,
+    OptimizerType,
+    TaskType,
+    train_glm,
+)
+from photon_ml_tpu.ops import RegularizationContext
+from photon_ml_tpu.ops.stats import summarize_features
+
+
+def _vocab(d, intercept=False):
+    return FeatureVocabulary(
+        [feature_key(f"f{j}", "") for j in range(d)], add_intercept=intercept
+    )
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_vs_inverted(self, rng):
+        # the reference scores bins against their MIDPOINT probability, so
+        # exact calibration at the midpoints gives an unremarkable chi^2
+        # while inverted predictions give an enormous one
+        n, bins = 24000, 12  # d=10 -> 12 bins
+        mids = (np.arange(bins) + 0.5) / bins
+        p = mids[rng.integers(0, bins, size=n)]
+        y = (rng.uniform(size=n) < p).astype(float)
+        calibrated = hosmer_lemeshow(y, p, num_dimensions=10)
+        inverted = hosmer_lemeshow(1.0 - y, p, num_dimensions=10)
+        assert calibrated.degrees_of_freedom == 10
+        assert sum(b.total for b in calibrated.bins) == n
+        assert calibrated.chi_square < inverted.chi_square / 20
+        assert inverted.p_value < 1e-6
+
+    def test_expected_counts_match_hand_table(self):
+        # one bin [0, 1) (1 sample, 0 dims -> by_dim=2, by_data=1)
+        y = np.array([1.0])
+        p = np.array([0.5])
+        rep = hosmer_lemeshow(y, p, num_dimensions=0)
+        assert len(rep.bins) == 1
+        b = rep.bins[0]
+        # midpoint 0.5, total 1 -> expected_pos = ceil(0.5) = 1
+        assert b.expected_pos == 1
+        assert b.expected_neg == 0
+
+    def test_padding_rows_dropped(self, rng):
+        n = 5000
+        p = rng.uniform(size=n)
+        y = (rng.uniform(size=n) < p).astype(float)
+        base = hosmer_lemeshow(y, p, num_dimensions=5)
+        y2 = np.concatenate([y, np.ones(100)])
+        p2 = np.concatenate([p, np.full(100, 0.01)])
+        w2 = np.concatenate([np.ones(n), np.zeros(100)])
+        padded = hosmer_lemeshow(y2, p2, num_dimensions=5, weights=w2)
+        assert padded.chi_square == pytest.approx(base.chi_square)
+
+    def test_cutoffs_monotone(self, rng):
+        rep = hosmer_lemeshow(
+            np.array([0.0, 1.0] * 50), np.linspace(0.01, 0.99, 100), 3
+        )
+        values = [c for _, c in rep.cutoffs]
+        assert values == sorted(values)
+
+
+class TestKendallTau:
+    def test_tau_beta_matches_scipy(self, rng):
+        from scipy.stats import kendalltau
+
+        a = rng.normal(size=300)
+        b = 0.5 * a + rng.normal(size=300)
+        rep = kendall_tau(a, b)
+        ref, _ = kendalltau(a, b)
+        assert rep.tau_beta == pytest.approx(float(ref), abs=1e-12)
+
+    def test_tau_with_ties_matches_bruteforce(self, rng):
+        # with ties, the reference's one-category-per-pair bookkeeping
+        # (tie-in-A wins) diverges from scipy's tau-b; oracle is an
+        # independent O(n^2) loop implementing the Scala checkConcordance
+        a = np.round(rng.normal(size=60), 1)
+        b = np.round(0.3 * a + rng.normal(size=60), 1)
+        C = D = Ta = Tb = 0
+        m = len(a)
+        for i in range(m):
+            for j in range(i + 1, m):
+                if a[i] == a[j]:
+                    Ta += 1
+                elif b[i] == b[j]:
+                    Tb += 1
+                elif (a[i] - a[j]) * (b[i] - b[j]) > 0:
+                    C += 1
+                else:
+                    D += 1
+        rep = kendall_tau(a, b)
+        assert (rep.num_concordant, rep.num_discordant) == (C, D)
+        P = m * (m - 1) // 2
+        expected_beta = (C - D) / np.sqrt(float(P - Ta) * float(P - Tb))
+        assert rep.tau_beta == pytest.approx(expected_beta, abs=1e-12)
+        assert rep.message  # tie warning fires
+
+    def test_independent_low_dependence_signal(self, rng):
+        a = rng.normal(size=500)
+        b = rng.normal(size=500)
+        rep = kendall_tau(a, b)
+        # reference p-value convention: LARGE = dependence detected
+        assert rep.p_value < 0.95
+        assert abs(rep.tau_alpha) < 0.1
+
+    def test_pair_bookkeeping(self):
+        rep = kendall_tau([1.0, 2.0, 3.0], [1.0, 2.0, 3.0])
+        assert rep.num_pairs == 3
+        assert rep.num_concordant == 3
+        assert rep.num_discordant == 0
+        assert rep.tau_alpha == 1.0
+
+    def test_prediction_error_sampling_cap(self, rng):
+        y = rng.normal(size=7000)
+        p = rng.normal(size=7000)
+        rep = prediction_error_independence(y, p, max_sample=1000)
+        assert rep.kendall_tau.num_items == 1000
+        assert rep.errors.shape == (1000,)
+
+
+class TestFeatureImportance:
+    def test_orders_by_coef_times_meanabs(self, rng):
+        d = 6
+        x = rng.normal(size=(100, d)) * np.array([1, 10, 1, 1, 1, 1.0])
+        batch = LabeledBatch.create(x, np.zeros(100), dtype=jnp.float64)
+        summary = summarize_features(batch)
+        coef = np.array([5.0, 1.0, 0.0, -2.0, 0.1, 0.0])
+        rep = feature_importance(
+            coef, _vocab(d), summary, kind="EXPECTED_MAGNITUDE"
+        )
+        # feature 1: |1| * meanAbs(~8) dominates feature 0: |5| * ~0.8
+        assert rep.features[0].index == 1
+        imps = [f.importance for f in rep.features]
+        assert imps == sorted(imps, reverse=True)
+
+    def test_fallback_without_summary(self):
+        coef = np.array([1.0, -3.0, 2.0])
+        rep = feature_importance(coef, _vocab(3), None, kind="VARIANCE")
+        assert rep.features[0].index == 1
+        assert rep.importance_description == "Magnitude of feature coefficient"
+
+    def test_variance_kind_uses_variance(self, rng):
+        d = 3
+        x = rng.normal(size=(500, d)) * np.array([1.0, 1.0, 20.0])
+        batch = LabeledBatch.create(x, np.zeros(500), dtype=jnp.float64)
+        summary = summarize_features(batch)
+        coef = np.array([1.0, 1.0, 0.5])
+        rep = feature_importance(coef, _vocab(d), summary, kind="VARIANCE")
+        assert rep.features[0].index == 2  # variance ~400 * 0.5 wins
+
+
+def _click_batch(rng, n, d, noise=0.0):
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    logits = x @ w + noise * rng.normal(size=n)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(float)
+    return LabeledBatch.create(x, y, dtype=jnp.float64), w
+
+
+class TestFittingDiagnostic:
+    def test_curves_shape_and_improvement(self, rng):
+        batch, _ = _click_batch(rng, 4000, 8)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0, 0.1),
+            max_iters=50,
+            track_states=False,
+        )
+        out = fitting_diagnostic(batch, cfg, seed=3)
+        assert set(out) == {1.0, 0.1}
+        rep = out[1.0]
+        from photon_ml_tpu.ops.metrics import (
+            AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS as AUC,
+        )
+
+        portions, train, test = rep.metrics[AUC]
+        assert len(portions) == 9  # cumulative 10%..90%
+        assert np.all(np.diff(portions) > 0)
+        # holdout AUC at 90% of data should beat 10% of data
+        assert test[-1] > test[0] - 0.02
+
+    def test_too_little_data_returns_empty(self, rng):
+        batch, _ = _click_batch(rng, 50, 8)  # 50 <= 8*10
+        cfg = GLMTrainingConfig(reg_weights=(1.0,), track_states=False)
+        assert fitting_diagnostic(batch, cfg) == {}
+
+
+class TestBootstrapDiagnostic:
+    def test_intervals_cover_full_fit(self, rng):
+        batch, _ = _click_batch(rng, 3000, 5)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=50,
+            track_states=False,
+        )
+        (tm,) = train_glm(batch, cfg)
+        coef = np.asarray(tm.model.coefficients.means)
+        rep = bootstrap_diagnostic(
+            batch, cfg, coef, _vocab(5), num_replicas=8, seed=1
+        )
+        assert len(rep.important_features) == 5
+        for ci in rep.important_features:
+            assert ci.min <= ci.q1 <= ci.median <= ci.q3 <= ci.max
+            # the full-data fit should land inside the replica range
+            assert ci.min - 0.5 <= coef[ci.index] <= ci.max + 0.5
+        from photon_ml_tpu.ops.metrics import (
+            AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS as AUC,
+        )
+
+        assert AUC in rep.metric_distributions
+
+    def test_straddling_zero_detects_null_features(self, rng):
+        n, d = 1500, 8
+        x = rng.normal(size=(n, d))
+        w = np.array([3.0, -3.0] + [0.0] * 6)  # features 2..7 pure noise
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w))).astype(float)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=50,
+            track_states=False,
+        )
+        (tm,) = train_glm(batch, cfg)
+        rep = bootstrap_diagnostic(
+            batch,
+            cfg,
+            np.asarray(tm.model.coefficients.means),
+            _vocab(d),
+            num_replicas=24,
+            seed=2,
+        )
+        straddlers = {ci.index for ci in rep.straddling_zero}
+        # discriminative features never straddle; some noise feature does
+        assert straddlers
+        assert not straddlers & {0, 1}
+        assert straddlers <= {2, 3, 4, 5, 6, 7}
+
+
+class TestDriverDiagnose:
+    def _write_avro(self, tmp_path, rng, n=800, d=4, subdir="train"):
+        from photon_ml_tpu.io.avro import write_avro_file
+        from photon_ml_tpu.io.ingest import make_training_example
+        from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        x = rng.normal(size=(n, d))
+        w = np.array([2.0, -2.0, 1.0, 0.0])[:d]
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w))).astype(float)
+        recs = [
+            make_training_example(
+                y[i], {(f"f{j}", ""): x[i, j] for j in range(d)}
+            )
+            for i in range(n)
+        ]
+        path = tmp_path / subdir
+        path.mkdir()
+        write_avro_file(
+            str(path / "part-0.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+        )
+        return str(path)
+
+    def test_diagnosed_stage_and_report_contents(self, tmp_path, rng):
+        from photon_ml_tpu.cli.stages import DriverStage
+        from photon_ml_tpu.cli.train import run_glm_training
+
+        train = self._write_avro(tmp_path, rng, n=800, subdir="train")
+        validate = self._write_avro(tmp_path, rng, n=400, subdir="validate")
+        out = str(tmp_path / "out")
+        run = run_glm_training(
+            {
+                "train_input": [train],
+                "validate_input": [validate],
+                "output_dir": out,
+                "task": "LOGISTIC_REGRESSION",
+                "optimizer": "LBFGS",
+                "reg_type": "L2",
+                "reg_weights": [10.0, 1.0],
+                "max_iters": 40,
+                "add_intercept": False,
+                "diagnostics": True,
+                "training_diagnostics": True,
+            }
+        )
+        assert DriverStage.DIAGNOSED in run.stages
+        report_path = os.path.join(out, "model-diagnostic.html")
+        assert os.path.exists(report_path)
+        html = open(report_path).read()
+        # one chapter per lambda
+        assert "LOGISTIC_REGRESSION @ lambda = 10" in html
+        assert "LOGISTIC_REGRESSION @ lambda = 1" in html
+        # every diagnostic section made it into the artifact
+        assert "Hosmer&ndash;Lemeshow" in html
+        assert "Kendall tau" in html
+        assert "inner-product expectation" in html
+        assert "inner-product variance" in html
+        assert "Learning curves" in html
+        assert "Bootstrap (" in html
+        assert "<svg" in html  # learning-curve plots rendered
+        # HL table carries real bin counts
+        assert "Observed +" in html
+
+    def test_diagnostics_requires_validation(self, tmp_path, rng):
+        from photon_ml_tpu.cli.train import run_glm_training
+
+        train = self._write_avro(tmp_path, rng, n=200, subdir="train")
+        with pytest.raises(ValueError, match="diagnostics requires"):
+            run_glm_training(
+                {
+                    "train_input": [train],
+                    "output_dir": str(tmp_path / "out"),
+                    "diagnostics": True,
+                }
+            )
+
+
+class TestHtmlRenderer:
+    def test_empty_report_renders(self):
+        from photon_ml_tpu.diagnostics.reports import (
+            DiagnosticReport,
+            SystemReport,
+        )
+
+        doc = render_html(
+            DiagnosticReport(
+                system=SystemReport(params={"a": 1}, num_features=3)
+            )
+        )
+        assert doc.startswith("<!DOCTYPE html>")
+        assert "Feature space: 3 columns" in doc
