@@ -48,9 +48,18 @@ class GLMDriverParams:
     constraint_file: Optional[str] = None  # coefficient bounds JSON
     date_range: Optional[str] = None  # "yyyymmdd-yyyymmdd"
     date_range_days_ago: Optional[str] = None  # "N-M"
+    # Avro field-name set of the input records
+    # (``avro/FieldNamesType.scala:20``): TRAINING_EXAMPLE | RESPONSE_PREDICTION
+    field_names: str = "TRAINING_EXAMPLE"
     model_output_mode: str = "ALL"
     overwrite: bool = False
     compute_variances: bool = False
+    # evaluate every optimizer iteration's model snapshot on the validation
+    # data (``Driver.scala:293-347`` validatePerIteration + ModelTracker)
+    validate_per_iteration: bool = False
+    # warm-start: directory of a previous GLM run; its best-model.avro (or
+    # an explicit .avro path) seeds every solve (``ModelTraining.scala:95-141``)
+    initial_model_dir: Optional[str] = None
     log_level: str = "DEBUG"
     # model diagnostics (HL, error independence, importances) -> HTML
     # report + DIAGNOSED stage; requires validate_input
@@ -77,6 +86,10 @@ class GLMDriverParams:
             raise ValueError(
                 "training_diagnostics requires diagnostics=True"
             )
+        if self.validate_per_iteration and not self.validate_input:
+            raise ValueError(
+                "validate_per_iteration requires validate_input"
+            )
         if self.diagnostics and not self.validate_input:
             raise ValueError(
                 "diagnostics requires validate_input (the model diagnostics "
@@ -98,6 +111,7 @@ class GLMDriverParams:
             max_iters=self.max_iters,
             tolerance=self.tolerance,
             compute_variances=self.compute_variances,
+            track_models=self.validate_per_iteration,
             # set by the driver once the vocabulary exists
             intercept_index=None,
         )
@@ -122,6 +136,18 @@ class CoordinateSpec:
     active_cap: Optional[int] = None
     num_buckets: int = 4
     projector: Optional[str] = None  # RANDOM=<k> | INDEX_MAP | IDENTITY
+    # per-entity Pearson feature selection: keep at most
+    # ceil(ratio * numSamples_e) features per entity
+    # (``RandomEffectDataConfiguration.numFeaturesToSamplesRatioUpperBound``)
+    feature_ratio: Optional[float] = None
+    # factored random effect (w_e = B gamma_e): set latent_dim to enable
+    # (``MFOptimizationConfiguration`` "numInnerIter,latentDim" + the
+    # latent-matrix sub-config of the reference's triple-config string)
+    latent_dim: Optional[int] = None
+    num_inner_iterations: int = 1
+    latent_reg_weight: Optional[float] = None  # default: reg weight
+    latent_max_iters: Optional[int] = None  # default: max_iters
+    latent_tolerance: Optional[float] = None  # default: tolerance
 
 
 @dataclasses.dataclass
@@ -142,6 +168,7 @@ class GameDriverParams:
     add_intercept: bool = True
     date_range: Optional[str] = None
     date_range_days_ago: Optional[str] = None
+    field_names: str = "TRAINING_EXAMPLE"
     model_output_mode: str = "BEST"
     overwrite: bool = False
     log_level: str = "DEBUG"
@@ -150,6 +177,11 @@ class GameDriverParams:
     # (0 = disabled); resume=True continues a previous run in-place
     checkpoint_every: int = 0
     resume: bool = False
+    # warm-start: root of a previously saved GAME model (best/ or all/<i>)
+    initial_model_dir: Optional[str] = None
+    # merge coordinates sharing (effect type, shard) by coefficient
+    # addition at save (``ModelProcessingUtils.collapseGameModel``)
+    collapse_output: bool = False
 
     def validate(self) -> None:
         if not self.train_input:
@@ -174,6 +206,18 @@ class GameDriverParams:
             raise ValueError(
                 f"at most one fixed-effect coordinate supported, got {fixed}"
             )
+        if self.collapse_output:
+            factored = [
+                n
+                for n, c in self.coordinates.items()
+                if c.latent_dim is not None
+            ]
+            if factored:
+                raise ValueError(
+                    f"collapse_output cannot merge factored coordinates "
+                    f"{factored} (ModelProcessingUtils.scala:235-236); "
+                    "failing before training rather than at save"
+                )
         if self.resume and self.checkpoint_every <= 0:
             raise ValueError(
                 "resume=True requires checkpoint_every > 0; without "
@@ -207,6 +251,7 @@ class ScoringParams:
     sparse: bool = False
     date_range: Optional[str] = None
     date_range_days_ago: Optional[str] = None
+    field_names: str = "TRAINING_EXAMPLE"
     overwrite: bool = False
     log_level: str = "DEBUG"
 

@@ -46,6 +46,17 @@ from photon_ml_tpu.game import (
     build_bucketed_random_effect_design,
 )
 from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.factored import (
+    FactoredConfig,
+    FactoredRandomEffectCoordinate,
+)
+from photon_ml_tpu.game.projected import (
+    ProjectedRandomEffectCoordinate,
+    build_index_map_columns,
+    parse_projector_spec,
+    project_design_and_rows,
+)
+from photon_ml_tpu.game.projectors import build_random_projection
 from photon_ml_tpu.game.scoring import score_game_data
 from photon_ml_tpu.io.ingest import game_data_from_avro
 from photon_ml_tpu.io.models import save_game_model
@@ -80,8 +91,14 @@ def build_coordinates(
     reg_combo: Dict[str, float],
     entity_counts: Dict[str, int],
     dtype=jnp.float64,
+    shard_vocabs: Optional[Dict[str, FeatureVocabulary]] = None,
+    design_cache: Optional[Dict[str, object]] = None,
 ):
-    """One training coordinate per updating-sequence entry."""
+    """One training coordinate per updating-sequence entry.
+
+    ``design_cache`` (coordinate name -> built design) carries the
+    combo-invariant bucketing/feature-selection work across a reg-weight
+    grid — designs depend on data + data knobs, never on lambda."""
     coords = {}
     for name in params.updating_sequence:
         spec = params.coordinates[name]
@@ -91,23 +108,131 @@ def build_coordinates(
                 data.fixed_effect_batch(spec.shard, dtype), cfg
             )
         else:
-            design = build_bucketed_random_effect_design(
-                data,
-                spec.random_effect,
-                spec.shard,
-                entity_counts[spec.random_effect],
-                num_buckets=spec.num_buckets,
-                active_cap=spec.active_cap,
-                dtype=dtype,
+            if design_cache is not None and name in design_cache:
+                design = design_cache[name]
+            else:
+                design = build_bucketed_random_effect_design(
+                    data,
+                    spec.random_effect,
+                    spec.shard,
+                    entity_counts[spec.random_effect],
+                    num_buckets=spec.num_buckets,
+                    active_cap=spec.active_cap,
+                    dtype=dtype,
+                    feature_ratio=spec.feature_ratio,
+                )
+                if design_cache is not None:
+                    design_cache[name] = design
+            row_features = jnp.asarray(data.features[spec.shard], dtype)
+            row_entities = jnp.asarray(data.entity_ids[spec.random_effect])
+            offsets_base = jnp.asarray(data.offsets, dtype)
+            if spec.latent_dim is not None:
+                if spec.projector:
+                    raise ValueError(
+                        f"coordinate {name!r}: latent_dim (factored) and "
+                        "projector are mutually exclusive"
+                    )
+                latent_cfg = dataclasses.replace(
+                    cfg,
+                    reg_weight=(
+                        spec.latent_reg_weight
+                        if spec.latent_reg_weight is not None
+                        else cfg.reg_weight
+                    ),
+                    max_iters=(
+                        spec.latent_max_iters
+                        if spec.latent_max_iters is not None
+                        else cfg.max_iters
+                    ),
+                    tolerance=(
+                        spec.latent_tolerance
+                        if spec.latent_tolerance is not None
+                        else cfg.tolerance
+                    ),
+                )
+                coords[name] = FactoredRandomEffectCoordinate(
+                    design=design,
+                    row_features=row_features,
+                    row_entities=row_entities,
+                    full_offsets_base=offsets_base,
+                    re_config=cfg,
+                    factored=FactoredConfig(
+                        latent_dim=spec.latent_dim,
+                        num_inner_iterations=spec.num_inner_iterations,
+                        latent_factor_config=latent_cfg,
+                    ),
+                )
+                continue
+            kind, k = (
+                parse_projector_spec(spec.projector)
+                if spec.projector
+                else ("IDENTITY", None)
             )
-            coords[name] = RandomEffectCoordinate(
-                design=design,
-                row_features=jnp.asarray(data.features[spec.shard], dtype),
-                row_entities=jnp.asarray(data.entity_ids[spec.random_effect]),
-                full_offsets_base=jnp.asarray(data.offsets, dtype),
-                config=cfg,
-            )
+            if kind == "IDENTITY":
+                coords[name] = RandomEffectCoordinate(
+                    design=design,
+                    row_features=row_features,
+                    row_entities=row_entities,
+                    full_offsets_base=offsets_base,
+                    config=cfg,
+                )
+            else:
+                d_orig = data.features[spec.shard].shape[1]
+                cache_key = f"{name}\x00projected"
+                if design_cache is not None and cache_key in design_cache:
+                    projector, prebuilt = design_cache[cache_key]
+                else:
+                    if kind == "RANDOM":
+                        # intercept passthrough row: per-entity base rates
+                        # stay exactly representable
+                        # (``ProjectionMatrix.scala:96-126``)
+                        icpt = (
+                            shard_vocabs[spec.shard].intercept_index
+                            if shard_vocabs and spec.shard in shard_vocabs
+                            else None
+                        )
+                        projector = build_random_projection(
+                            d_orig, k, seed=0, intercept_index=icpt,
+                            dtype=dtype,
+                        )
+                    else:  # INDEX_MAP
+                        projector = build_index_map_columns(
+                            data,
+                            spec.random_effect,
+                            spec.shard,
+                            entity_counts[spec.random_effect],
+                        )
+                    prebuilt = project_design_and_rows(
+                        design, row_features, row_entities, projector
+                    )
+                    if design_cache is not None:
+                        design_cache[cache_key] = (projector, prebuilt)
+                coords[name] = ProjectedRandomEffectCoordinate(
+                    design=design,
+                    row_features=row_features,
+                    row_entities=row_entities,
+                    full_offsets_base=offsets_base,
+                    config=cfg,
+                    projector=projector,
+                    original_dim=d_orig,
+                    prebuilt=prebuilt,
+                )
     return coords
+
+
+def materialize_original_space(model: GameModel, coords: Dict) -> GameModel:
+    """Back-project any projected coordinate's table so the model is in
+    original feature space (``RandomEffectModelInProjectedSpace.scala:31-97``
+    — persistence and scoring never see projected coefficients)."""
+    params = {
+        n: (
+            coords[n].back_project(p)
+            if isinstance(coords.get(n), ProjectedRandomEffectCoordinate)
+            else p
+        )
+        for n, p in model.params.items()
+    }
+    return dataclasses.replace(model, params=params)
 
 
 @dataclasses.dataclass
@@ -140,8 +265,13 @@ def run_game_training(params) -> GameTrainingRun:
 
     # ---- prepare feature maps + dataset ---------------------------------
     with timed(logger, "prepare data"):
+        from photon_ml_tpu.io.ingest import normalize_field_names
+
         date_range = resolve_date_range(params)
-        records = read_records(expand_date_paths(params.train_input, date_range))
+        records = normalize_field_names(
+            read_records(expand_date_paths(params.train_input, date_range)),
+            params.field_names,
+        )
         logger.info(f"read {len(records)} training records")
 
         shard_ids = {
@@ -188,8 +318,11 @@ def run_game_training(params) -> GameTrainingRun:
 
         vdata = None
         if params.validate_input:
-            vrecords = read_records(
-                expand_date_paths(params.validate_input, date_range)
+            vrecords = normalize_field_names(
+                read_records(
+                    expand_date_paths(params.validate_input, date_range)
+                ),
+                params.field_names,
             )
             vdata, _, _ = game_data_from_avro(
                 vrecords, shard_vocabs, entity_keys, entity_vocabs=entity_vocabs
@@ -223,12 +356,74 @@ def run_game_training(params) -> GameTrainingRun:
             metrics_mod.root_mean_squared_error(labels, margins, weights)
         )
 
+    # warm-start tables from a previously saved model
+    # (``ModelTraining.scala:95-141``'s warm-start semantics on the GAME
+    # driver): rows remap by raw entity id into THIS run's entity vocab
+    warm_params: Dict[str, np.ndarray] = {}
+    if params.initial_model_dir:
+        from photon_ml_tpu.io.models import load_game_model
+
+        coord_vocabs = {
+            n: shard_vocabs[shards_by_coord[n]]
+            for n in params.updating_sequence
+        }
+        init_evocabs = {
+            n: entity_vocabs[res_by_coord[n]]
+            for n in params.updating_sequence
+            if res_by_coord[n] is not None
+        }
+        loaded, _, _, _ = load_game_model(
+            params.initial_model_dir, coord_vocabs, init_evocabs
+        )
+        for n, p in loaded.items():
+            if n in params.coordinates:
+                warm_params[n] = p
+        logger.info(
+            f"warm-starting coordinates {sorted(warm_params)} from "
+            f"{params.initial_model_dir}"
+        )
+
     sweep: List[dict] = []
+    design_cache: Dict[str, object] = {}
     for combo_index, combo in enumerate(params.grid()):
         with timed(logger, f"train combo {combo}"):
             coords = build_coordinates(
-                params, data, task, combo, entity_counts, dtype=dtype
+                params, data, task, combo, entity_counts, dtype=dtype,
+                shard_vocabs=shard_vocabs, design_cache=design_cache,
             )
+            initial_model = None
+            if warm_params:
+                init = {}
+                for n in params.updating_sequence:
+                    p = warm_params.get(n)
+                    coord = coords[n]
+                    plain_coord = not isinstance(
+                        coord, ProjectedRandomEffectCoordinate
+                    ) and not hasattr(coord, "factored")
+                    if p is not None and not hasattr(p, "gamma") and plain_coord:
+                        init[n] = jnp.asarray(np.asarray(p), dtype)
+                        continue
+                    if (
+                        p is not None
+                        and hasattr(p, "gamma")
+                        and hasattr(coord, "factored")
+                        and np.asarray(p.gamma).shape[1]
+                        == coord.factored.latent_dim
+                    ):
+                        init[n] = type(p)(
+                            gamma=jnp.asarray(np.asarray(p.gamma), dtype),
+                            projection=jnp.asarray(
+                                np.asarray(p.projection), dtype
+                            ),
+                        )
+                        continue
+                    if p is not None:
+                        logger.warn(
+                            f"coordinate {n}: saved params do not match the "
+                            "coordinate kind/latent dim; cold-starting it"
+                        )
+                    init[n] = coord.initial_params()
+                initial_model = GameModel(params=init)
             cd = CoordinateDescent(
                 coordinates=coords,
                 labels=jnp.asarray(data.labels, dtype),
@@ -236,8 +431,14 @@ def run_game_training(params) -> GameTrainingRun:
                 weights=jnp.asarray(data.weights, dtype),
                 task=task,
             )
+            # validation (like persistence) always sees original-space
+            # coefficients; projected tables are back-projected first
             vfn = (
-                validation_metric
+                (
+                    lambda model, _coords=coords: validation_metric(
+                        materialize_original_space(model, _coords)
+                    )
+                )
                 if (vdata is not None and params.validate_per_coordinate)
                 else None
             )
@@ -252,6 +453,7 @@ def run_game_training(params) -> GameTrainingRun:
             )
             model, history = cd.run(
                 params.num_iterations,
+                initial_model=initial_model,
                 validation_fn=vfn,
                 checkpoint_dir=ckpt_dir,
                 checkpoint_every=max(params.checkpoint_every, 1),
@@ -268,6 +470,7 @@ def run_game_training(params) -> GameTrainingRun:
                     )
                     + f" ({h.seconds:.2f}s)"
                 )
+            model = materialize_original_space(model, coords)
             if vfn is not None:
                 final_metric = history[-1].validation_metric
             elif vdata is not None:
@@ -312,22 +515,38 @@ def run_game_training(params) -> GameTrainingRun:
                 if params.model_output_mode == "BEST"
                 else os.path.join(params.output_dir, "all", str(idx))
             )
+            save_params = {
+                # FactoredParams pass through whole (latent wire format)
+                n: p if hasattr(p, "gamma") else np.asarray(p)
+                for n, p in entry["model"].params.items()
+            }
+            save_shards = shards_by_coord
+            save_res = res_by_coord
+            save_evocabs = {
+                n: entity_vocabs[res_by_coord[n]]
+                for n in params.updating_sequence
+                if res_by_coord[n] is not None
+            }
+            if params.collapse_output:
+                from photon_ml_tpu.io.models import collapse_game_model
+
+                save_params, save_shards, save_res, save_evocabs = (
+                    collapse_game_model(
+                        save_params, save_shards, save_res, save_evocabs
+                    )
+                )
+                logger.info(
+                    f"collapsed to coordinates {sorted(save_params)}"
+                )
             save_game_model(
                 subdir,
-                params={
-                    n: np.asarray(p) for n, p in entry["model"].params.items()
-                },
-                shards=shards_by_coord,
+                params=save_params,
+                shards=save_shards,
                 vocabs={
-                    n: shard_vocabs[shards_by_coord[n]]
-                    for n in params.updating_sequence
+                    n: shard_vocabs[save_shards[n]] for n in save_params
                 },
-                entity_vocabs={
-                    n: entity_vocabs[res_by_coord[n]]
-                    for n in params.updating_sequence
-                    if res_by_coord[n] is not None
-                },
-                random_effects=res_by_coord,
+                entity_vocabs=save_evocabs,
+                random_effects=save_res,
                 task=task,
             )
             with open(os.path.join(subdir, "model-spec.json"), "w") as f:

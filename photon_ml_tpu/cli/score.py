@@ -99,7 +99,12 @@ def run_scoring(params) -> ScoringRun:
     )
     task = TaskType[params.task]
     date_range = resolve_date_range(params)
-    records = read_records(expand_date_paths(params.input, date_range))
+    from photon_ml_tpu.io.ingest import normalize_field_names
+
+    records = normalize_field_names(
+        read_records(expand_date_paths(params.input, date_range)),
+        params.field_names,
+    )
     logger.info(f"scoring {len(records)} records with {params.model_kind} "
                 f"model from {params.model_dir}")
 
@@ -165,7 +170,9 @@ def run_scoring(params) -> ScoringRun:
             # coordinate -> shard comes from id-info; vocabs keyed per
             # coordinate for load_game_model
             coord_shards: Dict[str, str] = {}
-            for kind in ("fixed-effect", "random-effect"):
+            for kind in (
+                "fixed-effect", "random-effect", "factored-random-effect"
+            ):
                 kdir = os.path.join(model_root, kind)
                 if not os.path.isdir(kdir):
                     continue

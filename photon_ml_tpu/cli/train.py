@@ -147,9 +147,13 @@ def run_glm_training(params) -> GLMTrainingRun:
 
     # ---- PREPROCESS ------------------------------------------------------
     with timed(logger, "preprocess"):
+        from photon_ml_tpu.io.ingest import normalize_field_names
+
         date_range = resolve_date_range(params)
         train_paths = expand_date_paths(params.train_input, date_range)
-        records = read_records(train_paths)
+        records = normalize_field_names(
+            read_records(train_paths), params.field_names
+        )
         logger.info(f"read {len(records)} training records")
 
         if params.feature_file:
@@ -191,7 +195,40 @@ def run_glm_training(params) -> GLMTrainingRun:
             cfg = dataclasses.replace(
                 cfg, lower_bounds=lb, upper_bounds=ub
             )
-        models = list(train_glm(batch, cfg))
+        initial = None
+        if params.initial_model_dir:
+            from photon_ml_tpu.io.models import load_glm_model
+
+            init_path = params.initial_model_dir
+            if os.path.isdir(init_path):
+                best = os.path.join(init_path, "best-model.avro")
+                if os.path.exists(best):
+                    init_path = best
+                else:
+                    # no-validation runs write only models/; accept a sole
+                    # model there, refuse ambiguity (like cli/score.py)
+                    mdir = os.path.join(init_path, "models")
+                    candidates = (
+                        sorted(
+                            f for f in os.listdir(mdir)
+                            if f.endswith(".avro")
+                        )
+                        if os.path.isdir(mdir)
+                        else []
+                    )
+                    if len(candidates) != 1:
+                        raise FileNotFoundError(
+                            f"no best-model.avro in {init_path} and "
+                            f"{len(candidates)} candidates in models/ — "
+                            "point initial_model_dir at a specific .avro"
+                        )
+                    init_path = os.path.join(mdir, candidates[0])
+            # coefficients remap by (name, term), so a drifted vocabulary
+            # still warm-starts correctly (unknown features drop, new
+            # features start at 0)
+            initial, _ = load_glm_model(init_path, vocab)
+            logger.info(f"warm-starting from {init_path}")
+        models = list(train_glm(batch, cfg, initial_coefficients=initial))
         for tm in models:
             logger.info(
                 f"lambda={tm.reg_weight}: iters={int(tm.result.iterations)} "
@@ -206,8 +243,11 @@ def run_glm_training(params) -> GLMTrainingRun:
     if params.validate_input:
         tracker.assert_at_least(DriverStage.TRAINED)
         with timed(logger, "validate"):
-            vrecords = read_records(
-                expand_date_paths(params.validate_input, date_range)
+            vrecords = normalize_field_names(
+                read_records(
+                    expand_date_paths(params.validate_input, date_range)
+                ),
+                params.field_names,
             )
             vbatch = labeled_batch_from_avro(
                 vrecords, vocab, sparse=params.sparse,
@@ -233,6 +273,38 @@ def run_glm_training(params) -> GLMTrainingRun:
                 f"best lambda={best.reg_weight} (model #{best_index}, "
                 f"metrics={validation_metrics[best_index]})"
             )
+            if params.validate_per_iteration:
+                # ModelTracker snapshots -> per-iteration validation
+                # metrics (``Driver.scala:293-347``)
+                per_iter: Dict[str, List[Dict[str, float]]] = {}
+                for i, tm in enumerate(models):
+                    hist = tm.result.w_history
+                    if hist is None:
+                        continue
+                    n_models = int(tm.result.iterations) + 1
+                    rows = []
+                    for it in range(n_models):
+                        margins = (
+                            vbatch.features @ hist[it] + vbatch.offsets
+                        )
+                        m = metrics_mod.evaluate(
+                            task,
+                            vbatch.labels,
+                            margins,
+                            vbatch.effective_weights(),
+                        )
+                        rows.append(m)
+                        logger.info(
+                            f"lambda={tm.reg_weight} iteration={it}: {m}"
+                        )
+                    per_iter[f"{i}_lambda_{tm.reg_weight:g}"] = rows
+                with open(
+                    os.path.join(
+                        params.output_dir, "per-iteration-metrics.json"
+                    ),
+                    "w",
+                ) as f:
+                    json.dump(per_iter, f, indent=2)
         tracker.advance(DriverStage.VALIDATED)
 
     # ---- DIAGNOSE (``Driver.scala:424-474``) -----------------------------

@@ -68,8 +68,9 @@ def kendall_tau(a, b) -> KendallTauReport:
     b = np.asarray(b, np.float64)
     m = a.shape[0]
     iu = np.triu_indices(m, k=1)
-    dx = np.sign(a[:, None] - a[None, :])[iu]
-    dy = np.sign(b[:, None] - b[None, :])[iu]
+    # index the pair vectors directly — no (m, m) temporaries
+    dx = np.sign(a[iu[0]] - a[iu[1]])
+    dy = np.sign(b[iu[0]] - b[iu[1]])
     ties_a = int(np.sum(dx == 0))
     ties_b = int(np.sum((dx != 0) & (dy == 0)))
     concordant = int(np.sum(dx * dy > 0))

@@ -35,8 +35,26 @@ from photon_ml_tpu.game.coordinates import (
     RandomEffectCoordinate,
 )
 from photon_ml_tpu.game.descent import CoordinateDescent, GameModel
+from photon_ml_tpu.game.factored import (
+    FactoredConfig,
+    FactoredParams,
+    FactoredRandomEffectCoordinate,
+    MatrixFactorizationModel,
+)
+from photon_ml_tpu.game.projected import (
+    ProjectedRandomEffectCoordinate,
+    build_index_map_columns,
+    parse_projector_spec,
+)
 
 __all__ = [
+    "FactoredConfig",
+    "FactoredParams",
+    "FactoredRandomEffectCoordinate",
+    "MatrixFactorizationModel",
+    "ProjectedRandomEffectCoordinate",
+    "build_index_map_columns",
+    "parse_projector_spec",
     "GameData",
     "RandomEffectDesign",
     "BucketedRandomEffectDesign",

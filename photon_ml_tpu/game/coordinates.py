@@ -79,18 +79,28 @@ class CoordinateConfig:
         )
 
 
-@lru_cache(maxsize=128)
 def _make_solve(config: CoordinateConfig, batched: bool):
-    """jitted solve(w0, features, labels, offsets, weights, mask) for one
-    subproblem; vmapped over the leading axis when `batched`."""
+    """jitted solve(w0, reg_weight, features, labels, offsets, weights,
+    mask) for one subproblem; vmapped over the leading axis when `batched`
+    — reg_weight is a TRACED scalar (per-entity in the batched case, the
+    honest analog of ``RandomEffectOptimizationProblem.scala:41-110``'s
+    per-entity objective functions). The cache key zeroes reg_weight so a
+    lambda grid sweep reuses ONE compilation."""
+    return _make_solve_cached(
+        dataclasses.replace(config, reg_weight=0.0), batched
+    )
+
+
+@lru_cache(maxsize=128)
+def _make_solve_cached(config: CoordinateConfig, batched: bool):
     loss = loss_for_task(config.task)
     scfg = config.solver_config()
-    l1 = config.reg_weight * config.l1_ratio
-    l2 = config.reg_weight * (1.0 - config.l1_ratio)
     use_owlqn = config.l1_ratio > 0.0
     use_tron = config.optimizer == OptimizerType.TRON
 
-    def solve_one(w0, features, labels, offsets, weights, mask):
+    def solve_one(w0, reg_weight, features, labels, offsets, weights, mask):
+        l1 = reg_weight * config.l1_ratio
+        l2 = reg_weight * (1.0 - config.l1_ratio)
         batch = LabeledBatch(features, labels, offsets, weights, mask)
         obj = GLMObjective(loss=loss, l2_weight=l2)
         vg = lambda w: obj.value_and_grad(w, batch)
@@ -102,6 +112,60 @@ def _make_solve(config: CoordinateConfig, batched: bool):
         return minimize_lbfgs(vg, w0, scfg)
 
     return jax.jit(jax.vmap(solve_one) if batched else solve_one)
+
+
+def _downsample_budget(
+    labels: np.ndarray, mask: np.ndarray, rate: float, binary: bool
+) -> int:
+    """Static row budget for the gathered down-sampled batch: expected
+    keep count + 6 standard deviations of the Bernoulli draw, so overflow
+    (kept rows beyond the budget, which are dropped) is vanishingly rare."""
+    real = mask > 0
+    n = int(real.sum())
+    if binary:
+        pos = int(((labels > 0) & real).sum())
+        neg = n - pos
+        mean = pos + rate * neg
+        var = rate * (1.0 - rate) * neg
+    else:
+        mean = rate * n
+        var = rate * (1.0 - rate) * n
+    return min(n, int(np.ceil(mean + 6.0 * np.sqrt(max(var, 1.0)))) + 1)
+
+
+def _make_gathered_solve(config: CoordinateConfig, budget: int):
+    """jitted solve over the GATHERED down-sampled batch: rows with
+    positive post-sampling weight are packed (stable order) into a
+    (budget, d) batch; dropped and overflow rows carry weight 0. Cache
+    key zeroes reg_weight (traced) like _make_solve."""
+    return _make_gathered_solve_cached(
+        dataclasses.replace(config, reg_weight=0.0), budget
+    )
+
+
+@lru_cache(maxsize=64)
+def _make_gathered_solve_cached(config: CoordinateConfig, budget: int):
+    solve = _make_solve(config, batched=False)
+
+    @jax.jit
+    def gather_solve(w, reg_weight, features, labels, offsets, weights, mask):
+        kept = weights > 0.0
+        # stable partition: kept-row indices first
+        order = jnp.argsort(~kept)  # False (kept) sorts before True
+        idx = order[:budget]
+        valid = kept[idx]
+        sub_mask = jnp.where(valid, mask[idx], 0.0)
+        return solve(
+            w,
+            reg_weight,
+            features[idx],
+            labels[idx],
+            offsets[idx],
+            jnp.where(valid, weights[idx], 0.0),
+            sub_mask,
+        )
+
+    return gather_solve
 
 
 class FixedEffectCoordinate:
@@ -122,6 +186,26 @@ class FixedEffectCoordinate:
             if config.down_sampling_rate is not None
             else None
         )
+        # Down-sampling must SAVE work, not just zero weights (the
+        # reference's down-sampler cuts the fixed-effect solve cost —
+        # ``sampler/BinaryClassificationDownSampler.scala:36-66``): kept
+        # rows are gathered into a smaller STATIC batch sized for the
+        # expected keep count plus a 6-sigma margin, so every pass reuses
+        # one compilation. The dense path only — gathering padded-ELL rows
+        # is the sparse container's own re-pack problem.
+        self._ds_budget = None
+        if self._downsample is not None and not hasattr(
+            batch.features, "values"
+        ):
+            self._ds_budget = _downsample_budget(
+                np.asarray(batch.labels),
+                np.asarray(batch.mask),
+                config.down_sampling_rate,
+                binary=config.task.is_classifier,
+            )
+            self._gather_solve = _make_gathered_solve(
+                config, self._ds_budget
+            )
 
     @property
     def dim(self) -> int:
@@ -149,8 +233,20 @@ class FixedEffectCoordinate:
                 self.batch.labels,
                 self.config.down_sampling_rate,
             )
+            if self._ds_budget is not None:
+                result = self._gather_solve(
+                    w,
+                    jnp.asarray(self.config.reg_weight, w.dtype),
+                    self.batch.features,
+                    self.batch.labels,
+                    offsets,
+                    weights,
+                    self.batch.mask,
+                )
+                return result.w, result
         result = self._solve(
             w,
+            jnp.asarray(self.config.reg_weight, w.dtype),
             self.batch.features,
             self.batch.labels,
             offsets,
@@ -176,18 +272,29 @@ class RandomEffectUpdateSummary:
     iterations: np.ndarray  # (E_active,) int32
 
 
-@lru_cache(maxsize=128)
 def _make_bucket_update(config: CoordinateConfig):
     """jitted (table, entity_index, design arrays) -> (table', result):
     gather warm starts from the global table, solve the bucket's entities
     in one vmapped call, scatter solutions back. Sentinel indices
-    (== num_entities) clip on gather and drop on scatter."""
+    (== num_entities) clip on gather and drop on scatter. Cache key zeroes
+    reg_weight (traced per entity) so a lambda grid reuses one compile."""
+    return _make_bucket_update_cached(
+        dataclasses.replace(config, reg_weight=0.0)
+    )
+
+
+@lru_cache(maxsize=128)
+def _make_bucket_update_cached(config: CoordinateConfig):
     solve = _make_solve(config, batched=True)
 
     @jax.jit
-    def update_bucket(table, entity_index, features, labels, offsets, weights, mask):
+    def update_bucket(
+        table, entity_index, reg_weights, features, labels, offsets,
+        weights, mask,
+    ):
         w0 = jnp.take(table, entity_index, axis=0, mode="clip")
-        result = solve(w0, features, labels, offsets, weights, mask)
+        lam = jnp.take(reg_weights, entity_index, mode="clip")
+        result = solve(w0, lam, features, labels, offsets, weights, mask)
         new_table = table.at[entity_index].set(result.w, mode="drop")
         return new_table, result
 
@@ -213,6 +320,7 @@ class RandomEffectCoordinate:
         row_entities: jax.Array,  # (n,) int32, -1 = unknown entity
         full_offsets_base: jax.Array,  # (n,) data offsets
         config: CoordinateConfig,
+        reg_weights: Optional[jax.Array] = None,  # (E,) per-entity lambdas
     ):
         if config.random_effect is None:
             raise ValueError("config lacks random_effect; wrong coordinate")
@@ -229,6 +337,21 @@ class RandomEffectCoordinate:
         self.row_entities = row_entities
         self.full_offsets_base = full_offsets_base
         self.config = config
+        # (E,) per-entity regularization weights
+        # (``RandomEffectOptimizationProblem.scala:41-110``: each entity may
+        # carry a distinct objective); shared config weight by default
+        if reg_weights is None:
+            reg_weights = jnp.full(
+                (design.num_entities,), config.reg_weight, jnp.float32
+            )
+        else:
+            reg_weights = jnp.asarray(reg_weights, jnp.float32)
+            if reg_weights.shape != (design.num_entities,):
+                raise ValueError(
+                    f"reg_weights must be ({design.num_entities},), got "
+                    f"{reg_weights.shape}"
+                )
+        self.reg_weights = reg_weights
         self._update_bucket = _make_bucket_update(config)
         # static per-bucket masks of real (non-sharding-pad) lanes
         self._valid_lanes = [
@@ -272,6 +395,7 @@ class RandomEffectCoordinate:
             table, result = self._update_bucket(
                 table,
                 jnp.asarray(entity_index),
+                self.reg_weights,
                 bucket.features,
                 bucket.labels,
                 offsets,
@@ -288,6 +412,16 @@ class RandomEffectCoordinate:
 
     def score(self, table: jax.Array) -> jax.Array:
         return self._score(table, self.row_features, self.row_entities)
+
+    def reg_term(self, table: jax.Array) -> jax.Array:
+        """Penalty with PER-ENTITY weights — what the vmapped solves
+        minimized (``RandomEffectOptimizationProblem.getRegularizationTermValue``)."""
+        lam = self.reg_weights.astype(table.dtype)
+        l2 = lam * (1.0 - self.config.l1_ratio)
+        l1 = lam * self.config.l1_ratio
+        sq = jnp.sum(table * table, axis=-1)
+        ab = jnp.sum(jnp.abs(table), axis=-1)
+        return jnp.sum(0.5 * l2 * sq + l1 * ab)
 
 
 # -- down-samplers (``sampler/``) -------------------------------------------

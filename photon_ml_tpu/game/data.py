@@ -179,6 +179,79 @@ def _fill_design(
     )
 
 
+def pearson_correlation_scores(
+    features: np.ndarray, labels: np.ndarray, mask: np.ndarray
+) -> np.ndarray:
+    """(E, R, d) design -> (E, d) per-entity Pearson |correlation| basis.
+
+    Vectorized rebuild of ``LocalDataSet.computePearsonCorrelationScore``
+    (``LocalDataSet.scala:198-259``): per entity, corr(feature_j, label)
+    over its active rows; a present feature with ~zero variance is the
+    intercept — the FIRST such gets score 1.0, later ones 0.0; features
+    absent from the entity's rows score -inf (never selected).
+    """
+    m = mask > 0
+    x = np.where(m[:, :, None], features, 0.0)
+    y = np.where(m, labels, 0.0)
+    n = m.sum(axis=1).astype(np.float64)[:, None]  # (E, 1)
+    s1 = x.sum(axis=1)
+    s2 = (x * x).sum(axis=1)
+    sxy = (x * y[:, :, None]).sum(axis=1)
+    ly = y.sum(axis=1)[:, None]
+    lyy = (y * y).sum(axis=1)[:, None]
+    numerator = n * sxy - s1 * ly
+    feat_var = np.abs(n * s2 - s1 * s1)
+    std = np.sqrt(feat_var)
+    label_var = np.maximum(n * lyy - ly * ly, 0.0)
+    denominator = std * np.sqrt(label_var)
+    # constant labels: correlation is undefined, and a tiny-denominator
+    # guard would amplify cancellation noise into garbage scores — force 0.
+    # Thresholds are RELATIVE to the moment magnitudes (absolute epsilons
+    # break under catastrophic cancellation at large n / large values).
+    label_const = label_var < 1e-9 * np.maximum(n * lyy, 1.0)
+    score = np.where(
+        label_const, 0.0, numerator / (denominator + 1e-12)
+    )
+
+    present = s2 > 0.0
+    constant = present & (feat_var < 1e-9 * np.maximum(n * s2, 1.0))
+    # first constant (intercept-like) feature per entity scores 1.0
+    first_const = constant & (
+        np.cumsum(constant, axis=1) == 1
+    )
+    score = np.where(constant, 0.0, score)
+    score = np.where(first_const, 1.0, score)
+    return np.where(present, np.abs(score), -np.inf)
+
+
+def select_features_by_pearson(
+    design: RandomEffectDesign, ratio: float
+) -> RandomEffectDesign:
+    """Per-entity feature selection: keep the top ceil(ratio * n_e)
+    features by |Pearson corr|, zeroing the rest in the design so their
+    coefficients solve to exactly 0 (the dense-rep analog of
+    ``RandomEffectDataSet.featureSelectionOnActiveData``,
+    ``RandomEffectDataSet.scala:360-380``)."""
+    if ratio <= 0:
+        raise ValueError(f"feature ratio must be positive, got {ratio}")
+    feats = np.asarray(design.features, np.float64)
+    mask = np.asarray(design.mask)
+    score = pearson_correlation_scores(
+        feats, np.asarray(design.labels, np.float64), mask
+    )
+    e, _, d = feats.shape
+    n_e = (mask > 0).sum(axis=1)
+    k_e = np.minimum(np.ceil(ratio * n_e).astype(np.int64), d)
+    rank = np.argsort(np.argsort(-score, axis=1, kind="stable"), axis=1)
+    keep = rank < k_e[:, None]  # (E, d)
+    return dataclasses.replace(
+        design,
+        features=jnp.asarray(
+            np.where(keep[:, None, :], feats, 0.0), design.features.dtype
+        ),
+    )
+
+
 def build_random_effect_design(
     data: GameData,
     random_effect: str,
@@ -187,6 +260,7 @@ def build_random_effect_design(
     active_cap: Optional[int] = None,
     seed: int = 0,
     dtype=jnp.float32,
+    feature_ratio: Optional[float] = None,
 ) -> RandomEffectDesign:
     """Group rows by entity into padded tensors (host-side, once per run).
 
@@ -212,7 +286,7 @@ def build_random_effect_design(
     cap_of = np.minimum(counts, cap)
     keep = slot < np.repeat(cap_of, counts)
     rescale = np.repeat(np.where(counts > cap, counts / cap, 1.0), counts)
-    return _fill_design(
+    design = _fill_design(
         data,
         shard,
         order[keep],
@@ -223,6 +297,9 @@ def build_random_effect_design(
         cap,
         dtype,
     )
+    if feature_ratio is not None:
+        design = select_features_by_pearson(design, feature_ratio)
+    return design
 
 
 @dataclasses.dataclass
@@ -311,6 +388,7 @@ def build_bucketed_random_effect_design(
     entity_multiple: int = 1,
     seed: int = 0,
     dtype=jnp.float32,
+    feature_ratio: Optional[float] = None,
 ) -> BucketedRandomEffectDesign:
     """Like :func:`build_random_effect_design` but with per-size-class row
     caps. Entities (those with data) are sorted by row count and split into
@@ -380,19 +458,20 @@ def build_bucketed_random_effect_design(
     for b, (cap_b, ents_b) in enumerate(zip(bucket_caps, bucket_entities)):
         sel = bucket_of_entity[ents] == b
         e_pad = -(-ents_b.size // entity_multiple) * entity_multiple
-        buckets.append(
-            _fill_design(
-                data,
-                shard,
-                rows[sel],
-                local_of_entity[ents[sel]],
-                slots[sel],
-                rescale_of_entity[ents[sel]],
-                e_pad,
-                cap_b,
-                dtype,
-            )
+        bucket = _fill_design(
+            data,
+            shard,
+            rows[sel],
+            local_of_entity[ents[sel]],
+            slots[sel],
+            rescale_of_entity[ents[sel]],
+            e_pad,
+            cap_b,
+            dtype,
         )
+        if feature_ratio is not None:
+            bucket = select_features_by_pearson(bucket, feature_ratio)
+        buckets.append(bucket)
         idx = np.full(e_pad, num_entities, np.int64)
         idx[: ents_b.size] = ents_b
         entity_index.append(np.asarray(idx, np.int32))

@@ -2,18 +2,24 @@
 
 Rebuild of ``algorithm/FactoredRandomEffectCoordinate.scala:37-267``: when
 entities are too many / data too thin for full per-entity coefficient
-vectors, factor the random effect as  w_e = B^T gamma_e  with a shared
+vectors, factor the random effect as  w_e = B gamma_e  with a shared
 projection B (d x k) and per-entity latent coefficients gamma_e (k,).
 Training alternates (numInnerIterations x):
 
   (a) project the active design through the current B and solve the
       per-entity latent GLMs (a RandomEffect solve in k dims);
-  (b) re-fit B as ONE fixed-effect-style GLM over Kronecker-product
-      features x (x) gamma_e — vec(B) is the coefficient vector
-      (``kroneckerProductFeaturesAndCoefficients`` :251-266).
+  (b) re-fit B as ONE GLM whose virtual features are the Kronecker
+      products x (x) gamma_e (``kroneckerProductFeaturesAndCoefficients``
+      :251-266) — here the Kronecker design is NEVER materialized: margins,
+      gradients, and Hessian-vector products contract X, gamma, and B
+      directly by einsum, so phase (b) costs O(E R d k) FLOPs and
+      O(E R d) memory instead of the O(E R d k) memory a materialized
+      (E*R, d*k) matrix would need.
 
-Both phases are jitted; the Kronecker design is an einsum. Scoring:
-margin_i = gamma_{e(i)} . (B^T x_i), unknown entities score 0.
+Accepts a :class:`BucketedRandomEffectDesign` (or a single global-cap
+design, wrapped as one bucket): phase (a) runs per bucket with
+gather/scatter against the global gamma table; phase (b) sums every
+bucket's contribution into one shared-B objective.
 
 ``MatrixFactorizationModel`` (``model/MatrixFactorizationModel.scala:30-134``)
 is the inference-side pairing: two latent tables scored by gathered dot.
@@ -22,6 +28,7 @@ is the inference-side pairing: two latent tables scored by gathered dot.
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache
 from typing import Optional, Tuple
 
 import jax
@@ -30,7 +37,17 @@ import numpy as np
 
 from photon_ml_tpu.core.types import _pytree_dataclass
 from photon_ml_tpu.game.coordinates import CoordinateConfig, _make_solve
-from photon_ml_tpu.game.data import RandomEffectDesign
+from photon_ml_tpu.game.data import (
+    BucketedRandomEffectDesign,
+    RandomEffectDesign,
+)
+from photon_ml_tpu.models.training import OptimizerType
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.solvers import (
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+)
 
 
 @_pytree_dataclass
@@ -62,12 +79,80 @@ class FactoredConfig:
             )
 
 
+@lru_cache(maxsize=64)
+def _make_latent_solve(config: CoordinateConfig, num_buckets: int):
+    """jitted solve for the shared projection B over `num_buckets` bucket
+    designs. The objective treats vec(B) as the coefficient vector of a
+    GLM on the VIRTUAL Kronecker features x (x) gamma — contracted lazily:
+
+      margin_er = einsum('erd,dk,ek->er', X_b, B, gamma_b)
+      grad_dk   = einsum('er,erd,ek->dk', c, X_b, gamma_b) + lambda B
+      (Hv)_dk   = same contraction with c2 * dmargin(V)
+
+    Bucket tensors arrive as positional args (pytrees of varying shapes),
+    so one compilation serves a whole training run."""
+    loss = loss_for_task(config.task)
+    scfg = config.solver_config()
+    use_tron = config.optimizer == OptimizerType.TRON
+    use_owlqn = config.l1_ratio > 0.0
+    l2 = config.reg_weight * (1.0 - config.l1_ratio)
+    l1 = config.reg_weight * config.l1_ratio
+    lam = l2
+
+    def solve(b0, gammas, buckets_offsets, buckets):
+        d, k = b0.shape
+
+        def margins(B, bucket, gamma_b, offsets):
+            xb = jnp.einsum("erd,dk->erk", bucket.features, B)
+            return jnp.einsum("erk,ek->er", xb, gamma_b) + offsets
+
+        def value_and_grad(vecB):
+            B = vecB.reshape(d, k)
+            val = 0.5 * lam * jnp.vdot(B, B)
+            grad = lam * B
+            for bucket, gamma_b, offsets in zip(
+                buckets, gammas, buckets_offsets
+            ):
+                w = bucket.weights * bucket.mask
+                z = margins(B, bucket, gamma_b, offsets)
+                val = val + jnp.sum(w * loss.value(z, bucket.labels))
+                c = w * loss.d1(z, bucket.labels)
+                cg = jnp.einsum("er,ek->erk", c, gamma_b)
+                grad = grad + jnp.einsum(
+                    "erd,erk->dk", bucket.features, cg
+                )
+            return val, grad.reshape(-1)
+
+        def hvp(vecB, vecV):
+            B = vecB.reshape(d, k)
+            V = vecV.reshape(d, k)
+            out = lam * V
+            for bucket, gamma_b, offsets in zip(
+                buckets, gammas, buckets_offsets
+            ):
+                w = bucket.weights * bucket.mask
+                z = margins(B, bucket, gamma_b, offsets)
+                dz = margins(V, bucket, gamma_b, jnp.zeros_like(offsets))
+                c2 = w * loss.d2(z, bucket.labels) * dz
+                cg = jnp.einsum("er,ek->erk", c2, gamma_b)
+                out = out + jnp.einsum("erd,erk->dk", bucket.features, cg)
+            return out.reshape(-1)
+
+        if use_owlqn:
+            return minimize_owlqn(value_and_grad, b0.reshape(-1), l1, scfg)
+        if use_tron:
+            return minimize_tron(value_and_grad, hvp, b0.reshape(-1), scfg)
+        return minimize_lbfgs(value_and_grad, b0.reshape(-1), scfg)
+
+    return jax.jit(solve)
+
+
 class FactoredRandomEffectCoordinate:
     """Drop-in coordinate: update(params, partial_scores) / score(params)."""
 
     def __init__(
         self,
-        design: RandomEffectDesign,
+        design,  # RandomEffectDesign | BucketedRandomEffectDesign
         row_features: jax.Array,
         row_entities: jax.Array,
         full_offsets_base: jax.Array,
@@ -75,6 +160,14 @@ class FactoredRandomEffectCoordinate:
         factored: FactoredConfig,
         seed: int = 0,
     ):
+        if isinstance(design, RandomEffectDesign):
+            design = BucketedRandomEffectDesign(
+                buckets=[design],
+                entity_index=[
+                    np.arange(design.num_entities, dtype=np.int32)
+                ],
+                num_entities=design.num_entities,
+            )
         self.design = design
         self.row_features = row_features
         self.row_entities = row_entities
@@ -84,11 +177,13 @@ class FactoredRandomEffectCoordinate:
         self._seed = seed
 
         latent_cfg = factored.latent_factor_config or re_config
+        self._latent_cfg = latent_cfg
         self._re_solve = _make_solve(
             dataclasses.replace(re_config, random_effect=None), batched=True
         )
-        self._latent_solve = _make_solve(
-            dataclasses.replace(latent_cfg, random_effect=None), batched=False
+        self._latent_solve = _make_latent_solve(
+            dataclasses.replace(latent_cfg, random_effect=None),
+            design.num_buckets,
         )
 
         @jax.jit
@@ -104,14 +199,21 @@ class FactoredRandomEffectCoordinate:
     def num_entities(self) -> int:
         return self.design.num_entities
 
+    @property
+    def dim(self) -> int:
+        """Original feature dimension of the underlying design."""
+        return self.design.dim
+
     def initial_params(self) -> FactoredParams:
         """Gamma zeros; B a Gaussian N(0, 1/d) like the reference's random
         projection init (``FactoredRandomEffectOptimizationProblem``)."""
+        from photon_ml_tpu.models.training import solve_dtype
+
         d = self.design.dim
         k = self.factored.latent_dim
         rng = np.random.default_rng(self._seed)
         b = rng.normal(0.0, 1.0 / np.sqrt(d), size=(d, k))
-        dtype = self.design.features.dtype
+        dtype = solve_dtype(self.design.buckets[0])
         return FactoredParams(
             gamma=jnp.zeros((self.num_entities, k), dtype),
             projection=jnp.asarray(b, dtype),
@@ -121,38 +223,45 @@ class FactoredRandomEffectCoordinate:
         self, params: FactoredParams, partial_scores: jax.Array, key=None
     ) -> Tuple[FactoredParams, object]:
         design = self.design
-        offsets = design.gather_offsets(
-            self.full_offsets_base + partial_scores
-        )
+        full_offsets = self.full_offsets_base + partial_scores
+        bucket_offsets = [
+            b.gather_offsets(full_offsets) for b in design.buckets
+        ]
         gamma, b = params.gamma, params.projection
+        lam_re = jnp.full(
+            (design.num_entities,), self.config.reg_weight, gamma.dtype
+        )
         result = None
         for _ in range(self.factored.num_inner_iterations):
-            # (a) latent-space per-entity solves
-            latent_feats = design.features @ b  # (E, R, k)
-            result = self._re_solve(
-                gamma,
-                latent_feats,
-                design.labels,
-                offsets,
-                design.weights,
-                design.mask,
+            # (a) latent-space per-entity solves, bucket by bucket
+            for bucket, entity_index, offsets in zip(
+                design.buckets, design.entity_index, bucket_offsets
+            ):
+                eidx = jnp.asarray(entity_index)
+                g0 = jnp.take(gamma, eidx, axis=0, mode="clip")
+                lam_b = jnp.take(lam_re, eidx, mode="clip")
+                latent_feats = jnp.einsum(
+                    "erd,dk->erk", bucket.features, b
+                )
+                result = self._re_solve(
+                    g0,
+                    lam_b,
+                    latent_feats,
+                    bucket.labels,
+                    offsets,
+                    bucket.weights,
+                    bucket.mask,
+                )
+                gamma = gamma.at[eidx].set(result.w, mode="drop")
+            # (b) shared projection over ALL buckets, einsum-contracted
+            gammas = tuple(
+                jnp.take(gamma, jnp.asarray(ei), axis=0, mode="clip")
+                for ei in design.entity_index
             )
-            gamma = result.w
-            # (b) shared projection as one GLM over Kronecker features
-            e, r, d = design.features.shape
-            k = gamma.shape[1]
-            kron = jnp.einsum(
-                "erd,ek->erdk", design.features, gamma
-            ).reshape(e * r, d * k)
             latent_result = self._latent_solve(
-                b.reshape(-1),
-                kron,
-                design.labels.reshape(-1),
-                offsets.reshape(-1),
-                design.weights.reshape(-1),
-                design.mask.reshape(-1),
+                b, gammas, tuple(bucket_offsets), tuple(design.buckets)
             )
-            b = latent_result.w.reshape(d, k)
+            b = latent_result.w.reshape(b.shape)
         return FactoredParams(gamma=gamma, projection=b), result
 
     def score(self, params: FactoredParams) -> jax.Array:
@@ -163,9 +272,8 @@ class FactoredRandomEffectCoordinate:
         config — the exact quantities the two inner solves minimize."""
         from photon_ml_tpu.game.descent import _config_reg_term
 
-        latent_cfg = self.factored.latent_factor_config or self.config
         return _config_reg_term(self.config, params.gamma) + _config_reg_term(
-            latent_cfg, params.projection
+            self._latent_cfg, params.projection
         )
 
     def to_full_table(self, params: FactoredParams) -> jax.Array:

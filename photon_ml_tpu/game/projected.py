@@ -1,0 +1,221 @@
+"""Random-effect training in projected space.
+
+Rebuild of ``algorithm/RandomEffectCoordinateInProjectedSpace.scala:26-120``
++ ``model/RandomEffectModelInProjectedSpace.scala:31-97``: the coordinate
+solves every per-entity subproblem in a reduced k-dimensional space (shared
+Gaussian RANDOM projection, per-entity INDEX_MAP compaction, or IDENTITY),
+and coefficients are projected back to the original feature space at model
+extraction so on-disk models never know projection existed.
+
+TPU-first shape: projection is applied ONCE to the padded bucketed design
+at build time (a matmul or per-entity gather — not a per-row RDD map), the
+inner :class:`RandomEffectCoordinate` is reused unchanged on the projected
+tensors, and back-projection of the (E, k) table is a single matmul /
+scatter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.coordinates import (
+    CoordinateConfig,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.data import (
+    BucketedRandomEffectDesign,
+    GameData,
+    RandomEffectDesign,
+)
+from photon_ml_tpu.game.projectors import (
+    IndexMapProjection,
+    RandomProjection,
+    build_random_projection,
+)
+
+
+def parse_projector_spec(spec: str) -> Tuple[str, Optional[int]]:
+    """"IDENTITY" | "INDEX_MAP" | "RANDOM=<k>" -> (kind, k)
+    (``projector/ProjectorType.scala:20-30``)."""
+    s = spec.strip().upper()
+    if s == "IDENTITY":
+        return "IDENTITY", None
+    if s == "INDEX_MAP":
+        return "INDEX_MAP", None
+    if s.startswith("RANDOM="):
+        k = int(s.split("=", 1)[1])
+        if k <= 0:
+            raise ValueError(f"RANDOM projected dim must be positive: {spec}")
+        return "RANDOM", k
+    raise ValueError(
+        f"unknown projector {spec!r}; expected IDENTITY, INDEX_MAP, or "
+        "RANDOM=<k>"
+    )
+
+
+def build_index_map_columns(
+    data: GameData,
+    random_effect: str,
+    shard: str,
+    num_entities: int,
+) -> IndexMapProjection:
+    """Per-entity union of ACTIVE feature indices over all of the entity's
+    rows (``IndexMapProjectorRDD.scala:113-120``), indexed by global entity
+    id — usable against any bucketing of the same entities.
+
+    O(nnz) in time and memory: works on the nonzero coordinates directly
+    (never a dense (E, d) presence matrix, which would defeat INDEX_MAP's
+    purpose in the wide-feature regime it exists for)."""
+    from photon_ml_tpu.game.projectors import columns_from_active_pairs
+
+    x = np.asarray(data.features[shard])
+    d = x.shape[1]
+    eids = np.asarray(data.entity_ids[random_effect])
+    rows, feat_cols = np.nonzero(x)
+    ent = eids[rows]
+    known = ent >= 0
+    cols = columns_from_active_pairs(
+        ent[known], feat_cols[known], d, num_entities
+    )
+    return IndexMapProjection(columns=jnp.asarray(cols, jnp.int32))
+
+
+def _project_design_bucket(
+    projector, bucket: RandomEffectDesign, entity_index: np.ndarray,
+    num_entities: int,
+) -> RandomEffectDesign:
+    if isinstance(projector, RandomProjection):
+        return dataclasses.replace(
+            bucket,
+            features=projector.project_features(bucket.features),
+        )
+    # INDEX_MAP: gather this bucket's per-lane column tables (sentinel
+    # lanes clip to entity num_entities-1's columns; their mask is 0 so the
+    # garbage never enters a solve)
+    cols = jnp.take(
+        projector.columns, jnp.asarray(entity_index), axis=0, mode="clip"
+    )  # (E_b, k)
+    safe = jnp.maximum(cols, 0)
+    gathered = jnp.take_along_axis(
+        bucket.features, safe[:, None, :], axis=2
+    )
+    keep = (cols >= 0)[:, None, :]
+    return dataclasses.replace(
+        bucket, features=jnp.where(keep, gathered, 0.0)
+    )
+
+
+def project_design_and_rows(
+    design: BucketedRandomEffectDesign,
+    row_features: jax.Array,
+    row_entities: jax.Array,
+    projector,
+):
+    """The combo-invariant heavy lifting of a projected coordinate: project
+    every bucket's design and the full row view ONCE. Cacheable across a
+    reg-weight grid (projection depends on data, never on lambda)."""
+    projected = BucketedRandomEffectDesign(
+        buckets=[
+            _project_design_bucket(projector, b, ei, design.num_entities)
+            for b, ei in zip(design.buckets, design.entity_index)
+        ],
+        entity_index=design.entity_index,
+        num_entities=design.num_entities,
+    )
+    if isinstance(projector, RandomProjection):
+        proj_rows = projector.project_features(row_features)
+    else:
+        proj_rows = projector.project_row_features(
+            row_features, row_entities
+        )
+    return projected, proj_rows
+
+
+class ProjectedRandomEffectCoordinate:
+    """A RandomEffectCoordinate whose solves happen in projected space.
+
+    Drop-in member of a CoordinateDescent ``coordinates`` dict: exposes
+    initial_params/update/score on the PROJECTED (E, k) table, plus
+    :meth:`back_project` to map the trained table to original d-space for
+    persistence (``RandomEffectModelInProjectedSpace.toRandomEffectModel``).
+    """
+
+    def __init__(
+        self,
+        design: BucketedRandomEffectDesign,
+        row_features: jax.Array,  # (n, d) ORIGINAL-space scoring view
+        row_entities: jax.Array,
+        full_offsets_base: jax.Array,
+        config: CoordinateConfig,
+        projector: Union[RandomProjection, IndexMapProjection],
+        original_dim: int,
+        reg_weights: Optional[jax.Array] = None,
+        prebuilt=None,  # (projected_design, projected_rows) from
+        # :func:`project_design_and_rows` — reused across a lambda grid
+    ):
+        if isinstance(design, RandomEffectDesign):
+            design = BucketedRandomEffectDesign(
+                buckets=[design],
+                entity_index=[
+                    np.arange(design.num_entities, dtype=np.int32)
+                ],
+                num_entities=design.num_entities,
+            )
+        self.projector = projector
+        self.original_dim = original_dim
+        if prebuilt is not None:
+            projected, proj_rows = prebuilt
+        else:
+            projected, proj_rows = project_design_and_rows(
+                design, row_features, row_entities, projector
+            )
+        self.inner = RandomEffectCoordinate(
+            design=projected,
+            row_features=proj_rows,
+            row_entities=row_entities,
+            full_offsets_base=full_offsets_base,
+            config=config,
+            reg_weights=reg_weights,
+        )
+
+    @property
+    def config(self) -> CoordinateConfig:
+        """CoordinateDescent reads this for the objective's reg term — the
+        L2 penalty applies to the projected table, exactly what the inner
+        solves minimized."""
+        return self.inner.config
+
+    @property
+    def num_entities(self) -> int:
+        return self.inner.num_entities
+
+    @property
+    def dim(self) -> int:
+        """Projected dimension (the solve space)."""
+        return self.inner.dim
+
+    def initial_params(self) -> jax.Array:
+        return self.inner.initial_params()
+
+    def update(self, table, partial_scores, key=None):
+        return self.inner.update(table, partial_scores, key=key)
+
+    def reg_term(self, table: jax.Array) -> jax.Array:
+        return self.inner.reg_term(table)
+
+    def score(self, table: jax.Array) -> jax.Array:
+        return self.inner.score(table)
+
+    def back_project(self, table: jax.Array) -> jax.Array:
+        """(E, k) projected table -> (E, d) original-space coefficients
+        (``RandomEffectModelInProjectedSpace.scala:31-97``)."""
+        if isinstance(self.projector, RandomProjection):
+            return self.projector.project_coefficients_back(table)
+        return self.projector.project_coefficients_back(
+            table, self.original_dim
+        )

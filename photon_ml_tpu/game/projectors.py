@@ -121,20 +121,39 @@ class IndexMapProjection:
         return jnp.where(keep, gathered, 0.0)
 
 
+def columns_from_active_pairs(
+    ent: np.ndarray, col: np.ndarray, d: int, num_entities: int
+) -> np.ndarray:
+    """(entity, feature) occurrence pairs -> (num_entities, k) per-entity
+    sorted active-column table padded with -1, where k = max active-column
+    count. O(nnz): the shared kernel of both INDEX_MAP builders."""
+    pairs = np.unique(ent.astype(np.int64) * d + col.astype(np.int64))
+    pair_ent = pairs // d
+    pair_col = pairs % d
+    _, starts, counts = np.unique(
+        pair_ent, return_index=True, return_counts=True
+    )
+    k = max(int(counts.max()) if counts.size else 1, 1)
+    cols = np.full((num_entities, k), -1, np.int64)
+    slot = np.arange(pairs.size) - np.repeat(starts, counts)
+    cols[pair_ent, slot] = pair_col
+    return cols
+
+
 def build_index_map_projection(
     design: RandomEffectDesign, dtype=jnp.int32
 ) -> IndexMapProjection:
     """Union of active feature indices per entity
     (``IndexMapProjectorRDD.scala:113-120``): a feature is kept for an
-    entity iff it is nonzero in any of that entity's active rows."""
+    entity iff it is nonzero in any of that entity's active rows.
+
+    Design-tensor variant of ``projected.build_index_map_columns`` (which
+    derives the same column sets straight from GameData); both share
+    :func:`columns_from_active_pairs`."""
     feats = np.asarray(design.features)  # (E, R, d)
-    mask = np.asarray(design.mask)[:, :, None]
-    active = (np.abs(feats) > 0) & (mask > 0)  # (E, R, d)
-    per_entity = active.any(axis=1)  # (E, d)
-    k = max(int(per_entity.sum(axis=1).max()), 1)
-    e, d = per_entity.shape
-    cols = np.full((e, k), -1, np.int64)
-    for i in range(e):
-        idx = np.nonzero(per_entity[i])[0]
-        cols[i, : len(idx)] = idx
+    mask = np.asarray(design.mask)
+    e, _, d = feats.shape
+    ent, row, col = np.nonzero(feats)
+    keep = mask[ent, row] > 0
+    cols = columns_from_active_pairs(ent[keep], col[keep], d, e)
     return IndexMapProjection(columns=jnp.asarray(cols, dtype))

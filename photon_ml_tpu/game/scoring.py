@@ -32,6 +32,16 @@ def _random_scores(table, feats, ents):
     return jnp.where(ents >= 0, per_row, 0.0)
 
 
+@jax.jit
+def _factored_scores(gamma, projection, feats, ents):
+    """score = (x B) . gamma_e without materializing B gamma^T
+    (``FactoredRandomEffectCoordinate`` scoring contraction)."""
+    latent = feats @ projection  # (n, k)
+    safe = jnp.maximum(ents, 0)
+    per_row = jnp.einsum("nk,nk->n", latent, gamma[safe])
+    return jnp.where(ents >= 0, per_row, 0.0)
+
+
 def score_game_data(
     params: Dict[str, jax.Array],
     shards: Dict[str, str],
@@ -51,6 +61,14 @@ def score_game_data(
         re_key = random_effects.get(name)
         if re_key is None:
             total = total + _fixed_scores(jnp.asarray(p, dtype), feats)
+        elif hasattr(p, "gamma"):  # FactoredParams
+            ents = jnp.asarray(data.entity_ids[re_key])
+            total = total + _factored_scores(
+                jnp.asarray(p.gamma, dtype),
+                jnp.asarray(p.projection, dtype),
+                feats,
+                ents,
+            )
         else:
             ents = jnp.asarray(data.entity_ids[re_key])
             total = total + _random_scores(

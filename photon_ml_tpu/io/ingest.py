@@ -21,6 +21,37 @@ from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
 
 
+# Avro field-name sets (``avro/FieldNamesType.scala:20``): the driver flag
+# selects which record schema the input uses.
+TRAINING_EXAMPLE_FIELDS = "TRAINING_EXAMPLE"
+RESPONSE_PREDICTION_FIELDS = "RESPONSE_PREDICTION"
+FIELD_NAME_SETS = (TRAINING_EXAMPLE_FIELDS, RESPONSE_PREDICTION_FIELDS)
+
+
+def normalize_field_names(
+    records: List[dict], field_names: str
+) -> List[dict]:
+    """Map a foreign field-name set onto the TrainingExample names every
+    ingest path speaks. RESPONSE_PREDICTION
+    (``avro/ResponsePredictionFieldNames.scala``) calls the label
+    "response"; features/offset/weight share names and uid/metadataMap are
+    absent. Shallow-copies only when renaming is needed."""
+    if field_names == TRAINING_EXAMPLE_FIELDS:
+        return records
+    if field_names != RESPONSE_PREDICTION_FIELDS:
+        raise ValueError(
+            f"unknown field-name set {field_names!r}; expected one of "
+            f"{FIELD_NAME_SETS}"
+        )
+    out = []
+    for rec in records:
+        r = dict(rec)
+        if "label" not in r:
+            r["label"] = r.get("response")
+        out.append(r)
+    return out
+
+
 def _read_label(rec: dict, i: int, allow_null_labels: bool) -> float:
     """Label policy shared by GLM and GAME ingest: scoring input may carry
     null labels (coerced to 0.0 when the caller opts in); training input

@@ -140,6 +140,13 @@ def save_game_model(
     entity_vocabs: coordinate -> {raw_id: index} for RE coordinates;
     random_effects: coordinate -> RE type name or None (fixed)."""
     for name, table in params.items():
+        if _is_factored(table):
+            _save_factored_coordinate(
+                root, name, table, shards[name],
+                random_effects.get(name), entity_vocabs.get(name, {}),
+                vocabs[name],
+            )
+            continue
         table = np.asarray(table)
         re_type = random_effects.get(name)
         kind = "fixed-effect" if re_type is None else "random-effect"
@@ -191,6 +198,11 @@ def load_game_model(
         if not os.path.isdir(kdir):
             continue
         for name in sorted(os.listdir(kdir)):
+            if name not in vocabs:
+                # a coordinate the caller has no vocabulary for (dropped
+                # from the config, or a collapsed-merge name) cannot be
+                # decoded — skip it instead of KeyError-ing the whole load
+                continue
             cdir = os.path.join(kdir, name)
             info = {}
             with open(os.path.join(cdir, "id-info")) as f:
@@ -222,6 +234,22 @@ def load_game_model(
                         table[e], _ = _record_to_coefficients(rec, vocab)
                 params[name] = table
                 entity_vocabs_out[name] = dict(evocab)
+    fdir = os.path.join(root, "factored-random-effect")
+    if os.path.isdir(fdir):
+        for name in sorted(os.listdir(fdir)):
+            if name not in vocabs:
+                continue
+            cdir = os.path.join(fdir, name)
+            evocab = (
+                entity_vocabs.get(name) if entity_vocabs is not None else None
+            )
+            fparams, info, evocab = load_factored_coordinate(
+                cdir, vocabs[name], evocab
+            )
+            params[name] = fparams
+            shards[name] = info.get("featureShardId", name)
+            random_effects[name] = info.get("randomEffectType")
+            entity_vocabs_out[name] = evocab
     return params, shards, random_effects, entity_vocabs_out
 
 
@@ -230,3 +258,168 @@ def _maybe_int(s):
         return int(s)
     except (TypeError, ValueError):
         return s
+
+
+def collapse_game_model(
+    params: Dict[str, np.ndarray],
+    shards: Dict[str, str],
+    random_effects: Dict[str, Optional[str]],
+    entity_vocabs: Dict[str, dict],
+):
+    """Merge coordinates sharing (effect type, feature shard) by
+    coefficient ADDITION (``ModelProcessingUtils.collapseGameModel``
+    :224-264): fixed-effect vectors sum directly; random-effect tables
+    cogroup on the raw entity id (an entity absent from one coordinate
+    contributes zeros). Returns (params, shards, random_effects,
+    entity_vocabs) with merged coordinates named "<effect>-<shard>".
+    Factored coordinates are rejected like the reference's
+    UnsupportedOperationException for unknown model types."""
+    groups: Dict[Tuple[str, str], List[str]] = {}
+    for name in params:
+        if _is_factored(params[name]):
+            raise ValueError(
+                f"collapse of factored coordinate {name!r} is not supported "
+                "(reference ModelProcessingUtils.scala:235-236)"
+            )
+        effect = random_effects.get(name) or "fixed-effect"
+        groups.setdefault((effect, shards[name]), []).append(name)
+
+    out_params: Dict[str, np.ndarray] = {}
+    out_shards: Dict[str, str] = {}
+    out_res: Dict[str, Optional[str]] = {}
+    out_evocabs: Dict[str, dict] = {}
+    for (effect, shard), names in groups.items():
+        merged_name = f"{effect}-{shard}"
+        out_shards[merged_name] = shard
+        re_type = random_effects.get(names[0])
+        out_res[merged_name] = re_type
+        if re_type is None:
+            out_params[merged_name] = np.sum(
+                [np.asarray(params[n]) for n in names], axis=0
+            )
+            continue
+        # cogroup random-effect tables on raw entity ids
+        raw_ids: List = []
+        seen = set()
+        for n in names:
+            for raw in entity_vocabs[n]:
+                if raw not in seen:
+                    seen.add(raw)
+                    raw_ids.append(raw)
+        merged_vocab = {raw: i for i, raw in enumerate(raw_ids)}
+        d = np.asarray(params[names[0]]).shape[1]
+        table = np.zeros((len(raw_ids), d))
+        for n in names:
+            t = np.asarray(params[n])
+            src = np.fromiter(
+                entity_vocabs[n].values(), np.int64,
+                count=len(entity_vocabs[n]),
+            )
+            dst = np.asarray(
+                [merged_vocab[raw] for raw in entity_vocabs[n]], np.int64
+            )
+            np.add.at(table, dst, t[src])
+        out_params[merged_name] = table
+        out_evocabs[merged_name] = merged_vocab
+    return out_params, out_shards, out_res, out_evocabs
+
+
+# ---------------------------------------------------------------------------
+# Factored random effects (latent-factor wire format,
+# ``ModelProcessingUtils.saveMatrixFactorizationModelToHDFS`` :274-332)
+# ---------------------------------------------------------------------------
+
+
+def _is_factored(table) -> bool:
+    return hasattr(table, "gamma") and hasattr(table, "projection")
+
+
+def _save_factored_coordinate(
+    root: str,
+    name: str,
+    params,  # FactoredParams
+    shard: str,
+    re_type: Optional[str],
+    entity_vocab: dict,
+    vocab: FeatureVocabulary,
+):
+    """w_e = B gamma_e saved as two LatentFactorAvro tables: gamma rows
+    keyed by raw entity id, projection rows keyed by the feature key —
+    the factorization survives the round trip (materializing (E, d) would
+    defeat the representation's point)."""
+    from photon_ml_tpu.io.schemas import LATENT_FACTOR_SCHEMA
+
+    gamma = np.asarray(params.gamma)
+    projection = np.asarray(params.projection)
+    cdir = os.path.join(root, "factored-random-effect", name)
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "id-info"), "w") as f:
+        f.write(f"featureShardId={shard}\n")
+        if re_type is not None:
+            f.write(f"randomEffectType={re_type}\n")
+        f.write(f"latentDim={gamma.shape[1]}\n")
+    index_to_id = {v: k for k, v in entity_vocab.items()}
+    write_avro_file(
+        os.path.join(cdir, "latent-factors.avro"),
+        LATENT_FACTOR_SCHEMA,
+        [
+            {
+                "effectId": str(index_to_id.get(e, e)),
+                "latentFactor": [float(v) for v in gamma[e]],
+            }
+            for e in range(gamma.shape[0])
+        ],
+    )
+    write_avro_file(
+        os.path.join(cdir, "projection.avro"),
+        LATENT_FACTOR_SCHEMA,
+        [
+            {
+                "effectId": "{}\x01{}".format(*vocab.name_term(j)),
+                "latentFactor": [float(v) for v in projection[j]],
+            }
+            for j in range(projection.shape[0])
+        ],
+    )
+
+
+def load_factored_coordinate(
+    cdir: str,
+    vocab: FeatureVocabulary,
+    entity_vocab: Optional[dict] = None,
+):
+    """Returns (FactoredParams, info dict, entity_vocab)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.factored import FactoredParams
+
+    info = {}
+    with open(os.path.join(cdir, "id-info")) as f:
+        for line in f:
+            if "=" in line:
+                k, v = line.strip().split("=", 1)
+                info[k] = v
+    k = int(info["latentDim"])
+    _, grecords = read_avro_file(os.path.join(cdir, "latent-factors.avro"))
+    if entity_vocab is None:
+        entity_vocab = {rec["effectId"]: i for i, rec in enumerate(grecords)}
+    gamma = np.zeros((len(entity_vocab), k))
+    for rec in grecords:
+        raw = rec["effectId"]
+        e = entity_vocab.get(raw, entity_vocab.get(_maybe_int(raw)))
+        if e is not None:
+            gamma[e] = rec["latentFactor"]
+    _, precords = read_avro_file(os.path.join(cdir, "projection.avro"))
+    projection = np.zeros((len(vocab), k))
+    for rec in precords:
+        name, _, term = rec["effectId"].partition("\x01")
+        idx = vocab.get(name, term)
+        if idx is not None:
+            projection[idx] = rec["latentFactor"]
+    return (
+        FactoredParams(
+            gamma=jnp.asarray(gamma), projection=jnp.asarray(projection)
+        ),
+        info,
+        dict(entity_vocab),
+    )

@@ -79,6 +79,9 @@ class GLMTrainingConfig:
     upper_bounds: Optional[Tuple[float, ...]] = None
     compute_variances: bool = False
     track_states: bool = True
+    # per-iteration coefficient snapshots (ModelTracker,
+    # ``supervised/model/ModelTracker.scala``) — feeds validate-per-iteration
+    track_models: bool = False
 
     def __post_init__(self):
         import numpy as np
@@ -136,6 +139,7 @@ class GLMTrainingConfig:
             lower_bounds=None if lb is None else jnp.asarray(lb),
             upper_bounds=None if ub is None else jnp.asarray(ub),
             track_states=self.track_states,
+            track_models=self.track_models,
         )
 
 
@@ -246,6 +250,15 @@ def train_glm(
     for lam in sorted(config.reg_weights, reverse=True):
         result = solve(w, jnp.asarray(lam, dtype), batch, norm)
         w = result.w  # warm start for the next (smaller) lambda
+        if config.track_models and result.w_history is not None:
+            # snapshots leave the solver in normalized space; de-normalize
+            # rows so ModelTracker consumers see raw-feature coefficients
+            hist = jax.vmap(
+                lambda m: norm.transform_model_coefficients(
+                    Coefficients(means=m), config.intercept_index
+                ).means
+            )(result.w_history)
+            result = dataclasses.replace(result, w_history=hist)
         var = (
             variances_fn(result.w, jnp.asarray(lam, dtype), batch, norm)
             if config.compute_variances

@@ -17,6 +17,7 @@ from photon_ml_tpu.parallel.mesh import (
     batch_sharding,
     default_mesh,
     entity_sharding,
+    make_feature_mesh,
     make_game_mesh,
     make_mesh,
     replicated,
@@ -26,11 +27,13 @@ from photon_ml_tpu.parallel.mesh import (
 )
 from photon_ml_tpu.parallel.distributed import (
     distributed_train_glm,
+    feature_sharded_train_glm,
     shard_map_value_and_grad,
 )
 
 __all__ = [
     "make_mesh",
+    "make_feature_mesh",
     "make_game_mesh",
     "default_mesh",
     "batch_sharding",
@@ -40,5 +43,6 @@ __all__ = [
     "shard_design",
     "shard_bucketed_design",
     "distributed_train_glm",
+    "feature_sharded_train_glm",
     "shard_map_value_and_grad",
 ]

@@ -28,14 +28,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_ml_tpu.core.types import LabeledBatch
+import dataclasses
+
+import numpy as np
+
+from photon_ml_tpu.core.types import Coefficients, LabeledBatch
 from photon_ml_tpu.models.training import (
     GLMTrainingConfig,
     TrainedModel,
     train_glm,
 )
 from photon_ml_tpu.ops.objective import GLMObjective
-from photon_ml_tpu.parallel.mesh import DATA_AXIS, replicated, shard_batch
+from photon_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FEATURE_AXIS,
+    replicated,
+    shard_batch,
+)
 
 
 def distributed_train_glm(
@@ -54,6 +63,83 @@ def distributed_train_glm(
     sharded = shard_batch(batch, mesh)
     with jax.set_mesh(mesh):
         return train_glm(sharded, config, **kwargs)
+
+
+def feature_sharded_train_glm(
+    batch: LabeledBatch,
+    config: GLMTrainingConfig,
+    mesh: Mesh,
+    **kwargs,
+) -> Sequence[TrainedModel]:
+    """``train_glm`` with the design sharded over BOTH ('data', 'feature')
+    axes and the coefficient vector sharded over 'feature' — the huge-d
+    regime (hundreds of billions of coefficients, README.md:58) where
+    replicating w per device is impossible. Margins contract over the
+    sharded feature axis (XLA inserts the psum); the gradient/CG vectors
+    inherit w's sharding through the jitted solver, so the whole solve is
+    SPMD with coefficient state split across devices.
+
+    Rows pad to the 'data' extent and columns to the 'feature' extent
+    (zero columns solve to exactly 0 and are dropped from the returned
+    coefficients). Dense features only; box constraints and feature-axis
+    normalization are currently unsupported here.
+    """
+    if hasattr(batch.features, "values"):
+        raise ValueError("feature sharding currently requires dense features")
+    if config.lower_bounds is not None or config.upper_bounds is not None:
+        raise ValueError("feature sharding does not support box constraints")
+    from photon_ml_tpu.core.normalization import NormalizationType
+
+    if config.normalization != NormalizationType.NONE:
+        raise ValueError("feature sharding requires NormalizationType.NONE")
+
+    n_rows_shards = mesh.shape[DATA_AXIS]
+    n_col_shards = mesh.shape[FEATURE_AXIS]
+    d = batch.num_features
+    d_pad = -(-d // n_col_shards) * n_col_shards
+    n = batch.batch_size
+    n_pad = -(-n // n_rows_shards) * n_rows_shards
+
+    padded = LabeledBatch.pad_to(batch, n_pad)
+    feats = jnp.pad(padded.features, ((0, 0), (0, d_pad - d)))
+    row_spec = NamedSharding(mesh, P(DATA_AXIS))
+    padded = LabeledBatch(
+        features=jax.device_put(
+            feats, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
+        ),
+        labels=jax.device_put(padded.labels, row_spec),
+        offsets=jax.device_put(padded.offsets, row_spec),
+        weights=jax.device_put(padded.weights, row_spec),
+        mask=jax.device_put(padded.mask, row_spec),
+    )
+    w0 = jax.device_put(
+        jnp.zeros((d_pad,), padded.features.dtype),
+        NamedSharding(mesh, P(FEATURE_AXIS)),
+    )
+    with jax.set_mesh(mesh):
+        models = train_glm(
+            padded,
+            config,
+            initial_coefficients=Coefficients(means=w0),
+            **kwargs,
+        )
+    # strip the zero pad columns from every returned model
+    out = []
+    for tm in models:
+        coef = tm.model.coefficients
+        coef = dataclasses.replace(
+            coef,
+            means=coef.means[:d],
+            variances=(
+                None if coef.variances is None else coef.variances[:d]
+            ),
+        )
+        out.append(
+            dataclasses.replace(
+                tm, model=tm.model.with_coefficients(coef)
+            )
+        )
+    return out
 
 
 def shard_map_value_and_grad(

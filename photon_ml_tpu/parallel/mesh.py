@@ -24,6 +24,7 @@ from photon_ml_tpu.core.types import LabeledBatch
 
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
+FEATURE_AXIS = "feature"
 
 
 def make_mesh(
@@ -49,6 +50,24 @@ def make_game_mesh(
         )
     grid = np.asarray(devs[: n_data * n_entity]).reshape(n_data, n_entity)
     return Mesh(grid, (DATA_AXIS, ENTITY_AXIS))
+
+
+def make_feature_mesh(
+    n_data: int, n_feature: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2D ('data', 'feature') mesh for the huge-d fixed-effect regime
+    (SURVEY §5.7): rows shard over 'data', coefficient/feature columns
+    over 'feature' — the TPU answer to the reference's off-heap coefficient
+    index (``util/PalDBIndexMap.scala:43``), where w no longer fits
+    replicated on one worker."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data * n_feature > len(devs):
+        raise ValueError(
+            f"mesh {n_data}x{n_feature} needs {n_data * n_feature} "
+            f"devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs[: n_data * n_feature]).reshape(n_data, n_feature)
+    return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
 
 
 def default_mesh() -> Mesh:

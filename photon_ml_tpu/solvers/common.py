@@ -64,6 +64,11 @@ class SolverConfig:
     # Record (value, |grad|) per iteration into fixed-size device buffers
     # (``optimization/OptimizationStatesTracker.scala:33-115``).
     track_states: bool = True
+    # Additionally record the COEFFICIENTS per iteration — the reference's
+    # ModelTracker (``supervised/model/ModelTracker.scala``), feeding
+    # validate-per-iteration (``Driver.scala:293-347``). Costs a
+    # (max_iters+1, d) buffer; off by default.
+    track_models: bool = False
 
 
 @_pytree_dataclass
@@ -85,6 +90,10 @@ class SolverResult:
     # total inner CG iterations == Hessian-vector products (TRON only;
     # None for first-order solvers). Feeds FLOP/MFU accounting.
     cg_iterations: Optional[jax.Array] = None
+    # (max_iters+1, d) per-iteration coefficients when track_models
+    # (ModelTracker); entries at index > iterations are unwritten zeros
+    # and must be masked by callers like the values buffer
+    w_history: Optional[jax.Array] = None
 
 
 def project_to_hypercube(
@@ -145,3 +154,15 @@ def tracker_buffers(
 def record_state(values, grad_norms, i, value, grad_norm):
     i = jnp.minimum(i, values.shape[0] - 1)
     return values.at[i].set(value), grad_norms.at[i].set(grad_norm)
+
+
+def model_buffer(max_iters: int, w0: jax.Array, track: bool) -> jax.Array:
+    """(max_iters+1, d) per-iteration coefficient buffer (ModelTracker);
+    one slot when tracking is off."""
+    size = max_iters + 1 if track else 1
+    return jnp.zeros((size,) + w0.shape, w0.dtype).at[0].set(w0)
+
+
+def record_model(buf: jax.Array, i, w: jax.Array) -> jax.Array:
+    i = jnp.minimum(i, buf.shape[0] - 1)
+    return buf.at[i].set(w)

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from photon_ml_tpu.solvers.common import (
+    model_buffer,
+    record_model,
     ConvergenceReason,
     SolverConfig,
     SolverResult,
@@ -124,6 +126,7 @@ class _LbfgsState(NamedTuple):
     grad_norm_initial: jax.Array
     values: jax.Array
     grad_norms: jax.Array
+    w_history: jax.Array
 
 
 def minimize_lbfgs(
@@ -143,6 +146,7 @@ def minimize_lbfgs(
     values, grad_norms = tracker_buffers(config.max_iters, dtype, config.track_states)
     gnorm0 = jnp.linalg.norm(g0)
     values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
+    w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
 
     init = _LbfgsState(
         w=w0,
@@ -159,6 +163,7 @@ def minimize_lbfgs(
         grad_norm_initial=gnorm0,
         values=values,
         grad_norms=grad_norms,
+        w_history=w_hist0,
     )
 
     def body(s: _LbfgsState) -> _LbfgsState:
@@ -236,6 +241,7 @@ def minimize_lbfgs(
             grad_norm_initial=s.grad_norm_initial,
             values=values,
             grad_norms=grad_norms,
+            w_history=record_model(s.w_history, it, w_new),
         )
 
     final = lax.while_loop(
@@ -249,6 +255,7 @@ def minimize_lbfgs(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        w_history=final.w_history if config.track_models else None,
     )
 
 
@@ -277,6 +284,7 @@ class _OwlqnState(NamedTuple):
     grad_norm_initial: jax.Array
     values: jax.Array
     grad_norms: jax.Array
+    w_history: jax.Array
 
 
 def minimize_owlqn(
@@ -304,6 +312,7 @@ def minimize_owlqn(
     pgnorm0 = jnp.linalg.norm(pg0)
     values, grad_norms = tracker_buffers(config.max_iters, dtype, config.track_states)
     values, grad_norms = record_state(values, grad_norms, 0, f0, pgnorm0)
+    w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
 
     init = _OwlqnState(
         w=w0,
@@ -321,6 +330,7 @@ def minimize_owlqn(
         grad_norm_initial=pgnorm0,
         values=values,
         grad_norms=grad_norms,
+        w_history=w_hist0,
     )
 
     def body(s: _OwlqnState) -> _OwlqnState:
@@ -412,6 +422,7 @@ def minimize_owlqn(
             grad_norm_initial=s.grad_norm_initial,
             values=values,
             grad_norms=grad_norms,
+            w_history=record_model(s.w_history, it, w_new),
         )
 
     final = lax.while_loop(
@@ -425,4 +436,5 @@ def minimize_owlqn(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        w_history=final.w_history if config.track_models else None,
     )

@@ -36,6 +36,8 @@ from photon_ml_tpu.solvers.common import (
     SolverConfig,
     SolverResult,
     check_convergence,
+    model_buffer,
+    record_model,
     record_state,
     tracker_buffers,
 )
@@ -142,6 +144,7 @@ class _TronState(NamedTuple):
     values: jax.Array
     grad_norms: jax.Array
     cg_total: jax.Array
+    w_history: jax.Array
 
 
 def minimize_tron(
@@ -156,6 +159,7 @@ def minimize_tron(
     gnorm0 = jnp.linalg.norm(g0)
     values, grad_norms = tracker_buffers(config.max_iters, dtype, config.track_states)
     values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
+    w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
 
     init = _TronState(
         w=w0,
@@ -174,6 +178,7 @@ def minimize_tron(
         values=values,
         grad_norms=grad_norms,
         cg_total=jnp.int32(0),
+        w_history=w_hist0,
     )
 
     def body(s: _TronState) -> _TronState:
@@ -264,6 +269,7 @@ def minimize_tron(
             values=values,
             grad_norms=grad_norms,
             cg_total=s.cg_total + cg_iters,
+            w_history=record_model(s.w_history, it, w_new),
         )
 
     final = lax.while_loop(
@@ -278,4 +284,5 @@ def minimize_tron(
         values=final.values,
         grad_norms=final.grad_norms,
         cg_iterations=final.cg_total,
+        w_history=final.w_history if config.track_models else None,
     )

@@ -454,3 +454,471 @@ class TestUtils:
             log.info("visible")
         text = path.read_text()
         assert "visible" in text and "hidden" not in text
+
+
+def make_sparse_user_records(rng, n_users, rows_per_user, d_g, d_u, truth=None):
+    """Per-entity-sparse fixture: each user's rows touch only ITS OWN pair
+    of user features (uf{2u}, uf{2u+1}) out of a d_u-wide space — the
+    regime INDEX_MAP projection compacts losslessly."""
+    if truth is None:
+        w_g = rng.normal(size=d_g)
+        w_u = rng.normal(size=(n_users, d_u)) * 2.0
+    else:
+        w_g, w_u = truth
+    records = []
+    i = 0
+    for u in range(n_users):
+        j0, j1 = (2 * u) % d_u, (2 * u + 1) % d_u
+        for _ in range(rows_per_user):
+            xg = rng.normal(size=d_g)
+            x0, x1 = rng.normal(), rng.normal()
+            margin = xg @ w_g + x0 * w_u[u, j0] + x1 * w_u[u, j1]
+            y = float(rng.uniform() < _sigmoid(margin))
+            feats = [
+                {"name": f"gf{j}", "term": "", "value": float(xg[j])}
+                for j in range(d_g)
+            ] + [
+                {"name": f"uf{j0}", "term": "", "value": float(x0)},
+                {"name": f"uf{j1}", "term": "", "value": float(x1)},
+            ]
+            records.append(
+                {
+                    "uid": f"row{i}",
+                    "label": y,
+                    "features": feats,
+                    "metadataMap": {"userId": f"user{u}"},
+                    "weight": None,
+                    "offset": None,
+                }
+            )
+            i += 1
+    return records, (w_g, w_u)
+
+
+class TestProjectedGameDriver:
+    D_U = 10
+
+    @pytest.fixture
+    def sparse_game_fixture(self, rng, tmp_path):
+        trecords, truth = make_sparse_user_records(
+            rng, n_users=10, rows_per_user=30, d_g=3, d_u=self.D_U
+        )
+        vrecords, _ = make_sparse_user_records(
+            rng, n_users=10, rows_per_user=10, d_g=3, d_u=self.D_U,
+            truth=truth,
+        )
+        train = write_records(str(tmp_path / "ptrain.avro"), trecords)
+        valid = write_records(str(tmp_path / "pvalid.avro"), vrecords)
+        gshard = write_feature_file(
+            str(tmp_path / "pg.features"), [f"gf{j}" for j in range(3)]
+        )
+        ushard = write_feature_file(
+            str(tmp_path / "pu.features"),
+            [f"uf{j}" for j in range(self.D_U)],
+        )
+        return train, valid, gshard, ushard, tmp_path
+
+    def _params(self, fixture, out, projector=None, **over):
+        train, valid, gs, us, tmp = fixture
+        p = game_params(train, valid, gs, us, out, **over)
+        if projector is not None:
+            p["coordinates"]["per-user"]["projector"] = projector
+        return p
+
+    def test_index_map_reproduces_unprojected(self, sparse_game_fixture):
+        tmp = sparse_game_fixture[4]
+        plain = run_game_training(
+            self._params(sparse_game_fixture, str(tmp / "plain"))
+        )
+        proj = run_game_training(
+            self._params(
+                sparse_game_fixture, str(tmp / "proj"),
+                projector="INDEX_MAP",
+            )
+        )
+        # per-entity-sparse + L2: unused columns solve to exactly 0, so the
+        # compacted solve reproduces the full-space solution
+        np.testing.assert_allclose(
+            np.asarray(proj.sweep[0]["model"].params["per-user"]),
+            np.asarray(plain.sweep[0]["model"].params["per-user"]),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            proj.sweep[0]["validation_metric"],
+            plain.sweep[0]["validation_metric"],
+            atol=1e-6,
+        )
+
+    def test_random_projector_trains_saves_loads_scores(
+        self, sparse_game_fixture
+    ):
+        train, valid, gs, us, tmp = sparse_game_fixture
+        out = str(tmp / "rand")
+        run = run_game_training(
+            self._params(
+                sparse_game_fixture, out, projector="RANDOM=4"
+            )
+        )
+        # the in-memory + on-disk model is in ORIGINAL feature space
+        table = np.asarray(run.sweep[0]["model"].params["per-user"])
+        assert table.shape == (10, self.D_U + 1)  # + intercept
+        srun = run_scoring(
+            {
+                "input": [valid],
+                "model_dir": out,
+                "output_dir": str(tmp / "rand-scores"),
+                "model_kind": "game",
+                "evaluate": True,
+            }
+        )
+        auc = srun.metrics["AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"]
+        # scoring the saved model reproduces the driver's own validation
+        np.testing.assert_allclose(
+            auc, run.sweep[run.best_index]["validation_metric"], atol=1e-9
+        )
+        assert auc > 0.6
+
+    def test_identity_projector_matches_no_projector(
+        self, sparse_game_fixture
+    ):
+        tmp = sparse_game_fixture[4]
+        plain = run_game_training(
+            self._params(sparse_game_fixture, str(tmp / "id-plain"))
+        )
+        ident = run_game_training(
+            self._params(
+                sparse_game_fixture, str(tmp / "id-proj"),
+                projector="IDENTITY",
+            )
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ident.sweep[0]["model"].params["per-user"]),
+            np.asarray(plain.sweep[0]["model"].params["per-user"]),
+        )
+
+    def test_unknown_projector_rejected(self, sparse_game_fixture):
+        tmp = sparse_game_fixture[4]
+        with pytest.raises(ValueError, match="unknown projector"):
+            run_game_training(
+                self._params(
+                    sparse_game_fixture, str(tmp / "bad"),
+                    projector="HASHING",
+                )
+            )
+
+
+class TestFactoredGameDriver:
+    def test_factored_trains_saves_loads_scores(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        out = str(tmp / "fact")
+        params = game_params(train, valid, gs, us, out)
+        params["coordinates"]["per-user"]["latent_dim"] = 2
+        params["coordinates"]["per-user"]["num_inner_iterations"] = 2
+        params["coordinates"]["per-user"]["latent_reg_weight"] = 0.1
+        run = run_game_training(params)
+        model = run.sweep[0]["model"]
+        fp = model.params["per-user"]
+        assert hasattr(fp, "gamma") and hasattr(fp, "projection")
+        assert np.asarray(fp.gamma).shape == (12, 2)
+        assert np.asarray(fp.projection).shape == (3, 2)  # 2 + intercept
+        # training objective decreased and validation ran per update
+        hist = run.sweep[0]["history"]
+        objs = [h.objective for h in hist]
+        assert all(b <= a + 1e-6 for a, b in zip(objs, objs[1:]))
+        # on-disk: latent wire format under factored-random-effect/
+        best = run.output_dirs[0]
+        fdir = os.path.join(best, "factored-random-effect", "per-user")
+        assert os.path.exists(os.path.join(fdir, "latent-factors.avro"))
+        assert os.path.exists(os.path.join(fdir, "projection.avro"))
+
+        srun = run_scoring(
+            {
+                "input": [valid],
+                "model_dir": out,
+                "output_dir": str(tmp / "fact-scores"),
+                "model_kind": "game",
+                "evaluate": True,
+            }
+        )
+        auc = srun.metrics["AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"]
+        # scoring the saved latent tables reproduces the driver's own
+        # final validation metric exactly
+        np.testing.assert_allclose(
+            auc, run.sweep[run.best_index]["validation_metric"], atol=1e-9
+        )
+
+    def test_factored_with_projector_rejected(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        params = game_params(train, valid, gs, us, str(tmp / "factbad"))
+        params["coordinates"]["per-user"]["latent_dim"] = 2
+        params["coordinates"]["per-user"]["projector"] = "INDEX_MAP"
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            run_game_training(params)
+
+    def test_factored_latent_round_trip_io(self, rng, tmp_path):
+        """save -> load preserves gamma and projection exactly (through
+        the raw-entity-id and feature-key mappings)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.factored import FactoredParams
+        from photon_ml_tpu.io.models import (
+            load_game_model,
+            save_game_model,
+        )
+        from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+        e, d, k = 5, 4, 2
+        gamma = rng.normal(size=(e, k))
+        projection = rng.normal(size=(d, k))
+        vocab = FeatureVocabulary(
+            [feature_key(f"f{j}", "t") for j in range(d)]
+        )
+        evocab = {f"user{i}": i for i in range(e)}
+        root = str(tmp_path / "fmodel")
+        save_game_model(
+            root,
+            params={
+                "fact": FactoredParams(
+                    gamma=jnp.asarray(gamma),
+                    projection=jnp.asarray(projection),
+                )
+            },
+            shards={"fact": "ushard"},
+            vocabs={"fact": vocab},
+            entity_vocabs={"fact": evocab},
+            random_effects={"fact": "userId"},
+        )
+        params, shards, res, evs = load_game_model(
+            root, {"fact": vocab}, {"fact": evocab}
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["fact"].gamma), gamma, atol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["fact"].projection), projection, atol=1e-12
+        )
+        assert shards["fact"] == "ushard"
+        assert res["fact"] == "userId"
+
+
+class TestWarmStartAndCollapse:
+    def test_glm_warm_start_converges_immediately(self, rng, glm_fixture):
+        train, valid, tmp = glm_fixture
+        common = {
+            "train_input": [train],
+            "optimizer": "LBFGS",
+            "reg_weights": [1.0],
+            "max_iters": 200,
+            "tolerance": 1e-12,
+        }
+        first = run_glm_training(
+            {**common, "output_dir": str(tmp / "ws1"), "model_output_mode": "ALL"}
+        )
+        # models/ holds the single trained model; warm-start from it
+        mdir = os.path.join(str(tmp / "ws1"), "models")
+        model_file = [f for f in os.listdir(mdir) if f.endswith(".avro")][0]
+        second = run_glm_training(
+            {
+                **common,
+                "output_dir": str(tmp / "ws2"),
+                "initial_model_dir": os.path.join(mdir, model_file),
+            }
+        )
+        # warm start at the optimum: convergence within a couple iterations
+        assert int(second.models[0].result.iterations) <= 3
+        np.testing.assert_allclose(
+            np.asarray(second.models[0].model.coefficients.means),
+            np.asarray(first.models[0].model.coefficients.means),
+            atol=1e-4,
+        )
+
+    def test_game_warm_start_starts_near_optimum(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        first = run_game_training(
+            game_params(train, None, gs, us, str(tmp / "gws1"),
+                        model_output_mode="ALL", num_iterations=3)
+        )
+        warm = run_game_training(
+            game_params(
+                train, None, gs, us, str(tmp / "gws2"),
+                num_iterations=1,
+                initial_model_dir=first.output_dirs[0],
+            )
+        )
+        # the warm run's FIRST objective must already be at (or below)
+        # the cold run's final objective
+        cold_final = first.sweep[0]["history"][-1].objective
+        warm_first = warm.sweep[0]["history"][0].objective
+        assert warm_first <= cold_final + 1e-4
+
+    def test_collapse_game_model_sums_coefficients(self, rng):
+        from photon_ml_tpu.io.models import collapse_game_model
+
+        params = {
+            "a": np.asarray([[1.0, 2.0], [3.0, 4.0]]),  # RE table
+            "b": np.asarray([[10.0, 20.0], [30.0, 40.0]]),
+            "f1": np.asarray([1.0, 1.0, 1.0]),
+            "f2": np.asarray([2.0, 2.0, 2.0]),
+        }
+        shards = {"a": "us", "b": "us", "f1": "gs", "f2": "gs"}
+        res = {"a": "userId", "b": "userId", "f1": None, "f2": None}
+        evocabs = {
+            "a": {"u0": 0, "u1": 1},
+            "b": {"u1": 0, "u2": 1},  # overlapping + disjoint entities
+        }
+        p, s, r, ev = collapse_game_model(params, shards, res, evocabs)
+        assert set(p) == {"userId-us", "fixed-effect-gs"}
+        np.testing.assert_allclose(
+            p["fixed-effect-gs"], [3.0, 3.0, 3.0]
+        )
+        merged = p["userId-us"]
+        mv = ev["userId-us"]
+        np.testing.assert_allclose(merged[mv["u0"]], [1.0, 2.0])
+        np.testing.assert_allclose(merged[mv["u1"]], [13.0, 24.0])  # summed
+        np.testing.assert_allclose(merged[mv["u2"]], [30.0, 40.0])
+
+    def test_collapse_output_driver_flag(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        # two coordinates on the SAME shard + RE type -> one merged model
+        params = game_params(train, valid, gs, us, str(tmp / "gcol"))
+        params["coordinates"]["per-user-2"] = dict(
+            params["coordinates"]["per-user"]
+        )
+        params["updating_sequence"] = ["global", "per-user", "per-user-2"]
+        params["collapse_output"] = True
+        run = run_game_training(params)
+        best = run.output_dirs[0]
+        merged = os.path.join(best, "random-effect", "userId-ushard")
+        assert os.path.isdir(merged), os.listdir(
+            os.path.join(best, "random-effect")
+        )
+        # merged model scores = sum of both coordinates' contributions
+        srun = run_scoring(
+            {
+                "input": [valid],
+                "model_dir": str(tmp / "gcol"),
+                "output_dir": str(tmp / "gcol-scores"),
+                "model_kind": "game",
+            }
+        )
+        assert np.abs(srun.scores).max() > 0
+
+
+class TestResponsePredictionFieldNames:
+    RESPONSE_SCHEMA = {
+        "name": "SimplifiedResponsePrediction",
+        "namespace": "com.linkedin.lab.regression.avro",
+        "type": "record",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {
+                "name": "features",
+                "type": {
+                    "type": "array",
+                    "items": {
+                        "name": "RPFeature",
+                        "type": "record",
+                        "fields": [
+                            {"name": "name", "type": "string"},
+                            {"name": "term", "type": "string"},
+                            {"name": "value", "type": "double"},
+                        ],
+                    },
+                },
+            },
+            {"name": "weight", "type": "double", "default": 1.0},
+            {"name": "offset", "type": "double", "default": 0.0},
+        ],
+    }
+
+    def test_trains_from_response_prediction_records(self, rng, tmp_path):
+        n, d = 300, 4
+        x = rng.normal(size=(n, d))
+        w = rng.normal(size=d)
+        y = (rng.uniform(size=n) < _sigmoid(x @ w)).astype(float)
+        recs = [
+            {
+                "response": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[i, j])}
+                    for j in range(d)
+                ],
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+            for i in range(n)
+        ]
+        tdir = tmp_path / "rp"
+        tdir.mkdir()
+        write_avro_file(
+            str(tdir / "part-0.avro"), self.RESPONSE_SCHEMA, recs
+        )
+        run = run_glm_training(
+            {
+                "train_input": [str(tdir)],
+                "output_dir": str(tmp_path / "rp-out"),
+                "field_names": "RESPONSE_PREDICTION",
+                "optimizer": "TRON",
+                "reg_weights": [1.0],
+                "max_iters": 50,
+            }
+        )
+        coef = np.asarray(run.models[0].model.coefficients.means)
+        assert np.all(np.isfinite(coef)) and np.abs(coef).max() > 0.1
+        # sign agreement with the generating weights (strong signal)
+        idx = [run.vocab.get(f"f{j}", "") for j in range(d)]
+        assert np.all(np.sign(coef[idx]) == np.sign(w))
+
+    def test_unknown_field_names_rejected(self, rng, tmp_path):
+        from photon_ml_tpu.io.ingest import normalize_field_names
+
+        with pytest.raises(ValueError, match="unknown field-name set"):
+            normalize_field_names([], "ADMM_WHATEVER")
+
+
+class TestValidatePerIteration:
+    def test_snapshots_and_metrics_per_iteration(self, rng, glm_fixture):
+        train, valid, tmp = glm_fixture
+        run = run_glm_training(
+            {
+                "train_input": [train],
+                "validate_input": [valid],
+                "output_dir": str(tmp / "vpi"),
+                "optimizer": "LBFGS",
+                "reg_weights": [1.0],
+                "max_iters": 30,
+                "validate_per_iteration": True,
+            }
+        )
+        hist = run.models[0].result.w_history
+        iters = int(run.models[0].result.iterations)
+        assert hist is not None and hist.shape[0] == 31
+        # final snapshot equals the returned model coefficients (both are
+        # de-normalized raw-space)
+        np.testing.assert_allclose(
+            np.asarray(hist[iters]),
+            np.asarray(run.models[0].model.coefficients.means),
+            atol=1e-12,
+        )
+        path = os.path.join(str(tmp / "vpi"), "per-iteration-metrics.json")
+        assert os.path.exists(path)
+        data = json.load(open(path))
+        rows = data["0_lambda_1"]
+        assert len(rows) == iters + 1
+        aucs = [
+            r["AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS"] for r in rows
+        ]
+        # AUC improves from the zero-model start to the converged model
+        assert aucs[-1] > aucs[0]
+        assert aucs[-1] > 0.85
+
+    def test_requires_validation_input(self, rng, glm_fixture):
+        train, _, tmp = glm_fixture
+        with pytest.raises(ValueError, match="validate_per_iteration"):
+            run_glm_training(
+                {
+                    "train_input": [train],
+                    "output_dir": str(tmp / "vpi2"),
+                    "validate_per_iteration": True,
+                }
+            )

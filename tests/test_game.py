@@ -385,3 +385,303 @@ class TestDownSamplers:
         )
         assert abs(w.sum() - 5000) / 5000 < 0.15
         assert (w > 0).mean() == pytest.approx(0.1, abs=0.03)
+
+
+class TestPerEntityRegWeights:
+    def test_matches_per_entity_separate_solves(self, rng):
+        """An (E,) reg-weight vector must reproduce E independent
+        train_glm solves each run at its own lambda."""
+        from photon_ml_tpu.models import (
+            GLMTrainingConfig,
+            TaskType as TT,
+            train_glm,
+        )
+        from photon_ml_tpu.core.types import LabeledBatch
+        from photon_ml_tpu.ops import RegularizationContext
+
+        n_users, rows, d = 6, 40, 3
+        data, user, _ = make_mixed_effects_data(
+            rng, n_users=n_users, rows_per_user=rows, d_user=d, d_global=2
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        lambdas = np.asarray([0.1, 0.5, 1.0, 2.0, 5.0, 10.0])
+        re_cfg = CoordinateConfig(
+            shard="per_user",
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            reg_weight=999.0,  # must be ignored when reg_weights given
+            max_iters=50,
+            tolerance=1e-10,
+            random_effect="userId",
+        )
+        coord = RandomEffectCoordinate(
+            design=design,
+            row_features=jnp.asarray(data.features["per_user"], jnp.float64),
+            row_entities=jnp.asarray(data.entity_ids["userId"]),
+            full_offsets_base=jnp.zeros(data.num_rows, jnp.float64),
+            config=re_cfg,
+            reg_weights=lambdas,
+        )
+        table, _ = coord.update(
+            coord.initial_params(), jnp.zeros(data.num_rows, jnp.float64)
+        )
+        table = np.asarray(table)
+
+        for e in range(n_users):
+            sel = user == e
+            batch = LabeledBatch.create(
+                data.features["per_user"][sel],
+                data.labels[sel],
+                weights=data.weights[sel],
+                dtype=jnp.float64,
+            )
+            (tm,) = train_glm(
+                batch,
+                GLMTrainingConfig(
+                    task=TT.LOGISTIC_REGRESSION,
+                    optimizer=OptimizerType.TRON,
+                    regularization=RegularizationContext("L2"),
+                    reg_weights=(float(lambdas[e]),),
+                    max_iters=50,
+                    tolerance=1e-10,
+                    track_states=False,
+                ),
+            )
+            np.testing.assert_allclose(
+                table[e],
+                np.asarray(tm.model.coefficients.means),
+                atol=1e-6,
+                err_msg=f"entity {e} lambda {lambdas[e]}",
+            )
+
+    def test_reg_term_uses_per_entity_weights(self, rng):
+        n_users = 4
+        data, _, _ = make_mixed_effects_data(
+            rng, n_users=n_users, rows_per_user=10, d_user=2, d_global=2
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        lambdas = np.asarray([1.0, 2.0, 3.0, 4.0])
+        coord = RandomEffectCoordinate(
+            design=design,
+            row_features=jnp.asarray(data.features["per_user"], jnp.float64),
+            row_entities=jnp.asarray(data.entity_ids["userId"]),
+            full_offsets_base=jnp.zeros(data.num_rows, jnp.float64),
+            config=CoordinateConfig(
+                shard="per_user", random_effect="userId"
+            ),
+            reg_weights=lambdas,
+        )
+        table = rng.normal(size=(n_users, 2))
+        expected = sum(
+            0.5 * lambdas[e] * table[e] @ table[e] for e in range(n_users)
+        )
+        np.testing.assert_allclose(
+            float(coord.reg_term(jnp.asarray(table))), expected, rtol=1e-12
+        )
+
+    def test_shape_mismatch_rejected(self, rng):
+        data, _, _ = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=5, d_user=2, d_global=2
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", 4, dtype=jnp.float64
+        )
+        with pytest.raises(ValueError, match="reg_weights"):
+            RandomEffectCoordinate(
+                design=design,
+                row_features=jnp.asarray(data.features["per_user"]),
+                row_entities=jnp.asarray(data.entity_ids["userId"]),
+                full_offsets_base=jnp.zeros(data.num_rows),
+                config=CoordinateConfig(
+                    shard="per_user", random_effect="userId"
+                ),
+                reg_weights=np.ones(7),
+            )
+
+
+class TestPearsonFeatureSelection:
+    def _oracle_scores(self, x, y):
+        """Independent per-entity oracle via numpy.corrcoef."""
+        d = x.shape[1]
+        out = np.full(d, -np.inf)
+        for j in range(d):
+            col = x[:, j]
+            if not np.any(col != 0):
+                continue
+            if col.std() < 1e-8:
+                out[j] = 0.0  # handled separately for the intercept rule
+                continue
+            out[j] = abs(np.corrcoef(col, y)[0, 1])
+        return out
+
+    def test_scores_match_numpy_corrcoef(self, rng):
+        from photon_ml_tpu.game.data import pearson_correlation_scores
+
+        e, r, d = 3, 50, 6
+        x = rng.normal(size=(e, r, d))
+        y = (rng.uniform(size=(e, r)) < 0.5).astype(float)
+        mask = np.ones((e, r))
+        scores = pearson_correlation_scores(x, y, mask)
+        for i in range(e):
+            oracle = self._oracle_scores(x[i], y[i])
+            sel = np.isfinite(oracle) & (oracle > 0)
+            np.testing.assert_allclose(
+                scores[i][sel], oracle[sel], atol=1e-9
+            )
+
+    def test_intercept_rule_and_absent_features(self, rng):
+        from photon_ml_tpu.game.data import pearson_correlation_scores
+
+        r = 30
+        y = rng.normal(size=(1, r))
+        x = np.zeros((1, r, 4))
+        x[0, :, 0] = 1.0  # constant (intercept-like)
+        x[0, :, 1] = 1.0  # second constant -> 0.0
+        x[0, :, 2] = y[0] + 0.1 * rng.normal(size=r)  # informative
+        # feature 3 absent -> -inf
+        scores = pearson_correlation_scores(x, y, np.ones((1, r)))
+        assert scores[0, 0] == 1.0
+        assert scores[0, 1] == 0.0
+        assert scores[0, 2] > 0.5
+        assert scores[0, 3] == -np.inf
+
+    def test_selection_keeps_informative_features(self, rng):
+        """With ratio small, the informative features survive and noise
+        columns are zeroed; solves then match a hand-filtered design."""
+        from photon_ml_tpu.game.data import select_features_by_pearson
+
+        n_users, rows, d = 5, 150, 8
+        user = np.repeat(np.arange(n_users), rows)
+        x = rng.normal(size=(n_users * rows, d))
+        w = np.zeros((n_users, d))
+        w[:, 0] = 3.0
+        w[:, 1] = -3.0  # only features 0,1 matter
+        margin = np.einsum("nd,nd->n", x, w[user])
+        y = (rng.uniform(size=user.size) < 1 / (1 + np.exp(-margin))).astype(
+            float
+        )
+        data = GameData.create(
+            features={"per_user": x},
+            labels=y,
+            entity_ids={"userId": user},
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        selected = select_features_by_pearson(design, ratio=2.0 / rows)
+        feats = np.asarray(selected.features)
+        for e in range(n_users):
+            kept = np.nonzero(np.abs(feats[e]).sum(axis=0) > 0)[0]
+            assert len(kept) == 2
+            assert set(kept) == {0, 1}
+
+    def test_ratio_cap_scales_with_entity_rows(self, rng):
+        from photon_ml_tpu.game.data import select_features_by_pearson
+
+        # two entities with different row counts -> different k
+        user = np.asarray([0] * 10 + [1] * 40)
+        x = rng.normal(size=(50, 8))
+        y = (rng.uniform(size=50) < 0.5).astype(float)
+        data = GameData.create(
+            features={"per_user": x}, labels=y, entity_ids={"userId": user}
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", 2, dtype=jnp.float64
+        )
+        selected = select_features_by_pearson(design, ratio=0.1)
+        feats = np.asarray(selected.features)
+        kept0 = (np.abs(feats[0]).sum(axis=0) > 0).sum()
+        kept1 = (np.abs(feats[1]).sum(axis=0) > 0).sum()
+        assert kept0 == 1  # ceil(0.1 * 10)
+        assert kept1 == 4  # ceil(0.1 * 40)
+
+
+class TestGatheredDownsampling:
+    def test_gathered_solve_matches_full_batch_zero_weights(self, rng):
+        """The gathered small-batch solve must produce the same solution
+        as solving the full batch with the same zeroed weights."""
+        import jax
+
+        from photon_ml_tpu.game.coordinates import (
+            _downsample_budget,
+            _make_gathered_solve,
+            _make_solve,
+        )
+
+        n, d = 400, 5
+        x = rng.normal(size=(n, d))
+        w_true = rng.normal(size=d)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w_true))).astype(
+            float
+        )
+        cfg = CoordinateConfig(
+            shard="global",
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            reg_weight=1.0,
+            max_iters=40,
+            tolerance=1e-10,
+            down_sampling_rate=0.3,
+        )
+        budget = _downsample_budget(y, np.ones(n), 0.3, binary=True)
+        assert budget < n  # it actually shrinks the batch
+
+        from photon_ml_tpu.game.coordinates import (
+            _binary_downsample_weights,
+        )
+
+        key = jax.random.PRNGKey(7)
+        weights = np.asarray(
+            _binary_downsample_weights(
+                key, jnp.ones(n), jnp.asarray(y), 0.3
+            )
+        )
+
+        gather_solve = _make_gathered_solve(cfg, budget)
+        full_solve = _make_solve(cfg, batched=False)
+        args = (
+            jnp.zeros(d),
+            jnp.asarray(1.0),
+            jnp.asarray(x),
+            jnp.asarray(y),
+            jnp.zeros(n),
+            jnp.asarray(weights),
+            jnp.ones(n),
+        )
+        got = gather_solve(*args)
+        want = full_solve(*args)
+        np.testing.assert_allclose(
+            np.asarray(got.w), np.asarray(want.w), atol=1e-6
+        )
+
+    def test_fixed_coordinate_uses_gathered_path(self, rng):
+        import jax
+
+        data, user, n_users = make_mixed_effects_data(
+            rng, n_users=10, rows_per_user=40
+        )
+        cfg = CoordinateConfig(
+            shard="global",
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            reg_weight=1.0,
+            max_iters=20,
+            tolerance=1e-8,
+            down_sampling_rate=0.25,
+        )
+        coord = FixedEffectCoordinate(
+            data.fixed_effect_batch("global", jnp.float64), cfg
+        )
+        assert coord._ds_budget is not None
+        assert coord._ds_budget < data.num_rows
+        w, result = coord.update(
+            coord.initial_params(),
+            jnp.zeros(data.num_rows),
+            key=jax.random.PRNGKey(3),
+        )
+        assert np.all(np.isfinite(np.asarray(w)))
+        assert np.linalg.norm(np.asarray(w)) > 0.1
