@@ -70,6 +70,11 @@ class GLMDriverParams:
     # float64 matches the reference's double-precision solves; silently
     # degrades to float32 when x64 is disabled (default on TPU backends)
     precision: str = "float64"
+    # emit a jax.profiler trace of the train phase under
+    # <output_dir>/profile (TensorBoard-loadable) — SURVEY §5.1
+    profile: bool = False
+    # fail at the first NaN-producing op inside training — SURVEY §5.2
+    debug_nans: bool = False
 
     def validate(self) -> None:
         if not self.train_input:

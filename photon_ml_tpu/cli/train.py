@@ -183,7 +183,11 @@ def run_glm_training(params) -> GLMTrainingRun:
 
     # ---- TRAIN -----------------------------------------------------------
     tracker.assert_at_least(DriverStage.PREPROCESSED)
-    with timed(logger, "train"):
+    from photon_ml_tpu.utils.debug import debug_nans, profile_trace
+
+    with timed(logger, "train"), profile_trace(
+        os.path.join(params.output_dir, "profile") if params.profile else None
+    ), debug_nans(params.debug_nans):
         cfg = dataclasses.replace(
             params.to_training_config(),
             intercept_index=vocab.intercept_index,
@@ -414,6 +418,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--training-diagnostics", action="store_true", default=None
     )
+    p.add_argument("--profile", action="store_true", default=None)
+    p.add_argument("--debug-nans", action="store_true", default=None)
     return p
 
 

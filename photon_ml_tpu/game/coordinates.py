@@ -266,10 +266,35 @@ class RandomEffectUpdateSummary:
     """Per-entity tracker view of one (possibly multi-bucket) update —
     the fields CoordinateDescent's histogram consumes, concatenated over
     buckets with sharding-padding lanes removed
-    (``RandomEffectOptimizationTracker.scala:33-110``)."""
+    (``RandomEffectOptimizationTracker.scala:33-110``).
 
-    reason: np.ndarray  # (E_active,) int32
-    iterations: np.ndarray  # (E_active,) int32
+    LAZY: holds device arrays until `.reason` / `.iterations` is first
+    read, so the coordinate-descent loop can enqueue the next update
+    without a device->host sync per pass (the reference pays a collect
+    per tracker read; we defer it to history materialization)."""
+
+    # [(reason_dev (E_b,), iterations_dev (E_b,), valid mask), ...]
+    pending: list
+
+    def _materialize(self):
+        if self.pending is not None:
+            self._reason = np.concatenate(
+                [np.asarray(r)[v] for r, _, v in self.pending]
+            )
+            self._iterations = np.concatenate(
+                [np.asarray(i)[v] for _, i, v in self.pending]
+            )
+            self.pending = None
+
+    @property
+    def reason(self) -> np.ndarray:  # (E_active,) int32
+        self._materialize()
+        return self._reason
+
+    @property
+    def iterations(self) -> np.ndarray:  # (E_active,) int32
+        self._materialize()
+        return self._iterations
 
 
 def _make_bucket_update(config: CoordinateConfig):
@@ -387,7 +412,7 @@ class RandomEffectCoordinate:
         self, table: jax.Array, partial_scores: jax.Array, key=None
     ) -> Tuple[jax.Array, object]:
         full_offsets = self.full_offsets_base + partial_scores
-        reasons, iters = [], []
+        pending = []
         for bucket, entity_index, valid in zip(
             self.design.buckets, self.design.entity_index, self._valid_lanes
         ):
@@ -402,13 +427,8 @@ class RandomEffectCoordinate:
                 bucket.weights,
                 bucket.mask,
             )
-            reasons.append(np.asarray(result.reason)[valid])
-            iters.append(np.asarray(result.iterations)[valid])
-        summary = RandomEffectUpdateSummary(
-            reason=np.concatenate(reasons),
-            iterations=np.concatenate(iters),
-        )
-        return table, summary
+            pending.append((result.reason, result.iterations, valid))
+        return table, RandomEffectUpdateSummary(pending=pending)
 
     def score(self, table: jax.Array) -> jax.Array:
         return self._score(table, self.row_features, self.row_entities)

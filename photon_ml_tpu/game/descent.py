@@ -175,6 +175,45 @@ class CoordinateDescent:
             n: self.coordinates[n].score(model.params[n]) for n in names
         }
 
+        # Per-update device stats stay ON DEVICE during the loop (objective
+        # scalar, per-entity solver trackers) so consecutive updates
+        # pipeline without a host sync each pass — the deferred analog of
+        # the reference's post-hoc tracker collects. They materialize into
+        # `history` lazily (before checkpoints and at return). With a
+        # validation_fn the defer is moot: it returns a host float.
+        pending: List[dict] = []
+
+        def materialize():
+            for p in pending:
+                result = p.pop("result")
+                # first access of .reason/.iterations on a random-effect
+                # summary triggers its device->host transfer — HERE, not in
+                # the update loop
+                p["reason"] = result.reason
+                p["iterations"] = result.iterations
+                reasons = np.atleast_1d(np.asarray(p["reason"]))
+                history.append(
+                    CoordinateUpdateRecord(
+                        iteration=p["iteration"],
+                        coordinate=p["coordinate"],
+                        objective=float(p["objective"]),
+                        seconds=p["seconds"],
+                        validation_metric=p["validation_metric"],
+                        solver_iterations=(
+                            float(np.mean(np.asarray(p["iterations"])))
+                            if np.asarray(p["iterations"]).size
+                            else 0.0
+                        ),
+                        convergence_histogram={
+                            ConvergenceReason(int(r)).name: int(c)
+                            for r, c in zip(
+                                *np.unique(reasons, return_counts=True)
+                            )
+                        },
+                    )
+                )
+            pending.clear()
+
         for it in range(start_it, num_iterations):
             for name in names:
                 t0 = time.perf_counter()
@@ -191,34 +230,28 @@ class CoordinateDescent:
                 reg = sum(
                     self._reg_term(n, model.params[n]) for n in names
                 )
-                obj = float(
-                    self._objective(sum(scores.values()), reg)
-                )
-                reasons = np.atleast_1d(np.asarray(result.reason))
-                hist = {
-                    ConvergenceReason(int(r)).name: int(c)
-                    for r, c in zip(*np.unique(reasons, return_counts=True))
-                }
-                seconds = time.perf_counter() - t0  # update+rescore only
+                obj = self._objective(sum(scores.values()), reg)
+                # seconds measures host dispatch+update wall time; with
+                # deferred stats the device may still be draining
+                seconds = time.perf_counter() - t0
                 vmetric = (
                     float(validation_fn(model))
                     if validation_fn is not None
                     else None
                 )
-                history.append(
-                    CoordinateUpdateRecord(
-                        iteration=it,
-                        coordinate=name,
-                        objective=obj,
-                        seconds=seconds,
-                        validation_metric=vmetric,
-                        solver_iterations=(
-                            float(np.mean(np.asarray(result.iterations)))
-                            if np.asarray(result.iterations).size
-                            else 0.0
-                        ),
-                        convergence_histogram=hist,
-                    )
+                pending.append(
+                    {
+                        "iteration": it,
+                        "coordinate": name,
+                        "objective": obj,
+                        "seconds": seconds,
+                        "validation_metric": vmetric,
+                        # the result object is kept whole: reading
+                        # .reason/.iterations on a RandomEffectUpdateSummary
+                        # materializes device buffers, which must not happen
+                        # until materialize()
+                        "result": result,
+                    }
                 )
             if (
                 checkpoint_dir is not None
@@ -226,6 +259,7 @@ class CoordinateDescent:
             ):
                 from photon_ml_tpu.io.checkpoint import save_checkpoint
 
+                materialize()
                 save_checkpoint(
                     checkpoint_dir,
                     it + 1,
@@ -233,6 +267,7 @@ class CoordinateDescent:
                     np.asarray(key),
                     [dataclasses.asdict(h) for h in history],
                 )
+        materialize()
         return model, history
 
     def total_scores(self, model: GameModel) -> jax.Array:

@@ -148,7 +148,10 @@ def tracker_buffers(
     collapse to one slot (holding the latest state) so vmapped per-entity
     solves don't carry (entities, max_iters) tracker state."""
     size = max_iters + 1 if track else 1
-    return jnp.full((size,), jnp.nan, dtype), jnp.full((size,), jnp.nan, dtype)
+    # +inf sentinel for unwritten slots: obviously not a real (value, |g|)
+    # yet compatible with jax_debug_nans (a NaN fill would trip it on the
+    # very first buffer conversion)
+    return jnp.full((size,), jnp.inf, dtype), jnp.full((size,), jnp.inf, dtype)
 
 
 def record_state(values, grad_norms, i, value, grad_norm):

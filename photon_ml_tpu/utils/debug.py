@@ -1,0 +1,93 @@
+"""Profiling traces + numeric/sharding sanitizers (SURVEY §5.1–§5.2).
+
+The reference's observability is wall-clock logging plus optimizer state
+trackers; its "sanitizers" are immutability conventions. The TPU-native
+analogs:
+
+  - :func:`profile_trace` — a real profiler: wraps ``jax.profiler`` so a
+    driver phase emits a TensorBoard-loadable trace directory (the flag
+    replaces the reference's elapsed-millis log lines as the deep tool;
+    the timing logs still exist via utils/logging.timed).
+  - :func:`debug_nans` — scoped ``jax_debug_nans``: any NaN produced
+    inside the context fails loudly at the producing op instead of
+    surfacing later as a garbage metric.
+  - :func:`assert_all_finite` — host-side pytree finiteness check with a
+    path-qualified error, for post-solve invariants.
+  - :func:`assert_sharding` — shard-layout assertion: verifies an array's
+    actual sharding matches the intended PartitionSpec on a mesh, the
+    moral equivalent of a race detector for SPMD layouts (a silently
+    replicated array is the TPU bug that "works" but wastes memory, and a
+    silently resharded one inserts surprise collectives).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def profile_trace(output_dir: Optional[str]):
+    """Emit a jax.profiler trace for the enclosed phase when ``output_dir``
+    is set; no-op otherwise. The directory is TensorBoard-loadable."""
+    if not output_dir:
+        yield
+        return
+    os.makedirs(output_dir, exist_ok=True)
+    with jax.profiler.trace(output_dir):
+        yield
+
+
+@contextlib.contextmanager
+def debug_nans(enabled: bool = True):
+    """Scoped ``jax_debug_nans``: computations inside raise on the first
+    NaN they produce (at a re-run of the offending op un-jitted, so the
+    failure names the real culprit)."""
+    if not enabled:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_all_finite(tree, name: str = "tree") -> None:
+    """Host-side finiteness assertion over a pytree with a path-qualified
+    message. Intended for post-solve invariants (cheap relative to a
+    solve; do not call inside jit)."""
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in paths:
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        if not np.isfinite(arr).all():
+            bad = int((~np.isfinite(arr)).sum())
+            raise FloatingPointError(
+                f"{name}{jax.tree_util.keystr(path)}: {bad} non-finite "
+                f"values (shape {arr.shape})"
+            )
+
+
+def assert_sharding(x, mesh, spec) -> None:
+    """Assert ``x`` is laid out as NamedSharding(mesh, spec). Catches the
+    two silent SPMD layout bugs: an array that stayed replicated (memory
+    blow-up) and one that was resharded behind your back (surprise
+    collectives)."""
+    from jax.sharding import NamedSharding
+
+    want = NamedSharding(mesh, spec)
+    got = getattr(x, "sharding", None)
+    if got is None:
+        raise AssertionError(f"array has no sharding (host value?): {x!r}")
+    if not got.is_equivalent_to(want, np.ndim(x)):
+        raise AssertionError(
+            f"sharding mismatch: got {got}, want {want} "
+            f"(shape {np.shape(x)})"
+        )

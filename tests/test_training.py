@@ -4,6 +4,7 @@ validation matrix, model selection."""
 
 import jax.numpy as jnp
 import numpy as np
+import os
 import pytest
 from sklearn.linear_model import LogisticRegression
 
@@ -376,3 +377,95 @@ class TestModelSelection:
         # the absurd lambda shrinks predictions to ~0: RMSE must pick 0.1
         assert best.reg_weight == 0.1
         assert scores[0.1] < scores[10000.0]
+
+
+class TestDebugHarness:
+    def test_debug_nans_raises_at_producer(self):
+        import jax
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.utils.debug import debug_nans
+
+        with debug_nans(True):
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: jnp.log(x) * 0 + jnp.sqrt(x))(
+                    jnp.asarray(-1.0)
+                )
+        # restored afterwards: the same op silently yields nan again
+        assert bool(jnp.isnan(jnp.sqrt(jnp.asarray(-1.0))))
+
+    def test_assert_all_finite_names_path(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.utils.debug import assert_all_finite
+
+        good = {"a": jnp.ones(3), "b": [jnp.zeros(2)]}
+        assert_all_finite(good, "model")
+        bad = {"a": jnp.ones(3), "b": [jnp.asarray([1.0, float("nan")])]}
+        with pytest.raises(FloatingPointError, match=r"model\['b'\]\[0\]"):
+            assert_all_finite(bad, "model")
+
+    def test_assert_sharding(self, devices):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.parallel import make_mesh
+        from photon_ml_tpu.utils.debug import assert_sharding
+
+        mesh = make_mesh(8)
+        x = jax.device_put(
+            jnp.zeros((16, 4)), NamedSharding(mesh, P("data"))
+        )
+        assert_sharding(x, mesh, P("data"))
+        with pytest.raises(AssertionError, match="sharding mismatch"):
+            assert_sharding(x, mesh, P(None, "data"))
+
+    def test_profile_trace_writes_artifact(self, tmp_path):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.utils.debug import profile_trace
+
+        out = str(tmp_path / "trace")
+        with profile_trace(out):
+            float(jnp.sum(jnp.ones((64, 64)) @ jnp.ones((64, 64))))
+        # a plugins/profile/<ts>/ tree with at least one trace file
+        found = [
+            os.path.join(r, f)
+            for r, _, files in os.walk(out)
+            for f in files
+        ]
+        assert found, f"no trace artifacts under {out}"
+
+    def test_driver_profile_flag(self, rng, tmp_path):
+        import numpy as np
+
+        from photon_ml_tpu.cli.train import run_glm_training
+        from photon_ml_tpu.io.avro import write_avro_file
+        from photon_ml_tpu.io.ingest import make_training_example
+        from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        x = rng.normal(size=(200, 3))
+        y = (rng.uniform(size=200) < 0.5).astype(float)
+        recs = [
+            make_training_example(
+                y[i], {(f"f{j}", ""): x[i, j] for j in range(3)}
+            )
+            for i in range(200)
+        ]
+        tdir = tmp_path / "t"
+        tdir.mkdir()
+        write_avro_file(
+            str(tdir / "p.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+        )
+        out = str(tmp_path / "out")
+        run_glm_training(
+            {
+                "train_input": [str(tdir)],
+                "output_dir": out,
+                "reg_weights": [1.0],
+                "max_iters": 5,
+                "profile": True,
+            }
+        )
+        assert os.path.isdir(os.path.join(out, "profile"))
