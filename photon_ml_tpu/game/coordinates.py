@@ -155,7 +155,7 @@ def _make_gathered_solve_cached(config: CoordinateConfig, budget: int):
         idx = order[:budget]
         valid = kept[idx]
         sub_mask = jnp.where(valid, mask[idx], 0.0)
-        return solve(
+        result = solve(
             w,
             reg_weight,
             features[idx],
@@ -164,8 +164,30 @@ def _make_gathered_solve_cached(config: CoordinateConfig, budget: int):
             jnp.where(valid, weights[idx], 0.0),
             sub_mask,
         )
+        # rescore the FULL batch in the same dispatch
+        return result, features @ result.w
 
     return gather_solve
+
+
+def _make_fixed_update_and_score(config: CoordinateConfig):
+    """solve + full-batch rescore in ONE dispatch (cache key zeroes the
+    traced reg_weight like _make_solve)."""
+    return _make_fixed_update_and_score_cached(
+        dataclasses.replace(config, reg_weight=0.0)
+    )
+
+
+@lru_cache(maxsize=128)
+def _make_fixed_update_and_score_cached(config: CoordinateConfig):
+    solve = _make_solve(config, batched=False)
+
+    @jax.jit
+    def run(w, reg_weight, features, labels, offsets, weights, mask):
+        result = solve(w, reg_weight, features, labels, offsets, weights, mask)
+        return result, features @ result.w
+
+    return run
 
 
 class FixedEffectCoordinate:
@@ -176,7 +198,7 @@ class FixedEffectCoordinate:
             raise ValueError("config names a random effect; wrong coordinate")
         self.batch = batch
         self.config = config
-        self._solve = _make_solve(config, batched=False)
+        self._update_and_score = _make_fixed_update_and_score(config)
         self._score = jax.jit(lambda w, feats: feats @ w)
         self._downsample = (
             jax.jit(_binary_downsample_weights, static_argnums=(3,))
@@ -219,6 +241,17 @@ class FixedEffectCoordinate:
     def update(
         self, w: jax.Array, partial_scores: jax.Array, key=None
     ) -> Tuple[jax.Array, object]:
+        """Compatibility form of :meth:`update_and_score` (the descent loop
+        uses the fused form; this one computes-and-drops the rescore)."""
+        params, result, _ = self.update_and_score(w, partial_scores, key)
+        return params, result
+
+    def update_and_score(
+        self, w: jax.Array, partial_scores: jax.Array, key=None
+    ) -> Tuple[jax.Array, object, jax.Array]:
+        """update + full-batch rescore, fused into one dispatch (on
+        remote/tunneled devices each dispatch is a round trip; the
+        coordinate-descent loop uses this form)."""
         offsets = self.batch.offsets + partial_scores
         weights = self.batch.weights
         if self._downsample is not None:
@@ -234,7 +267,7 @@ class FixedEffectCoordinate:
                 self.config.down_sampling_rate,
             )
             if self._ds_budget is not None:
-                result = self._gather_solve(
+                result, scores = self._gather_solve(
                     w,
                     jnp.asarray(self.config.reg_weight, w.dtype),
                     self.batch.features,
@@ -243,8 +276,8 @@ class FixedEffectCoordinate:
                     weights,
                     self.batch.mask,
                 )
-                return result.w, result
-        result = self._solve(
+                return result.w, result, scores
+        result, scores = self._update_and_score(
             w,
             jnp.asarray(self.config.reg_weight, w.dtype),
             self.batch.features,
@@ -253,7 +286,7 @@ class FixedEffectCoordinate:
             weights,
             self.batch.mask,
         )
-        return result.w, result
+        return result.w, result, scores
 
     def score(self, w: jax.Array) -> jax.Array:
         """Broadcast-dot scoring (``FixedEffectCoordinate.scala:171-178``),
@@ -297,33 +330,56 @@ class RandomEffectUpdateSummary:
         return self._iterations
 
 
-def _make_bucket_update(config: CoordinateConfig):
-    """jitted (table, entity_index, design arrays) -> (table', result):
-    gather warm starts from the global table, solve the bucket's entities
-    in one vmapped call, scatter solutions back. Sentinel indices
-    (== num_entities) clip on gather and drop on scatter. Cache key zeroes
-    reg_weight (traced per entity) so a lambda grid reuses one compile."""
-    return _make_bucket_update_cached(
+def _make_multi_bucket_update(config: CoordinateConfig):
+    """ONE jitted call updating ALL buckets of a random effect: per bucket,
+    gather residual offsets and warm starts from the global table, solve
+    the bucket's entities in one vmapped call, scatter solutions back.
+    Sentinel indices (== num_entities) clip on gather and drop on scatter.
+
+    Fusing the whole multi-bucket pass into a single dispatch matters on
+    remote/tunneled devices where every dispatch pays a round trip (a
+    4-bucket update would otherwise cost 4+ latencies per CD pass). Cache
+    key zeroes reg_weight (traced per entity) so a lambda grid reuses one
+    compile."""
+    return _make_multi_bucket_update_cached(
         dataclasses.replace(config, reg_weight=0.0)
     )
 
 
 @lru_cache(maxsize=128)
-def _make_bucket_update_cached(config: CoordinateConfig):
+def _make_multi_bucket_update_cached(config: CoordinateConfig):
     solve = _make_solve(config, batched=True)
 
     @jax.jit
-    def update_bucket(
-        table, entity_index, reg_weights, features, labels, offsets,
-        weights, mask,
+    def update_all(
+        table, reg_weights, full_offsets, entity_indices, buckets,
+        row_features, row_entities,
     ):
-        w0 = jnp.take(table, entity_index, axis=0, mode="clip")
-        lam = jnp.take(reg_weights, entity_index, mode="clip")
-        result = solve(w0, lam, features, labels, offsets, weights, mask)
-        new_table = table.at[entity_index].set(result.w, mode="drop")
-        return new_table, result
+        trackers = []
+        for eidx, bucket in zip(entity_indices, buckets):
+            offsets = bucket.gather_offsets(full_offsets)
+            w0 = jnp.take(table, eidx, axis=0, mode="clip")
+            lam = jnp.take(reg_weights, eidx, mode="clip")
+            result = solve(
+                w0, lam, bucket.features, bucket.labels, offsets,
+                bucket.weights, bucket.mask,
+            )
+            table = table.at[eidx].set(result.w, mode="drop")
+            trackers.append((result.reason, result.iterations))
+        # full-row rescore in the same dispatch
+        scores = _score_rows_by_entity(table, row_features, row_entities)
+        return table, tuple(trackers), scores
 
-    return update_bucket
+    return update_all
+
+
+def _score_rows_by_entity(table, feats, ents):
+    """Embedding-style per-row scoring with the -1 = unknown-entity ->
+    score-0 convention (``model/RandomEffectModel.scala:117-146``). The
+    ONE definition both the fused update path and score() use."""
+    safe = jnp.maximum(ents, 0)
+    per_row = jnp.einsum("nd,nd->n", feats, table[safe])
+    return jnp.where(ents >= 0, per_row, 0.0)
 
 
 class RandomEffectCoordinate:
@@ -377,20 +433,17 @@ class RandomEffectCoordinate:
                     f"{reg_weights.shape}"
                 )
         self.reg_weights = reg_weights
-        self._update_bucket = _make_bucket_update(config)
+        self._update_all = _make_multi_bucket_update(config)
+        self._entity_indices = tuple(
+            jnp.asarray(ei) for ei in design.entity_index
+        )
         # static per-bucket masks of real (non-sharding-pad) lanes
         self._valid_lanes = [
             np.asarray(ei) < design.num_entities
             for ei in design.entity_index
         ]
 
-        @jax.jit
-        def score_rows(table, feats, ents):
-            safe = jnp.maximum(ents, 0)
-            per_row = jnp.einsum("nd,nd->n", feats, table[safe])
-            return jnp.where(ents >= 0, per_row, 0.0)
-
-        self._score = score_rows
+        self._score = jax.jit(_score_rows_by_entity)
 
     @property
     def num_entities(self) -> int:
@@ -411,24 +464,29 @@ class RandomEffectCoordinate:
     def update(
         self, table: jax.Array, partial_scores: jax.Array, key=None
     ) -> Tuple[jax.Array, object]:
-        full_offsets = self.full_offsets_base + partial_scores
-        pending = []
-        for bucket, entity_index, valid in zip(
-            self.design.buckets, self.design.entity_index, self._valid_lanes
-        ):
-            offsets = bucket.gather_offsets(full_offsets)
-            table, result = self._update_bucket(
-                table,
-                jnp.asarray(entity_index),
-                self.reg_weights,
-                bucket.features,
-                bucket.labels,
-                offsets,
-                bucket.weights,
-                bucket.mask,
-            )
-            pending.append((result.reason, result.iterations, valid))
-        return table, RandomEffectUpdateSummary(pending=pending)
+        table, summary, _ = self.update_and_score(
+            table, partial_scores, key=key
+        )
+        return table, summary
+
+    def update_and_score(
+        self, table: jax.Array, partial_scores: jax.Array, key=None
+    ) -> Tuple[jax.Array, object, jax.Array]:
+        """All bucket solves + the full-row rescore in ONE dispatch."""
+        table, trackers, scores = self._update_all(
+            table,
+            self.reg_weights,
+            self.full_offsets_base + partial_scores,
+            self._entity_indices,
+            tuple(self.design.buckets),
+            self.row_features,
+            self.row_entities,
+        )
+        pending = [
+            (reason, iters, valid)
+            for (reason, iters), valid in zip(trackers, self._valid_lanes)
+        ]
+        return table, RandomEffectUpdateSummary(pending=pending), scores
 
     def score(self, table: jax.Array) -> jax.Array:
         return self._score(table, self.row_features, self.row_entities)

@@ -96,13 +96,21 @@ class CoordinateDescent:
         self.weights = weights
         self.task = task
         loss_fn = _loss_fn_for_task(task)
+        names = list(self.coordinates)
 
+        # training objective from per-coordinate scores + params in ONE
+        # dispatch: the reg-term composition would otherwise issue several
+        # eager ops per coordinate per update — pure latency on a
+        # remote/tunneled device
         @jax.jit
-        def objective(total_scores, reg_terms):
-            margins = base_offsets + total_scores
-            return loss_fn(labels, margins, weights) + reg_terms
+        def full_objective(scores_dict, params_dict):
+            reg = sum(
+                self._reg_term(n, params_dict[n]) for n in names
+            )
+            total = sum(scores_dict[n] for n in names)
+            return loss_fn(labels, base_offsets + total, weights) + reg
 
-        self._objective = objective
+        self._full_objective = full_objective
 
     def _reg_term(self, name: str, params) -> jax.Array:
         """Delegates to the coordinate when it defines its own penalty
@@ -221,16 +229,19 @@ class CoordinateDescent:
                 total = sum(scores.values())
                 partial = total - scores[name]
                 key, sub = jax.random.split(key)
-                params, result = coord.update(
-                    model.params[name], partial, sub
-                )
+                if hasattr(coord, "update_and_score"):
+                    params, result, new_scores = coord.update_and_score(
+                        model.params[name], partial, sub
+                    )
+                else:
+                    params, result = coord.update(
+                        model.params[name], partial, sub
+                    )
+                    new_scores = coord.score(params)
                 model.params[name] = params
-                scores[name] = coord.score(params)
+                scores[name] = new_scores
 
-                reg = sum(
-                    self._reg_term(n, model.params[n]) for n in names
-                )
-                obj = self._objective(sum(scores.values()), reg)
+                obj = self._full_objective(scores, model.params)
                 # seconds measures host dispatch+update wall time; with
                 # deferred stats the device may still be draining
                 seconds = time.perf_counter() - t0

@@ -205,6 +205,9 @@ class ProjectedRandomEffectCoordinate:
     def update(self, table, partial_scores, key=None):
         return self.inner.update(table, partial_scores, key=key)
 
+    def update_and_score(self, table, partial_scores, key=None):
+        return self.inner.update_and_score(table, partial_scores, key=key)
+
     def reg_term(self, table: jax.Array) -> jax.Array:
         return self.inner.reg_term(table)
 

@@ -652,10 +652,14 @@ class TestGatheredDownsampling:
             jnp.asarray(weights),
             jnp.ones(n),
         )
-        got = gather_solve(*args)
+        got, got_scores = gather_solve(*args)
         want = full_solve(*args)
         np.testing.assert_allclose(
             np.asarray(got.w), np.asarray(want.w), atol=1e-6
+        )
+        # the fused rescore covers the FULL batch with the solved w
+        np.testing.assert_allclose(
+            np.asarray(got_scores), x @ np.asarray(got.w), atol=1e-8
         )
 
     def test_fixed_coordinate_uses_gathered_path(self, rng):
