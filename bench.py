@@ -1,6 +1,10 @@
 """Benchmarks vs CPU baselines on the BASELINE.json configs.
 
-Three measurements:
+Five measurements covering BASELINE.json's five configs — dense logistic
+(Criteo proxy), linear + elastic net, GAME fixed + one random effect,
+GAME fixed + multi random effects + MF interaction (fixed-effect-only is
+the degenerate single-coordinate case of those two) — plus a sparse
+wide-feature configuration:
 
 1. HEADLINE — L2 logistic regression, dense 1M x 256 (the Criteo-logistic
    wall-clock proxy): one full TRON solve to the reference's convergence
@@ -17,7 +21,15 @@ Three measurements:
    with JAX_PLATFORMS=cpu — the stand-in for the reference's Spark-CPU
    executor math, identical convergence criteria by construction).
 
-3. SPARSE — L2 logistic on padded-ELL sparse 200k x 120k (nnz 32/row),
+3. GAME MULTI — fixed + per-user random effect + factored (latent-dim-4)
+   per-item interaction on 100k rows: CD iterations/sec on device
+   (``bench_game_multi_re``).
+
+4. LINEAR + ELASTIC NET — 500k x 256 linear regression via OWL-QN vs
+   sklearn ElasticNet at the exactly-mapped objective
+   (``bench_linear_elastic_net``).
+
+5. SPARSE — L2 logistic on padded-ELL sparse 200k x 120k (nnz 32/row),
    the >100k-feature regime of ``util/PalDBIndexMap.scala:43``; baseline
    sklearn lbfgs on the same data in CSR. Measured characteristics on one
    v5e chip: the 6.4M-element gather/scatter per objective pass runs at
@@ -292,6 +304,166 @@ def _game_cpu_baseline():
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def bench_linear_elastic_net():
+    """BASELINE config #2: linear regression + elastic net (OWL-QN) vs
+    sklearn ElasticNet on identical data. Objective mapping: sklearn
+    minimizes 1/(2n)||y-Xw||^2 + a*(r|w|_1 + (1-r)/2 ||w||^2); ours is the
+    unnormalized sum, so lambda_1 = n a r and lambda_2 = n a (1-r)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.types import LabeledBatch
+    from photon_ml_tpu.models import (
+        GLMTrainingConfig,
+        OptimizerType,
+        TaskType,
+        train_glm,
+    )
+    from photon_ml_tpu.ops import RegularizationContext
+
+    n, d = 500_000, 256
+    alpha, ratio = 0.001, 0.5
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((n, d), dtype=np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32) * (
+        rng.uniform(size=d) < 0.2
+    )
+    y = x @ w_true + rng.standard_normal(n).astype(np.float32)
+
+    batch = LabeledBatch.create(x, y, dtype=jnp.float32)
+    cfg = lambda lam: GLMTrainingConfig(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer=OptimizerType.LBFGS,
+        regularization=RegularizationContext("ELASTIC_NET", alpha=ratio),
+        reg_weights=(lam,),
+        tolerance=1e-7,
+        max_iters=100,
+        track_states=False,
+    )
+    lam = n * alpha
+    (warm,) = train_glm(batch, cfg(10.0 * lam))
+    np.asarray(warm.result.w)
+    t0 = time.perf_counter()
+    (tm,) = train_glm(batch, cfg(lam))
+    w_dev = np.asarray(tm.model.coefficients.means)
+    tpu_s = time.perf_counter() - t0
+
+    from sklearn.linear_model import ElasticNet
+
+    t0 = time.perf_counter()
+    skl = ElasticNet(
+        alpha=alpha, l1_ratio=ratio, fit_intercept=False, tol=1e-6
+    ).fit(x, y)
+    cpu_s = time.perf_counter() - t0
+    rmse_dev = float(np.sqrt(np.mean((x @ w_dev - y) ** 2)))
+    rmse_cpu = float(np.sqrt(np.mean((x @ skl.coef_ - y) ** 2)))
+    nnz_dev = int((np.abs(w_dev) > 1e-6).sum())
+    nnz_cpu = int((np.abs(skl.coef_) > 1e-6).sum())
+    log(
+        f"linear+EN 500kx256: device {tpu_s:.3f}s (rmse={rmse_dev:.4f} "
+        f"nnz={nnz_dev}) vs sklearn {cpu_s:.3f}s (rmse={rmse_cpu:.4f} "
+        f"nnz={nnz_cpu})"
+    )
+    return {"tpu_s": tpu_s, "cpu_s": cpu_s}
+
+
+def bench_game_multi_re():
+    """BASELINE config #5: fixed effect + TWO random effects with a
+    factored (matrix-factorization-style) item interaction. Reports CD
+    iters/sec on device (no CPU subprocess — the single-RE config above
+    carries the CPU comparison)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.tasks import TaskType
+    from photon_ml_tpu.game import (
+        CoordinateConfig,
+        CoordinateDescent,
+        FactoredConfig,
+        FactoredRandomEffectCoordinate,
+        FixedEffectCoordinate,
+        GameData,
+        RandomEffectCoordinate,
+        build_bucketed_random_effect_design,
+    )
+    from photon_ml_tpu.models.training import OptimizerType
+
+    n_rows, d_fixed, n_users, d_user, n_items, d_item, k = (
+        100_000, 32, 2_000, 8, 1_000, 16, 4
+    )
+    rng = np.random.default_rng(13)
+    user = rng.integers(0, n_users, size=n_rows).astype(np.int32)
+    item = rng.integers(0, n_items, size=n_rows).astype(np.int32)
+    xg = rng.standard_normal((n_rows, d_fixed), dtype=np.float32)
+    xu = rng.standard_normal((n_rows, d_user), dtype=np.float32)
+    xi = rng.standard_normal((n_rows, d_item), dtype=np.float32)
+    logits = 0.5 * xg[:, 0] + 0.3 * xu[:, 0] + 0.2 * xi[:, 0]
+    y = (rng.uniform(size=n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(
+        np.float32
+    )
+    data = GameData.create(
+        features={"global": xg, "per_user": xu, "per_item": xi},
+        labels=y,
+        entity_ids={"userId": user, "itemId": item},
+    )
+    base = dict(
+        task=TaskType.LOGISTIC_REGRESSION,
+        max_iters=5,
+        tolerance=1e-5,
+    )
+    fixed = FixedEffectCoordinate(
+        data.fixed_effect_batch("global"),
+        CoordinateConfig(
+            shard="global", optimizer=OptimizerType.TRON, reg_weight=1.0,
+            **base,
+        ),
+    )
+    u_design = build_bucketed_random_effect_design(
+        data, "userId", "per_user", n_users, num_buckets=4
+    )
+    users = RandomEffectCoordinate(
+        design=u_design,
+        row_features=jnp.asarray(xu),
+        row_entities=jnp.asarray(user),
+        full_offsets_base=jnp.zeros((n_rows,), jnp.float32),
+        config=CoordinateConfig(
+            shard="per_user", optimizer=OptimizerType.LBFGS,
+            reg_weight=10.0, random_effect="userId", **base,
+        ),
+    )
+    i_design = build_bucketed_random_effect_design(
+        data, "itemId", "per_item", n_items, num_buckets=4
+    )
+    items = FactoredRandomEffectCoordinate(
+        design=i_design,
+        row_features=jnp.asarray(xi),
+        row_entities=jnp.asarray(item),
+        full_offsets_base=jnp.zeros((n_rows,), jnp.float32),
+        re_config=CoordinateConfig(
+            shard="per_item", optimizer=OptimizerType.LBFGS,
+            reg_weight=10.0, random_effect="itemId", **base,
+        ),
+        factored=FactoredConfig(latent_dim=k, num_inner_iterations=1),
+    )
+    cd = CoordinateDescent(
+        coordinates={"fixed": fixed, "per-user": users, "per-item": items},
+        labels=jnp.asarray(y),
+        base_offsets=jnp.zeros((n_rows,), jnp.float32),
+        weights=jnp.ones((n_rows,), jnp.float32),
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    t0 = time.perf_counter()
+    cd.run(num_iterations=1)
+    log(f"GAME multi-RE warmup (compile+run): {time.perf_counter() - t0:.2f}s")
+    iters = 2
+    t0 = time.perf_counter()
+    _, history = cd.run(num_iterations=iters)
+    dt = time.perf_counter() - t0
+    log(
+        f"GAME multi-RE+MF CD: {iters} iterations in {dt:.2f}s "
+        f"({iters / dt:.3f} iters/s) objective={history[-1].objective:.4f}"
+    )
+    return {"iters_per_s": iters / dt}
+
+
 def bench_sparse():
     import jax.numpy as jnp
 
@@ -399,6 +571,8 @@ def main():
     glm = bench_glm_dense()
     game = bench_game()
     game_cpu = _game_cpu_baseline()
+    game_multi = bench_game_multi_re()
+    linear_en = bench_linear_elastic_net()
     sparse = bench_sparse()
 
     extra = {
@@ -409,6 +583,13 @@ def main():
         "sparse_200kx120k_s": round(sparse["tpu_s"], 3),
         "sparse_vs_sklearn": round(sparse["cpu_s"] / sparse["tpu_s"], 3),
         "game_cd_iters_per_s": round(game["iters_per_s"], 3),
+        "game_multi_re_mf_iters_per_s": round(
+            game_multi["iters_per_s"], 3
+        ),
+        "linear_en_s": round(linear_en["tpu_s"], 3),
+        "linear_en_vs_sklearn": round(
+            linear_en["cpu_s"] / linear_en["tpu_s"], 3
+        ),
     }
     if game_cpu:
         extra["game_vs_cpu"] = round(

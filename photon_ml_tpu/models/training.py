@@ -153,13 +153,21 @@ class TrainedModel:
     result: SolverResult
 
 
-@lru_cache(maxsize=64)
 def _build_solver(config: GLMTrainingConfig):
     """jitted solve(w0, reg_weight, batch, norm) with traced reg weight and
     normalization arrays. Cached on the (hashable) config so repeated
     train_glm calls — the lambda path, GAME coordinate-descent rounds,
     bootstrap replicas — reuse ONE compilation instead of re-tracing.
-    """
+    The cache key zeroes reg_weights (they are traced call arguments, not
+    trace-time constants), so configs differing only in lambdas share the
+    compilation too."""
+    return _build_solver_cached(
+        dataclasses.replace(config, reg_weights=(0.0,))
+    )
+
+
+@lru_cache(maxsize=64)
+def _build_solver_cached(config: GLMTrainingConfig):
     loss = loss_for_task(config.task)
     reg = config.regularization
     scfg = config.solver_config()
