@@ -1,0 +1,72 @@
+"""Synthesize the Avro fixtures the example drivers run on.
+
+A mixed-effects click model: global features gf0..gf5 with a shared
+coefficient vector, per-user features uf0..uf2 with per-user coefficients
+(userId in metadataMap) — the Yahoo-music-style shape of the reference's
+``DriverGameIntegTest``."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+D_G, D_U, N_USERS = 6, 3, 25
+
+
+def make_records(rng, rows_per_user, w_g, w_u):
+    records = []
+    for u in range(N_USERS):
+        for i in range(rows_per_user):
+            xg = rng.normal(size=D_G)
+            xu = rng.normal(size=D_U)
+            margin = xg @ w_g + xu @ w_u[u]
+            y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+            records.append(
+                {
+                    "uid": f"user{u}-row{i}",
+                    "label": y,
+                    "features": [
+                        {"name": f"gf{j}", "term": "", "value": float(xg[j])}
+                        for j in range(D_G)
+                    ]
+                    + [
+                        {"name": f"uf{j}", "term": "", "value": float(xu[j])}
+                        for j in range(D_U)
+                    ],
+                    "metadataMap": {"userId": f"user{u}"},
+                    "weight": None,
+                    "offset": None,
+                }
+            )
+    return records
+
+
+def main():
+    rng = np.random.default_rng(7)
+    w_g = rng.normal(size=D_G)
+    w_u = rng.normal(size=(N_USERS, D_U)) * 2.0
+    for sub, rows in (("train", 60), ("validate", 20), ("score", 15)):
+        d = os.path.join(HERE, "data", sub)
+        os.makedirs(d, exist_ok=True)
+        write_avro_file(
+            os.path.join(d, "part-00000.avro"),
+            TRAINING_EXAMPLE_SCHEMA,
+            make_records(rng, rows, w_g, w_u),
+        )
+        print(f"wrote {d}")
+    # feature-shard files for the GAME driver
+    with open(os.path.join(HERE, "data", "global.features"), "w") as f:
+        f.write("\n".join(f"gf{j}\x01" for j in range(D_G)))
+    with open(os.path.join(HERE, "data", "user.features"), "w") as f:
+        f.write("\n".join(f"uf{j}\x01" for j in range(D_U)))
+    print("wrote feature shard files")
+
+
+if __name__ == "__main__":
+    main()

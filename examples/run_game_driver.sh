@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# GAME training: fixed effect + per-user random effect, a reg-weight grid
+# on the random effect, per-coordinate validation, BEST-model output.
+set -euo pipefail
+cd "$(dirname "$0")"
+export PYTHONPATH="..${PYTHONPATH:+:$PYTHONPATH}"
+
+python -m photon_ml_tpu.cli.game_train --config game_train.json
+
+echo "GAME outputs:" && find output/game/best -maxdepth 2 -type d

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# GLM training over a lambda path with validation-driven selection and the
+# full diagnostics report — the analog of the reference's
+# examples/run_photon_ml_driver.sh.
+set -euo pipefail
+cd "$(dirname "$0")"
+export PYTHONPATH="..${PYTHONPATH:+:$PYTHONPATH}"
+
+python -m photon_ml_tpu.cli.train \
+  --train-input data/train \
+  --validate-input data/validate \
+  --output-dir output/glm \
+  --task LOGISTIC_REGRESSION \
+  --optimizer TRON \
+  --reg-type L2 \
+  --reg-weights 10 1 0.1 \
+  --max-iters 50 \
+  --diagnostics \
+  --overwrite
+
+echo "GLM outputs:" && ls output/glm

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Score fresh data with the GAME model saved by run_game_driver.sh and
+# evaluate against the labels it carries.
+set -euo pipefail
+cd "$(dirname "$0")"
+export PYTHONPATH="..${PYTHONPATH:+:$PYTHONPATH}"
+
+python -m photon_ml_tpu.cli.score --config score.json
+
+echo "scores:" && ls output/scores/scores && cat output/scores/metrics.json

@@ -334,6 +334,49 @@ def _is_factored(table) -> bool:
     return hasattr(table, "gamma") and hasattr(table, "projection")
 
 
+def _write_latent_factor_table(
+    path: str, table: np.ndarray, vocab: Optional[dict]
+) -> None:
+    """(rows, k) -> LatentFactorAvro records keyed by the vocab's raw ids
+    (positional string ids when no vocab)."""
+    from photon_ml_tpu.io.schemas import LATENT_FACTOR_SCHEMA
+
+    index_to_id = {v: k for k, v in vocab.items()} if vocab else {}
+    write_avro_file(
+        path,
+        LATENT_FACTOR_SCHEMA,
+        [
+            {
+                "effectId": str(index_to_id.get(i, i)),
+                "latentFactor": [float(v) for v in table[i]],
+            }
+            for i in range(table.shape[0])
+        ],
+    )
+
+
+def _fill_table_from_latent_records(
+    records, vocab: Optional[dict], what: str
+):
+    """LatentFactorAvro records -> ((rows, k) table, vocab). Builds the
+    vocab from record order when absent; raises on records whose id the
+    vocab cannot place (silent drops would corrupt scoring)."""
+    if vocab is None:
+        vocab = {rec["effectId"]: i for i, rec in enumerate(records)}
+    k = len(records[0]["latentFactor"]) if records else 1
+    table = np.zeros((len(vocab), k))
+    for rec in records:
+        raw = rec["effectId"]
+        i = vocab.get(raw, vocab.get(_maybe_int(raw)))
+        if i is None:
+            raise ValueError(
+                f"{what}: record id {raw!r} is not in the provided "
+                "vocabulary — refusing a silently truncated table"
+            )
+        table[i] = rec["latentFactor"]
+    return table, dict(vocab)
+
+
 def _save_factored_coordinate(
     root: str,
     name: str,
@@ -358,17 +401,8 @@ def _save_factored_coordinate(
         if re_type is not None:
             f.write(f"randomEffectType={re_type}\n")
         f.write(f"latentDim={gamma.shape[1]}\n")
-    index_to_id = {v: k for k, v in entity_vocab.items()}
-    write_avro_file(
-        os.path.join(cdir, "latent-factors.avro"),
-        LATENT_FACTOR_SCHEMA,
-        [
-            {
-                "effectId": str(index_to_id.get(e, e)),
-                "latentFactor": [float(v) for v in gamma[e]],
-            }
-            for e in range(gamma.shape[0])
-        ],
+    _write_latent_factor_table(
+        os.path.join(cdir, "latent-factors.avro"), gamma, entity_vocab
     )
     write_avro_file(
         os.path.join(cdir, "projection.avro"),
@@ -381,6 +415,64 @@ def _save_factored_coordinate(
             for j in range(projection.shape[0])
         ],
     )
+
+
+def save_mf_model(
+    root: str,
+    model,  # game.factored.MatrixFactorizationModel
+    row_effect_type: str,
+    col_effect_type: str,
+    row_vocab: Optional[dict] = None,
+    col_vocab: Optional[dict] = None,
+):
+    """Matrix-factorization model -> <root>/<rowEffectType>/ and
+    <root>/<colEffectType>/ LatentFactorAvro files
+    (``ModelProcessingUtils.saveMatrixFactorizationModelToHDFS``
+    :267-296). Vocab dicts map raw ids -> row index; positional string ids
+    are used when absent."""
+    from photon_ml_tpu.io.schemas import LATENT_FACTOR_SCHEMA
+
+    if row_effect_type == col_effect_type:
+        raise ValueError(
+            "row and col effect types must differ (they name directories)"
+        )
+    for effect, factors, vocab in (
+        (row_effect_type, np.asarray(model.row_factors), row_vocab),
+        (col_effect_type, np.asarray(model.col_factors), col_vocab),
+    ):
+        edir = os.path.join(root, effect)
+        os.makedirs(edir, exist_ok=True)
+        _write_latent_factor_table(
+            os.path.join(edir, "part-00000.avro"), factors, vocab
+        )
+
+
+def load_mf_model(
+    root: str,
+    row_effect_type: str,
+    col_effect_type: str,
+    row_vocab: Optional[dict] = None,
+    col_vocab: Optional[dict] = None,
+):
+    """Inverse of :func:`save_mf_model`
+    (``ModelProcessingUtils.loadMatrixFactorizationModelFromHDFS``
+    :303-332). Returns (MatrixFactorizationModel, row_vocab, col_vocab)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.factored import MatrixFactorizationModel
+
+    def load_side(effect, vocab):
+        _, records = read_avro_file(
+            os.path.join(root, effect, "part-00000.avro")
+        )
+        table, vocab = _fill_table_from_latent_records(
+            records, vocab, f"MF {effect}"
+        )
+        return jnp.asarray(table), vocab
+
+    rows, row_vocab = load_side(row_effect_type, row_vocab)
+    cols, col_vocab = load_side(col_effect_type, col_vocab)
+    return MatrixFactorizationModel(rows, cols), row_vocab, col_vocab
 
 
 def load_factored_coordinate(
@@ -401,14 +493,9 @@ def load_factored_coordinate(
                 info[k] = v
     k = int(info["latentDim"])
     _, grecords = read_avro_file(os.path.join(cdir, "latent-factors.avro"))
-    if entity_vocab is None:
-        entity_vocab = {rec["effectId"]: i for i, rec in enumerate(grecords)}
-    gamma = np.zeros((len(entity_vocab), k))
-    for rec in grecords:
-        raw = rec["effectId"]
-        e = entity_vocab.get(raw, entity_vocab.get(_maybe_int(raw)))
-        if e is not None:
-            gamma[e] = rec["latentFactor"]
+    gamma, entity_vocab = _fill_table_from_latent_records(
+        grecords, entity_vocab, f"factored coordinate {cdir}"
+    )
     _, precords = read_avro_file(os.path.join(cdir, "projection.avro"))
     projection = np.zeros((len(vocab), k))
     for rec in precords:
@@ -421,5 +508,5 @@ def load_factored_coordinate(
             gamma=jnp.asarray(gamma), projection=jnp.asarray(projection)
         ),
         info,
-        dict(entity_vocab),
+        entity_vocab,
     )

@@ -277,3 +277,73 @@ class TestModelPersistence:
             np.testing.assert_allclose(
                 params2["per-user"][ev2[str(raw)]], table[row], atol=1e-15
             )
+
+
+class TestMatrixFactorizationIO:
+    def test_round_trip_with_vocabs(self, tmp_path, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.factored import MatrixFactorizationModel
+        from photon_ml_tpu.io.models import load_mf_model, save_mf_model
+
+        r, c, k = 6, 4, 3
+        model = MatrixFactorizationModel(
+            jnp.asarray(rng.normal(size=(r, k))),
+            jnp.asarray(rng.normal(size=(c, k))),
+        )
+        rv = {f"member{i}": i for i in range(r)}
+        cv = {f"item{i}": i for i in range(c)}
+        root = str(tmp_path / "mf")
+        save_mf_model(root, model, "memberId", "itemId", rv, cv)
+        loaded, rv2, cv2 = load_mf_model(
+            root, "memberId", "itemId", rv, cv
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded.row_factors),
+            np.asarray(model.row_factors),
+            atol=1e-12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded.col_factors),
+            np.asarray(model.col_factors),
+            atol=1e-12,
+        )
+        # scores survive the round trip, including missing-id zeros
+        rows = np.asarray([0, 2, -1], np.int32)
+        cols = np.asarray([1, -1, 3], np.int32)
+        np.testing.assert_allclose(
+            np.asarray(loaded.score(rows, cols)),
+            np.asarray(model.score(rows, cols)),
+            atol=1e-12,
+        )
+
+    def test_round_trip_without_vocabs(self, tmp_path, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.factored import MatrixFactorizationModel
+        from photon_ml_tpu.io.models import load_mf_model, save_mf_model
+
+        model = MatrixFactorizationModel(
+            jnp.asarray(rng.normal(size=(3, 2))),
+            jnp.asarray(rng.normal(size=(5, 2))),
+        )
+        root = str(tmp_path / "mf2")
+        save_mf_model(root, model, "rowId", "colId")
+        loaded, _, _ = load_mf_model(root, "rowId", "colId")
+        np.testing.assert_allclose(
+            np.asarray(loaded.row_factors),
+            np.asarray(model.row_factors),
+            atol=1e-12,
+        )
+
+    def test_same_effect_types_rejected(self, tmp_path, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.factored import MatrixFactorizationModel
+        from photon_ml_tpu.io.models import save_mf_model
+
+        model = MatrixFactorizationModel(
+            jnp.ones((2, 2)), jnp.ones((2, 2))
+        )
+        with pytest.raises(ValueError, match="must differ"):
+            save_mf_model(str(tmp_path / "x"), model, "id", "id")
