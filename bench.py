@@ -236,7 +236,10 @@ def _build_game_cd(n_rows, d_fixed, n_entities, d_user, seed=7):
     re_cfg = CoordinateConfig(
         shard="per_user",
         task=TaskType.LOGISTIC_REGRESSION,
-        optimizer=OptimizerType.LBFGS,
+        # TRON is the reference's GAME default
+        # (``GLMOptimizationConfiguration.scala:33-38``) and needs no line
+        # search — fewer objective passes per entity than L-BFGS
+        optimizer=OptimizerType.TRON,
         reg_weight=10.0,
         max_iters=10,
         tolerance=1e-5,

@@ -28,9 +28,12 @@ from photon_ml_tpu.io.ingest import (
 )
 from photon_ml_tpu.io.models import (
     load_glm_model,
+    load_factored_coordinate,
     load_game_model,
+    load_mf_model,
     save_glm_model,
     save_game_model,
+    save_mf_model,
 )
 
 __all__ = [
@@ -51,5 +54,8 @@ __all__ = [
     "save_glm_model",
     "load_glm_model",
     "save_game_model",
+    "save_mf_model",
     "load_game_model",
+    "load_mf_model",
+    "load_factored_coordinate",
 ]
