@@ -25,6 +25,10 @@ from photon_ml_tpu.parallel.mesh import (
     shard_bucketed_design,
     shard_design,
 )
+from photon_ml_tpu.parallel.multihost import (
+    initialize_multihost,
+    process_local_rows,
+)
 from photon_ml_tpu.parallel.distributed import (
     distributed_train_glm,
     feature_sharded_train_glm,
@@ -45,4 +49,6 @@ __all__ = [
     "distributed_train_glm",
     "feature_sharded_train_glm",
     "shard_map_value_and_grad",
+    "initialize_multihost",
+    "process_local_rows",
 ]

@@ -1,0 +1,97 @@
+"""Multi-host (pod / DCN) runtime initialization.
+
+Rebuild of ``SparkContextConfiguration.scala`` (YARN client setup — the
+reference's "connect this process to the cluster" step) for the TPU
+runtime: one ``jax.distributed.initialize`` call per host process, after
+which ``jax.devices()`` spans every chip in the slice and the SAME mesh /
+pjit code paths used single-host (``parallel.mesh``) scale across hosts —
+in-slice collectives ride ICI, cross-slice ride DCN, both inserted by XLA
+exactly like the single-host psums. There is no NCCL/MPI analog to manage:
+the comm backend is the compiler's.
+
+Joining is triggered ONLY by explicit configuration — the
+JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID environment
+variables or the matching arguments. (Cloud TPU metadata can fill the
+process topology once initialize() runs, but metadata presence alone is
+not treated as a signal: dev images and single-chip tunnels carry pod-ish
+variables, and a misfired join hangs waiting for peers.)
+
+Typical driver usage::
+
+    from photon_ml_tpu.parallel import initialize_multihost, make_mesh
+
+    initialize_multihost()           # no-op when single-process
+    mesh = make_mesh()               # now spans the whole slice
+    models = distributed_train_glm(batch, config, mesh)
+
+Per-host data loading: each process should ingest ONLY its shard of rows
+(e.g. its subset of Avro part files) and place them with
+``jax.make_array_from_process_local_data`` onto a global mesh — the
+multi-host generalization of ``shard_batch``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_INITIALIZED = False
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process to the multi-host runtime. Returns True when a
+    multi-process runtime was initialized, False for the single-process
+    no-op (so drivers can call it unconditionally).
+
+    Arguments default to the JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID environment variables, and on Cloud TPU to the
+    platform's auto-detection. Safe to call twice (second call no-ops)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    # Join only on an EXPLICIT signal (argument or env var). TPU-metadata
+    # auto-detection is deliberately not used as the trigger: single-chip
+    # tunnels and dev images carry pod-ish variables, and a misfired
+    # initialize() hangs waiting for peers.
+    if not (coordinator_address or (num_processes or 0) > 1):
+        return False  # single-process run: nothing to join
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+    return True
+
+
+def split_rows(total_rows: int, num_processes: int, process_id: int) -> range:
+    """Contiguous even split of a global row space: the ranges over all
+    process ids are disjoint and cover [0, total_rows)."""
+    per = -(-total_rows // num_processes)
+    return range(
+        min(process_id * per, total_rows),
+        min((process_id + 1) * per, total_rows),
+    )
+
+
+def process_local_rows(total_rows: int) -> range:
+    """The contiguous row range THIS process should ingest — the even
+    split of a global row space over processes (the analog of the
+    reference's input-split assignment). Single-process: everything."""
+    return split_rows(total_rows, jax.process_count(), jax.process_index())

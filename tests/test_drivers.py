@@ -922,3 +922,122 @@ class TestValidatePerIteration:
                     "validate_per_iteration": True,
                 }
             )
+
+
+class TestNonLogisticDrivers:
+    """Driver-level e2e for the non-logistic tasks + per-example
+    offsets/weights — the remaining DriverIntegTest scenario shapes."""
+
+    def test_poisson_glm_driver_e2e(self, rng, tmp_path):
+        n, d = 800, 4
+        x = rng.normal(size=(n, d)) * 0.5
+        w = np.asarray([0.8, -0.5, 0.3, 0.0])
+        rate = np.exp(x @ w)
+        y = rng.poisson(rate).astype(float)
+        recs = [
+            {
+                "uid": f"r{i}",
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": None,
+                "weight": None,
+                "offset": None,
+            }
+            for i in range(n)
+        ]
+        tdir = tmp_path / "ptrain"
+        tdir.mkdir()
+        write_avro_file(
+            str(tdir / "p.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+        )
+        run = run_glm_training(
+            {
+                "train_input": [str(tdir)],
+                "validate_input": [str(tdir)],
+                "output_dir": str(tmp_path / "pout"),
+                "task": "POISSON_REGRESSION",
+                "optimizer": "TRON",
+                "reg_weights": [0.1],
+                "max_iters": 60,
+                "tolerance": 1e-10,
+                "add_intercept": False,
+            }
+        )
+        coef = np.asarray(run.models[0].model.coefficients.means)
+        idx = [run.vocab.get(f"f{j}", "") for j in range(d)]
+        # recovers the generating coefficients of the log link (sampling
+        # noise at n=800 plus the L2 pull bounds the agreement)
+        np.testing.assert_allclose(coef[idx], w, atol=0.25)
+        assert "ROOT_MEAN_SQUARED_ERROR" in run.validation_metrics[0]
+
+    def test_game_offsets_and_weights_flow_through(self, rng, tmp_path):
+        """Per-example offsets shift margins; zero-weight rows are
+        ignored by training."""
+        n_users, rows, d_u = 6, 60, 2
+        w_u = rng.normal(size=(n_users, d_u)) * 2.0
+        records = []
+        for u in range(n_users):
+            for i in range(rows):
+                xu = rng.normal(size=d_u)
+                offset = float(rng.normal() * 0.5)
+                margin = xu @ w_u[u] + offset
+                y = float(rng.uniform() < _sigmoid(margin))
+                # half the rows of user 0 are poisoned but weighted 0
+                poisoned = u == 0 and i % 2 == 0
+                records.append(
+                    {
+                        "uid": f"u{u}r{i}",
+                        "label": (1.0 - y) if poisoned else y,
+                        "features": [
+                            {
+                                "name": f"uf{j}",
+                                "term": "",
+                                "value": float(xu[j]),
+                            }
+                            for j in range(d_u)
+                        ],
+                        "metadataMap": {"userId": f"user{u}"},
+                        "weight": 0.0 if poisoned else 1.0,
+                        "offset": offset,
+                    }
+                )
+        train = write_records(str(tmp_path / "gw.avro"), records)
+        ushard = write_feature_file(
+            str(tmp_path / "uw.features"), [f"uf{j}" for j in range(d_u)]
+        )
+        run = run_game_training(
+            {
+                "train_input": [train],
+                "output_dir": str(tmp_path / "gwout"),
+                "task": "LOGISTIC_REGRESSION",
+                "num_iterations": 2,
+                "updating_sequence": ["per-user"],
+                "feature_shards": {"ushard": ushard},
+                "coordinates": {
+                    "per-user": {
+                        "shard": "ushard",
+                        "random_effect": "userId",
+                        "optimizer": "TRON",
+                        "reg_weights": [1.0],
+                        "max_iters": 30,
+                        "tolerance": 1e-9,
+                        "num_buckets": 2,
+                    }
+                },
+            }
+        )
+        table = np.asarray(run.sweep[0]["model"].params["per-user"])
+        evocab = run.entity_vocabs["userId"]
+        # every user's coefficient signs recover the truth — including
+        # user 0, whose poisoned rows carried weight 0
+        for u in range(n_users):
+            e = evocab[f"user{u}"]
+            idx = [
+                run.shard_vocabs["ushard"].get(f"uf{j}", "")
+                for j in range(d_u)
+            ]
+            agree = np.sign(table[e][idx]) == np.sign(w_u[u])
+            assert agree.all(), (u, table[e][idx], w_u[u])

@@ -276,3 +276,34 @@ class TestFeatureSharding:
         )
         with pytest.raises(ValueError, match="box constraints"):
             feature_sharded_train_glm(batch, cfg, make_feature_mesh(2, 4))
+
+
+class TestMultihost:
+    def test_single_process_noop(self, monkeypatch):
+        from photon_ml_tpu.parallel import initialize_multihost
+        from photon_ml_tpu.parallel import multihost
+
+        # hermetic: strip any ambient cluster config so the guard path is
+        # the one under test (pod-ish env vars exist on dev tunnels)
+        for var in (
+            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"
+        ):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setattr(multihost, "_INITIALIZED", False)
+        assert initialize_multihost() is False
+
+    def test_process_local_rows_single(self):
+        from photon_ml_tpu.parallel import process_local_rows
+
+        r = process_local_rows(103)
+        assert list(r) == list(range(103))
+
+    @pytest.mark.parametrize(
+        "total,n_proc", [(103, 4), (4, 103), (0, 3), (8, 8), (7, 2)]
+    )
+    def test_split_rows_disjoint_covering(self, total, n_proc):
+        from photon_ml_tpu.parallel.multihost import split_rows
+
+        ranges = [split_rows(total, n_proc, p) for p in range(n_proc)]
+        flat = [i for r in ranges for i in r]
+        assert flat == list(range(total))
