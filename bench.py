@@ -61,6 +61,10 @@ def log(*a):
 
 # TPU v5e peak dense matmul throughput (bf16), FLOP/s
 PEAK_FLOPS = 197e12
+# TPU v5e HBM bandwidth, bytes/s — the relevant roofline for GLM solves
+# (each objective pass streams the design matrix; arithmetic intensity is
+# ~2 FLOP/byte, far below the ~240 FLOP/byte compute-bound knee)
+PEAK_HBM_BPS = 819e9
 
 
 def _dense_click_data(n, n_test, d, seed=42):
@@ -145,7 +149,8 @@ def bench_glm_dense():
         # fused value/grad = 2 matmuls (margins + backproject) = 4nd FLOPs;
         # each CG Hessian-vector product is likewise 2 matmuls. +1 for the
         # initial value/grad before the loop.
-        fl = (iters + 1 + cg) * 4.0 * n * d
+        passes = iters + 1 + cg
+        fl = passes * 4.0 * n * d
         auc = float(
             area_under_roc_curve(
                 jnp.asarray(yte),
@@ -163,6 +168,9 @@ def bench_glm_dense():
     tpu_s = float(np.median(times))
     med = times.index(sorted(times)[1])
     mfu = flops[med] / tpu_s / PEAK_FLOPS
+    # each pass reads the bf16 design twice (margins + backprojection)
+    hbm_bytes = (flops[med] / (4.0 * n * d)) * 2.0 * x_bf16.nbytes
+    hbm_util = hbm_bytes / tpu_s / PEAK_HBM_BPS
     auc_dev = aucs[med]
 
     from sklearn.linear_model import LogisticRegression
@@ -189,6 +197,7 @@ def bench_glm_dense():
         "transfer_s": transfer_s,
         "transfer_gb": gb,
         "mfu": mfu,
+        "hbm_util": hbm_util,
         "achieved_tflops": flops[med] / tpu_s / 1e12,
         "auc_device": auc_dev,
         "auc_cpu": auc_cpu,
@@ -581,7 +590,8 @@ def main():
     extra = {
         "transfer_s": round(glm["transfer_s"], 2),
         "transfer_gb": round(glm["transfer_gb"], 3),
-        "mfu": round(glm["mfu"], 4),
+        "mfu": round(glm["mfu"], 5),
+        "hbm_util": round(glm["hbm_util"], 4),
         "achieved_tflops": round(glm["achieved_tflops"], 2),
         "sparse_200kx120k_s": round(sparse["tpu_s"], 3),
         "sparse_vs_sklearn": round(sparse["cpu_s"] / sparse["tpu_s"], 3),

@@ -93,5 +93,17 @@ def split_rows(total_rows: int, num_processes: int, process_id: int) -> range:
 def process_local_rows(total_rows: int) -> range:
     """The contiguous row range THIS process should ingest — the even
     split of a global row space over processes (the analog of the
-    reference's input-split assignment). Single-process: everything."""
+    reference's input-split assignment). Single-process: everything.
+
+    Must run AFTER :func:`initialize_multihost` on a pod — calling it
+    first would silently hand every host the full range (duplicated
+    ingest, corrupt global arrays), so a configured-but-unjoined runtime
+    is a hard error."""
+    configured = int(os.environ.get("JAX_NUM_PROCESSES", "1") or "1")
+    if jax.process_count() == 1 and configured > 1:
+        raise RuntimeError(
+            f"JAX_NUM_PROCESSES={configured} but this process has not "
+            "joined the multi-host runtime; call initialize_multihost() "
+            "before process_local_rows()"
+        )
     return split_rows(total_rows, jax.process_count(), jax.process_index())
