@@ -45,6 +45,7 @@ from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.solvers import (
     SolverConfig,
     minimize_lbfgs,
+    minimize_newton,
     minimize_owlqn,
     minimize_tron,
 )
@@ -97,6 +98,14 @@ def _make_solve_cached(config: CoordinateConfig, batched: bool):
     scfg = config.solver_config()
     use_owlqn = config.l1_ratio > 0.0
     use_tron = config.optimizer == OptimizerType.TRON
+    use_newton = config.optimizer == OptimizerType.NEWTON
+    if (use_tron or use_newton) and not loss.twice_differentiable:
+        # the GLM driver's validate() never runs for GAME coordinates, so
+        # enforce the second-order requirement here at build time
+        raise ValueError(
+            f"{config.task} is first-order only; {config.optimizer.name} "
+            "needs a twice-differentiable loss (use LBFGS)"
+        )
 
     def solve_one(w0, reg_weight, features, labels, offsets, weights, mask):
         l1 = reg_weight * config.l1_ratio
@@ -109,6 +118,9 @@ def _make_solve_cached(config: CoordinateConfig, batched: bool):
         if use_tron:
             hvp = lambda w, v: obj.hessian_vector(w, v, batch)
             return minimize_tron(vg, hvp, w0, scfg)
+        if use_newton:
+            hess = lambda w: obj.hessian_full(w, batch)
+            return minimize_newton(vg, hess, w0, scfg)
         return minimize_lbfgs(vg, w0, scfg)
 
     return jax.jit(jax.vmap(solve_one) if batched else solve_one)

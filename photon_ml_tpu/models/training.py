@@ -43,6 +43,7 @@ from photon_ml_tpu.solvers import (
     SolverConfig,
     SolverResult,
     minimize_lbfgs,
+    minimize_newton,
     minimize_owlqn,
     minimize_tron,
 )
@@ -53,10 +54,15 @@ _VARIANCE_EPSILON = 1e-12
 
 
 class OptimizerType(enum.Enum):
-    """``optimization/OptimizerType.scala``."""
+    """``optimization/OptimizerType.scala`` + NEWTON, a TPU-native
+    addition: exact Newton/IRLS with an explicit (d, d) Hessian and
+    Cholesky solves — one MXU pass per iteration. The reference cannot
+    afford the d^2 treeAggregate; small-d TPU solves can (dense features,
+    scale-only normalization, L2 only)."""
 
     LBFGS = "LBFGS"
     TRON = "TRON"
+    NEWTON = "NEWTON"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,6 +134,20 @@ class GLMTrainingConfig:
                 "standardization requires an intercept term "
                 "(reference Params.scala:166-169)"
             )
+        if self.optimizer == OptimizerType.NEWTON:
+            if has_l1:
+                raise ValueError("NEWTON supports L2 only (use OWL-QN for L1)")
+            if not loss_for_task(self.task).twice_differentiable:
+                raise ValueError(f"{self.task} is first-order only; use LBFGS")
+            if has_constraints:
+                raise ValueError(
+                    "NEWTON does not support box constraints; use LBFGS"
+                )
+            if self.normalization == NormalizationType.STANDARDIZATION:
+                raise ValueError(
+                    "NEWTON supports scale-only normalization (no whiten "
+                    "shifts); use SCALE_WITH_* or NONE"
+                )
 
     def solver_config(self) -> SolverConfig:
         lb = self.lower_bounds
@@ -173,6 +193,7 @@ def _build_solver_cached(config: GLMTrainingConfig):
     scfg = config.solver_config()
     use_owlqn = reg.reg_type in ("L1", "ELASTIC_NET")
     use_tron = config.optimizer == OptimizerType.TRON
+    use_newton = config.optimizer == OptimizerType.NEWTON
 
     @jax.jit
     def solve(w0, reg_weight, batch: LabeledBatch, norm: NormalizationContext):
@@ -185,6 +206,9 @@ def _build_solver_cached(config: GLMTrainingConfig):
         if use_tron:
             hvp = lambda w, v: obj.hessian_vector(w, v, batch)
             return minimize_tron(vg, hvp, w0, scfg)
+        if use_newton:
+            hess = lambda w: obj.hessian_full(w, batch)
+            return minimize_newton(vg, hess, w0, scfg)
         return minimize_lbfgs(vg, w0, scfg)
 
     @jax.jit

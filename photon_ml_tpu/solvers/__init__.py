@@ -1,4 +1,5 @@
-"""Solvers: jitted, vmappable convex optimizers (L-BFGS, OWL-QN, TRON).
+"""Solvers: jitted, vmappable convex optimizers (L-BFGS, OWL-QN, TRON,
+exact Newton-Cholesky).
 
 TPU rebuild of the reference's ``optimization/`` layer
 (``optimization/Optimizer.scala:31``, ``optimization/LBFGS.scala:41``,
@@ -17,6 +18,7 @@ from photon_ml_tpu.solvers.common import (
     project_to_hypercube,
 )
 from photon_ml_tpu.solvers.lbfgs import minimize_lbfgs, minimize_owlqn
+from photon_ml_tpu.solvers.newton import minimize_newton
 from photon_ml_tpu.solvers.tron import minimize_tron
 
 __all__ = [
@@ -27,4 +29,5 @@ __all__ = [
     "minimize_lbfgs",
     "minimize_owlqn",
     "minimize_tron",
+    "minimize_newton",
 ]

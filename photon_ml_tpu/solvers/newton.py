@@ -1,0 +1,206 @@
+"""Exact Newton (IRLS) with Cholesky solves, for the small-d regime.
+
+A TPU-native optimizer the reference cannot have: Photon-ML's optimizers
+are L-BFGS and Hessian-VECTOR TRON because a full (d, d) Hessian is a
+d^2-sized treeAggregate — prohibitive on Spark. On TPU the explicit
+cross-product X^T diag(c) X is one MXU pass and a (d, d) Cholesky is
+microseconds for d up to a few thousand, so each Newton iteration costs
+ONE data pass instead of a whole truncated-CG loop, and typical GLMs
+converge in < 10 iterations. This is the right solver for GAME
+fixed-effect coordinates (d ~ 10^1..10^3) and vmaps cleanly over the
+per-entity random-effect subproblems (d ~ 10^1).
+
+Damped for global convergence: backtracking halving on the Armijo
+condition (``SolverConfig.ls_c1`` / ``ls_max_evals``), plus a
+Levenberg-style jitter retry when the Cholesky meets a non-PD matrix
+(possible only with l2 = 0 on degenerate data). Convergence criteria
+match ``AbstractOptimizer.scala:52-62`` exactly like the other solvers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.solvers.common import (
+    ConvergenceReason,
+    SolverConfig,
+    SolverResult,
+    check_convergence,
+    model_buffer,
+    record_model,
+    record_state,
+    tracker_buffers,
+)
+
+ValueAndGrad = Callable[[jax.Array], Tuple[jax.Array, jax.Array]]
+HessianFull = Callable[[jax.Array], jax.Array]
+
+NEWTON_DEFAULT_CONFIG = SolverConfig(max_iters=25, tolerance=1e-7)
+
+
+class _NewtonState(NamedTuple):
+    w: jax.Array
+    value: jax.Array
+    grad: jax.Array
+    iteration: jax.Array
+    reason: jax.Array
+    value_initial: jax.Array
+    grad_norm_initial: jax.Array
+    values: jax.Array
+    grad_norms: jax.Array
+    w_history: jax.Array
+
+
+def _newton_direction(h: jax.Array, grad: jax.Array) -> jax.Array:
+    """Solve H p = -grad by Cholesky, retrying with a Levenberg jitter
+    when H is not positive definite (all branchless: the jittered solve
+    is selected where the plain factorization produced NaNs)."""
+    eye = jnp.eye(h.shape[-1], dtype=h.dtype)
+
+    def solve(mat):
+        factor = jax.scipy.linalg.cho_factor(mat)
+        return jax.scipy.linalg.cho_solve(factor, -grad)
+
+    p = solve(h)
+    bad = ~jnp.all(jnp.isfinite(p))
+    jitter = 1e-6 * (1.0 + jnp.trace(h) / h.shape[-1])
+    p_jittered = solve(h + jitter * eye)
+    return jnp.where(bad, p_jittered, p)
+
+
+def minimize_newton(
+    value_and_grad_fn: ValueAndGrad,
+    hessian_fn: HessianFull,
+    w0: jax.Array,
+    config: SolverConfig = NEWTON_DEFAULT_CONFIG,
+) -> SolverResult:
+    """Minimize a twice-differentiable objective by damped exact Newton."""
+    dtype = w0.dtype
+    v0, g0 = value_and_grad_fn(w0)
+    gnorm0 = jnp.linalg.norm(g0)
+    values, grad_norms = tracker_buffers(
+        config.max_iters, dtype, config.track_states
+    )
+    values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
+    w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
+
+    init = _NewtonState(
+        w=w0,
+        value=v0,
+        grad=g0,
+        iteration=jnp.int32(0),
+        reason=jnp.where(
+            gnorm0 == 0.0,
+            jnp.int32(ConvergenceReason.GRADIENT_CONVERGED),
+            jnp.int32(ConvergenceReason.NOT_CONVERGED),
+        ),
+        value_initial=v0,
+        grad_norm_initial=gnorm0,
+        values=values,
+        grad_norms=grad_norms,
+        w_history=w_hist0,
+    )
+
+    def body(s: _NewtonState) -> _NewtonState:
+        h = hessian_fn(s.w)
+        direction = _newton_direction(h, s.grad)
+        dphi0 = jnp.vdot(s.grad, direction)
+        # Non-descent (numerically possible with the jitter fallback):
+        # fall back to steepest descent scaled to the Newton step length.
+        bad_dir = dphi0 >= 0.0
+        direction = jnp.where(
+            bad_dir,
+            -s.grad
+            * (jnp.linalg.norm(direction) / jnp.maximum(jnp.linalg.norm(s.grad), 1e-30)),
+            direction,
+        )
+        dphi0 = jnp.where(bad_dir, jnp.vdot(s.grad, direction), dphi0)
+
+        def ls_cond(c):
+            alpha, _, _, k, accepted = c
+            return (~accepted) & (k < config.ls_max_evals)
+
+        def ls_body(c):
+            alpha, _, _, k, _ = c
+            wt = s.w + alpha * direction
+            vt, gt = value_and_grad_fn(wt)
+            ok = vt <= s.value + config.ls_c1 * alpha * dphi0
+            return (
+                jnp.where(ok, alpha, alpha * 0.5),
+                vt,
+                gt,
+                k + 1,
+                ok,
+            )
+
+        w_full = s.w + direction
+        v_full, g_full = value_and_grad_fn(w_full)
+        acc0 = v_full <= s.value + config.ls_c1 * dphi0
+        alpha, v_new, g_new, _, ls_ok = lax.while_loop(
+            ls_cond,
+            ls_body,
+            (
+                jnp.where(acc0, jnp.asarray(1.0, dtype), jnp.asarray(0.5, dtype)),
+                v_full,
+                g_full,
+                jnp.int32(1),
+                acc0,
+            ),
+        )
+        w_new = s.w + alpha * direction
+        w_new = jnp.where(ls_ok, w_new, s.w)
+        v_new = jnp.where(ls_ok, v_new, s.value)
+        g_new = jnp.where(ls_ok, g_new, s.grad)
+
+        it = s.iteration + 1
+        gnorm = jnp.linalg.norm(g_new)
+        reason = check_convergence(
+            s.value,
+            v_new,
+            gnorm,
+            s.value_initial,
+            s.grad_norm_initial,
+            it,
+            config.max_iters,
+            config.tolerance,
+        )
+        reason = jnp.where(
+            (~ls_ok)
+            & (reason != ConvergenceReason.GRADIENT_CONVERGED)
+            & (reason != ConvergenceReason.MAX_ITERATIONS),
+            jnp.int32(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
+            reason,
+        )
+        values, grad_norms = record_state(
+            s.values, s.grad_norms, it, v_new, gnorm
+        )
+        return _NewtonState(
+            w=w_new,
+            value=v_new,
+            grad=g_new,
+            iteration=it,
+            reason=reason,
+            value_initial=s.value_initial,
+            grad_norm_initial=s.grad_norm_initial,
+            values=values,
+            grad_norms=grad_norms,
+            w_history=record_model(s.w_history, it, w_new),
+        )
+
+    final = lax.while_loop(
+        lambda s: s.reason == ConvergenceReason.NOT_CONVERGED, body, init
+    )
+    return SolverResult(
+        w=final.w,
+        value=final.value,
+        grad=final.grad,
+        iterations=final.iteration,
+        reason=final.reason,
+        values=final.values,
+        grad_norms=final.grad_norms,
+        w_history=final.w_history if config.track_models else None,
+    )

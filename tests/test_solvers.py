@@ -13,6 +13,8 @@ import numpy as np
 import pytest
 import scipy.optimize
 
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.solvers import (
     ConvergenceReason,
     SolverConfig,
@@ -258,3 +260,173 @@ class TestConvergenceSemantics:
         res = minimize_lbfgs(vg, jnp.zeros(3))
         assert int(res.reason) == ConvergenceReason.GRADIENT_CONVERGED
         assert int(res.iterations) == 0
+
+
+class TestNewton:
+    """Exact Newton-Cholesky: the TPU-native small-d optimizer. Oracles:
+    TRON/sklearn solutions on the same objective."""
+
+    def _logistic(self, rng, n=2000, d=12):
+        x = rng.normal(size=(n, d))
+        w = rng.normal(size=d)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w))).astype(float)
+        return LabeledBatch.create(x, y, dtype=jnp.float64)
+
+    def _solve(self, batch, optimizer, lam=1.0, task=None):
+        from photon_ml_tpu.models import (
+            GLMTrainingConfig,
+            OptimizerType,
+            TaskType,
+            train_glm,
+        )
+        from photon_ml_tpu.ops import RegularizationContext
+
+        (tm,) = train_glm(
+            batch,
+            GLMTrainingConfig(
+                task=task or TaskType.LOGISTIC_REGRESSION,
+                optimizer=OptimizerType[optimizer],
+                regularization=RegularizationContext("L2"),
+                reg_weights=(lam,),
+                max_iters=60,
+                tolerance=1e-12,
+                track_states=False,
+            ),
+        )
+        return tm
+
+    def test_matches_tron_solution(self, rng):
+        batch = self._logistic(rng)
+        newton = self._solve(batch, "NEWTON")
+        tron = self._solve(batch, "TRON")
+        np.testing.assert_allclose(
+            np.asarray(newton.model.coefficients.means),
+            np.asarray(tron.model.coefficients.means),
+            atol=1e-7,
+        )
+        # the point of Newton: far fewer iterations than TRON
+        assert int(newton.result.iterations) <= int(tron.result.iterations)
+        assert int(newton.result.iterations) <= 12
+
+    def test_matches_sklearn(self, rng):
+        from sklearn.linear_model import LogisticRegression
+
+        batch = self._logistic(rng, n=3000, d=8)
+        newton = self._solve(batch, "NEWTON", lam=1.0)
+        skl = LogisticRegression(
+            C=1.0, fit_intercept=False, tol=1e-12, max_iter=500
+        ).fit(np.asarray(batch.features), np.asarray(batch.labels))
+        np.testing.assert_allclose(
+            np.asarray(newton.model.coefficients.means),
+            skl.coef_.ravel(),
+            atol=1e-5,
+        )
+
+    def test_linear_regression_exact_in_two_iterations(self, rng):
+        from photon_ml_tpu.models import TaskType
+
+        n, d = 500, 6
+        x = rng.normal(size=(n, d))
+        y = x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+        newton = self._solve(
+            batch, "NEWTON", lam=1.0, task=TaskType.LINEAR_REGRESSION
+        )
+        # quadratic objective: one Newton step reaches the optimum (the
+        # second iteration only certifies convergence)
+        assert int(newton.result.iterations) <= 2
+        ridge = np.linalg.solve(x.T @ x + np.eye(d), x.T @ y)
+        np.testing.assert_allclose(
+            np.asarray(newton.model.coefficients.means), ridge, atol=1e-8
+        )
+
+    def test_vmapped_per_entity_solves(self, rng):
+        """The GAME regime: batched Newton over many tiny subproblems."""
+        from photon_ml_tpu.game.coordinates import (
+            CoordinateConfig,
+            _make_solve,
+        )
+        from photon_ml_tpu.models.training import OptimizerType
+
+        e, r, d = 12, 30, 4
+        x = rng.normal(size=(e, r, d))
+        w = rng.normal(size=(e, d))
+        y = (
+            rng.uniform(size=(e, r))
+            < 1 / (1 + np.exp(-np.einsum("erd,ed->er", x, w)))
+        ).astype(float)
+        args = (
+            jnp.zeros((e, d)),
+            jnp.full((e,), 1.0),
+            jnp.asarray(x),
+            jnp.asarray(y),
+            jnp.zeros((e, r)),
+            jnp.ones((e, r)),
+            jnp.ones((e, r)),
+        )
+        cfg = dict(
+            shard="s",
+            task=TaskType.LOGISTIC_REGRESSION,
+            reg_weight=1.0,
+            max_iters=40,
+            tolerance=1e-12,
+        )
+        newton = _make_solve(
+            CoordinateConfig(optimizer=OptimizerType.NEWTON, **cfg), True
+        )(*args)
+        tron = _make_solve(
+            CoordinateConfig(optimizer=OptimizerType.TRON, **cfg), True
+        )(*args)
+        np.testing.assert_allclose(
+            np.asarray(newton.w), np.asarray(tron.w), atol=1e-7
+        )
+
+    def test_validation_guards(self, rng):
+        from photon_ml_tpu.models import (
+            GLMTrainingConfig,
+            OptimizerType,
+            TaskType,
+        )
+        from photon_ml_tpu.ops import RegularizationContext
+
+        with pytest.raises(ValueError, match="L2 only"):
+            GLMTrainingConfig(
+                optimizer=OptimizerType.NEWTON,
+                regularization=RegularizationContext("L1"),
+            ).validate()
+        with pytest.raises(ValueError, match="first-order"):
+            GLMTrainingConfig(
+                optimizer=OptimizerType.NEWTON,
+                task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+            ).validate()
+        with pytest.raises(ValueError, match="box constraints"):
+            GLMTrainingConfig(
+                optimizer=OptimizerType.NEWTON,
+                lower_bounds=(0.0,),
+            ).validate()
+        with pytest.raises(ValueError, match="scale-only"):
+            from photon_ml_tpu.core.normalization import NormalizationType
+
+            GLMTrainingConfig(
+                optimizer=OptimizerType.NEWTON,
+                normalization=NormalizationType.STANDARDIZATION,
+                intercept_index=0,
+            ).validate()
+
+    def test_game_coordinate_rejects_first_order_loss(self):
+        from photon_ml_tpu.game.coordinates import (
+            CoordinateConfig,
+            _make_solve,
+        )
+        from photon_ml_tpu.models.training import OptimizerType
+
+        for opt in (OptimizerType.NEWTON, OptimizerType.TRON):
+            with pytest.raises(ValueError, match="first-order only"):
+                _make_solve(
+                    CoordinateConfig(
+                        shard="s",
+                        task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+                        optimizer=opt,
+                    ),
+                    batched=True,
+                )
