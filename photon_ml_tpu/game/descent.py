@@ -171,7 +171,12 @@ class CoordinateDescent:
                         "return a longer run's state as if it were shorter"
                     )
                 model = GameModel(
-                    {n: jnp.asarray(ckpt.params[n]) for n in names}
+                    {
+                        n: jax.tree_util.tree_map(
+                            jnp.asarray, ckpt.params[n]
+                        )
+                        for n in names
+                    }
                 )
                 key = jnp.asarray(ckpt.rng_key, jnp.uint32)
                 start_it = ckpt.step
@@ -274,7 +279,8 @@ class CoordinateDescent:
                 save_checkpoint(
                     checkpoint_dir,
                     it + 1,
-                    {n: np.asarray(model.params[n]) for n in names},
+                    # save_checkpoint handles plain tables AND FactoredParams
+                    dict(model.params),
                     np.asarray(key),
                     [dataclasses.asdict(h) for h in history],
                 )

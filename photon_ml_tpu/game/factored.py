@@ -58,6 +58,12 @@ class FactoredParams:
     projection: jax.Array  # (d, k)
 
 
+def is_factored_params(x) -> bool:
+    """THE predicate for factored parameter containers — persistence and
+    checkpointing dispatch on it."""
+    return isinstance(x, FactoredParams)
+
+
 @dataclasses.dataclass(frozen=True)
 class FactoredConfig:
     """``MFOptimizationConfiguration.scala:24-46`` ("numInnerIter,latentDim")

@@ -8,10 +8,12 @@ write the FULL training state — model parameter tables, the PRNG key, the
 iteration counter, and the objective history — and a resumed run continues
 bit-for-bit where the original left off.
 
-Layout: ``<dir>/step-<k>/`` holding ``arrays.npz`` (parameter tables keyed
-``param/<coordinate>``) + ``manifest.json`` (counters, RNG key, history).
-The write is atomic (temp dir + rename) so a crash mid-checkpoint leaves
-the previous step intact.
+Layout: ``<dir>/step-<k>/`` holding ``arrays.npz`` (plain parameter tables
+keyed ``param/<coordinate>``; factored coordinates store two leaves,
+``param/<coordinate>#gamma`` and ``param/<coordinate>#projection``, with
+the kind recorded in the manifest) + ``manifest.json`` (counters, RNG key,
+history). The write is atomic (temp dir + rename) so a crash
+mid-checkpoint leaves the previous step intact.
 """
 
 from __future__ import annotations
@@ -30,7 +32,8 @@ _STEP_PREFIX = "step-"
 @dataclasses.dataclass
 class TrainingCheckpoint:
     step: int  # completed outer iterations
-    params: Dict[str, np.ndarray]
+    # coordinate -> plain table OR game.factored.FactoredParams
+    params: Dict[str, object]
     rng_key: np.ndarray
     history: List[dict]
 
@@ -38,7 +41,7 @@ class TrainingCheckpoint:
 def save_checkpoint(
     directory: str,
     step: int,
-    params: Dict[str, np.ndarray],
+    params: Dict[str, object],  # tables and/or FactoredParams
     rng_key,
     history: Optional[List[dict]] = None,
     keep: int = 2,
@@ -50,14 +53,32 @@ def save_checkpoint(
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    np.savez(
-        os.path.join(tmp, "arrays.npz"),
-        **{f"param/{name}": np.asarray(p) for name, p in params.items()},
-    )
+    from photon_ml_tpu.game.factored import is_factored_params
+
+    arrays: Dict[str, np.ndarray] = {}
+    param_kinds: Dict[str, str] = {}
+    for name, p in params.items():
+        if "#" in name:
+            # '#' is the factored-leaf separator in npz keys; a coordinate
+            # named e.g. "u#gamma" would collide with factored "u"'s leaf
+            raise ValueError(
+                f"coordinate name {name!r} contains '#' (reserved for the "
+                "checkpoint leaf encoding)"
+            )
+        if is_factored_params(p):
+            # factored random effect: two leaves, reassembled at load
+            param_kinds[name] = "factored"
+            arrays[f"param/{name}#gamma"] = np.asarray(p.gamma)
+            arrays[f"param/{name}#projection"] = np.asarray(p.projection)
+        else:
+            param_kinds[name] = "array"
+            arrays[f"param/{name}"] = np.asarray(p)
+    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
     manifest = {
         "step": step,
         "rng_key": np.asarray(rng_key).tolist(),
         "param_names": sorted(params),
+        "param_kinds": param_kinds,
         "history": history or [],
     }
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
@@ -95,9 +116,18 @@ def latest_checkpoint(directory: str) -> Optional[TrainingCheckpoint]:
     with open(os.path.join(d, "manifest.json")) as f:
         manifest = json.load(f)
     arrays = np.load(os.path.join(d, "arrays.npz"))
-    params = {
-        name: arrays[f"param/{name}"] for name in manifest["param_names"]
-    }
+    kinds = manifest.get("param_kinds", {})
+    params = {}
+    for name in manifest["param_names"]:
+        if kinds.get(name, "array") == "factored":
+            from photon_ml_tpu.game.factored import FactoredParams
+
+            params[name] = FactoredParams(
+                gamma=arrays[f"param/{name}#gamma"],
+                projection=arrays[f"param/{name}#projection"],
+            )
+        else:
+            params[name] = arrays[f"param/{name}"]
     return TrainingCheckpoint(
         step=manifest["step"],
         params=params,

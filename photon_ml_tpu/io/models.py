@@ -331,7 +331,9 @@ def collapse_game_model(
 
 
 def _is_factored(table) -> bool:
-    return hasattr(table, "gamma") and hasattr(table, "projection")
+    from photon_ml_tpu.game.factored import is_factored_params
+
+    return is_factored_params(table)
 
 
 def _write_latent_factor_table(

@@ -96,3 +96,75 @@ class TestKillAndResume:
                 np.asarray(model2.params[name]),
             )
         assert [h.objective for h in hist1] == [h.objective for h in hist2]
+
+
+class TestFactoredCheckpoint:
+    def test_factored_coordinate_checkpoint_resume(self, rng, tmp_path):
+        """Checkpoint + resume with a FactoredParams coordinate: resumed
+        run reproduces the uninterrupted run exactly."""
+        import dataclasses as dc
+
+        from photon_ml_tpu.core.tasks import TaskType
+        from photon_ml_tpu.game import (
+            CoordinateConfig,
+            CoordinateDescent,
+            FactoredConfig,
+            FactoredRandomEffectCoordinate,
+            GameData,
+            build_random_effect_design,
+        )
+        from photon_ml_tpu.models.training import OptimizerType
+
+        n_users, rows, d = 6, 25, 4
+        user = np.repeat(np.arange(n_users), rows)
+        x = rng.normal(size=(n_users * rows, d))
+        y = (rng.uniform(size=user.size) < 0.5).astype(float)
+        data = GameData.create(
+            features={"s": x}, labels=y, entity_ids={"u": user}
+        )
+        design = build_random_effect_design(
+            data, "u", "s", n_users, dtype=jnp.float64
+        )
+
+        def make_cd():
+            coord = FactoredRandomEffectCoordinate(
+                design=design,
+                row_features=jnp.asarray(x),
+                row_entities=jnp.asarray(user, jnp.int32),
+                full_offsets_base=jnp.zeros(user.size),
+                re_config=CoordinateConfig(
+                    shard="s",
+                    task=TaskType.LOGISTIC_REGRESSION,
+                    optimizer=OptimizerType.LBFGS,
+                    reg_weight=1.0,
+                    max_iters=8,
+                    tolerance=1e-8,
+                    random_effect="u",
+                ),
+                factored=FactoredConfig(latent_dim=2),
+            )
+            return CoordinateDescent(
+                coordinates={"fact": coord},
+                labels=jnp.asarray(y),
+                base_offsets=jnp.zeros(user.size),
+                weights=jnp.ones(user.size),
+                task=TaskType.LOGISTIC_REGRESSION,
+            )
+
+        ckpt = str(tmp_path / "fck")
+        make_cd().run(
+            num_iterations=1, checkpoint_dir=ckpt, checkpoint_every=1
+        )
+        resumed, _ = make_cd().run(
+            num_iterations=2, checkpoint_dir=ckpt, checkpoint_every=1,
+            resume=True,
+        )
+        straight, _ = make_cd().run(num_iterations=2)
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params["fact"].gamma),
+            np.asarray(straight.params["fact"].gamma),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params["fact"].projection),
+            np.asarray(straight.params["fact"].projection),
+        )
