@@ -47,24 +47,26 @@ def save_checkpoint(
     keep: int = 2,
 ) -> str:
     """Atomically write ``<directory>/step-<step>`` and prune old steps."""
+    from photon_ml_tpu.game.factored import is_factored_params
+
+    for name in params:
+        if "#" in name:
+            # '#' is the factored-leaf separator in npz keys; a coordinate
+            # named e.g. "u#gamma" would collide with factored "u"'s leaf.
+            # Validate before ANY filesystem mutation.
+            raise ValueError(
+                f"coordinate name {name!r} contains '#' (reserved for the "
+                "checkpoint leaf encoding)"
+            )
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"{_STEP_PREFIX}{step}")
     tmp = final + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    from photon_ml_tpu.game.factored import is_factored_params
-
     arrays: Dict[str, np.ndarray] = {}
     param_kinds: Dict[str, str] = {}
     for name, p in params.items():
-        if "#" in name:
-            # '#' is the factored-leaf separator in npz keys; a coordinate
-            # named e.g. "u#gamma" would collide with factored "u"'s leaf
-            raise ValueError(
-                f"coordinate name {name!r} contains '#' (reserved for the "
-                "checkpoint leaf encoding)"
-            )
         if is_factored_params(p):
             # factored random effect: two leaves, reassembled at load
             param_kinds[name] = "factored"

@@ -102,8 +102,6 @@ class TestFactoredCheckpoint:
     def test_factored_coordinate_checkpoint_resume(self, rng, tmp_path):
         """Checkpoint + resume with a FactoredParams coordinate: resumed
         run reproduces the uninterrupted run exactly."""
-        import dataclasses as dc
-
         from photon_ml_tpu.core.tasks import TaskType
         from photon_ml_tpu.game import (
             CoordinateConfig,

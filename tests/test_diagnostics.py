@@ -359,3 +359,90 @@ class TestHtmlRenderer:
         )
         assert doc.startswith("<!DOCTYPE html>")
         assert "Feature space: 3 columns" in doc
+
+
+class TestDiagnosticsWithSparseBatches:
+    def test_driver_diagnose_sparse(self, tmp_path, rng):
+        """The DIAGNOSED stage must work when ingest uses the padded-ELL
+        sparse representation."""
+        from photon_ml_tpu.cli.stages import DriverStage
+        from photon_ml_tpu.cli.train import run_glm_training
+        from photon_ml_tpu.io.avro import write_avro_file
+        from photon_ml_tpu.io.ingest import make_training_example
+        from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        n, d = 500, 6
+        x = rng.normal(size=(n, d))
+        w = np.asarray([2.0, -2.0, 1.0, 0.0, 0.5, -0.5])
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w))).astype(float)
+        for sub, lo, hi in (("train", 0, 350), ("validate", 350, 500)):
+            p = tmp_path / sub
+            p.mkdir()
+            recs = [
+                make_training_example(
+                    y[i], {(f"f{j}", ""): x[i, j] for j in range(d)}
+                )
+                for i in range(lo, hi)
+            ]
+            write_avro_file(
+                str(p / "p.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+            )
+        run = run_glm_training(
+            {
+                "train_input": [str(tmp_path / "train")],
+                "validate_input": [str(tmp_path / "validate")],
+                "output_dir": str(tmp_path / "out"),
+                "optimizer": "LBFGS",
+                "reg_weights": [1.0],
+                "max_iters": 40,
+                "sparse": True,
+                "diagnostics": True,
+            }
+        )
+        assert DriverStage.DIAGNOSED in run.stages
+        html = open(
+            os.path.join(str(tmp_path / "out"), "model-diagnostic.html")
+        ).read()
+        assert "Hosmer&ndash;Lemeshow" in html
+        assert "Kendall tau" in html
+
+
+class TestNewtonWithNormalization:
+    def test_scale_normalization_equivalent(self, rng):
+        """NEWTON under SCALE_WITH_MAX_MAGNITUDE_AND_CONSTANT-style
+        normalization reproduces the unnormalized optimum after the
+        coefficient back-transform."""
+        from photon_ml_tpu.core.normalization import NormalizationType
+        from photon_ml_tpu.models import (
+            GLMTrainingConfig,
+            OptimizerType,
+            TaskType,
+            train_glm,
+        )
+        from photon_ml_tpu.ops import RegularizationContext
+
+        n, d = 1500, 5
+        x = rng.normal(size=(n, d)) * np.asarray([1.0, 10.0, 0.1, 5.0, 2.0])
+        w = rng.normal(size=d)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w))).astype(float)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+
+        def solve(norm):
+            (tm,) = train_glm(
+                batch,
+                GLMTrainingConfig(
+                    task=TaskType.LOGISTIC_REGRESSION,
+                    optimizer=OptimizerType.NEWTON,
+                    regularization=RegularizationContext("NONE"),
+                    reg_weights=(0.0,),
+                    normalization=norm,
+                    max_iters=40,
+                    tolerance=1e-12,
+                    track_states=False,
+                ),
+            )
+            return np.asarray(tm.model.coefficients.means)
+
+        plain = solve(NormalizationType.NONE)
+        scaled = solve(NormalizationType.SCALE_WITH_MAX_MAGNITUDE)
+        np.testing.assert_allclose(scaled, plain, atol=1e-6)
