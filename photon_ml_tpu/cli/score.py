@@ -191,12 +191,46 @@ def run_scoring(params) -> ScoringRun:
             entity_keys = sorted(
                 {re for re in random_effects.values() if re is not None}
             )
-            # entity vocab per RE type: merge coordinate vocabs (they are
-            # keyed by coordinate in the model, by RE type in the data)
+            # Entity vocab per RE TYPE = the UNION over the coordinates
+            # sharing it (the data is indexed once per type; each
+            # coordinate's table rows must live in that shared space —
+            # a first-coordinate-wins merge would silently misattribute
+            # every other coordinate's per-entity rows). Coordinates
+            # lacking an entity contribute zero rows, the reference's
+            # missing-entity-scores-0 cogroup semantic.
+            from photon_ml_tpu.game.factored import (
+                FactoredParams,
+                is_factored_params,
+            )
+            from photon_ml_tpu.io.models import (
+                remap_entity_rows,
+                union_entity_vocab,
+            )
+
             re_vocabs: Dict[str, dict] = {}
+            for re_key in entity_keys:
+                re_vocabs[re_key] = union_entity_vocab(
+                    entity_vocabs[name]
+                    for name, rk in random_effects.items()
+                    if rk == re_key
+                )
             for name, re_key in random_effects.items():
-                if re_key is not None:
-                    re_vocabs.setdefault(re_key, entity_vocabs[name])
+                if re_key is None:
+                    continue
+                shared = re_vocabs[re_key]
+                own = entity_vocabs[name]
+                p = model_params[name]
+                if is_factored_params(p):
+                    model_params[name] = FactoredParams(
+                        gamma=jnp.asarray(
+                            remap_entity_rows(p.gamma, own, shared)
+                        ),
+                        projection=p.projection,
+                    )
+                else:
+                    model_params[name] = remap_entity_rows(
+                        p, own, shared
+                    )
             data, _, uids = game_data_from_avro(
                 records,
                 shard_vocabs,

@@ -260,6 +260,33 @@ def _maybe_int(s):
         return s
 
 
+def union_entity_vocab(vocabs) -> dict:
+    """Union of raw entity ids over an iterable of {raw: row} vocabs,
+    assigned rows in first-seen order."""
+    out: dict = {}
+    for vocab in vocabs:
+        for raw in vocab:
+            out.setdefault(raw, len(out))
+    return out
+
+
+def remap_entity_rows(
+    table: np.ndarray, own: dict, shared: dict
+) -> np.ndarray:
+    """Re-index a per-entity row table from its own {raw: row} vocab into a
+    shared one (missing entities keep zero rows — the cogroup
+    missing-entity-scores-0 semantic). Identity vocab: returns the input
+    unchanged (no copy)."""
+    table = np.asarray(table)
+    if shared == own:
+        return table
+    src = np.fromiter(own.values(), np.int64, count=len(own))
+    dst = np.asarray([shared[raw] for raw in own], np.int64)
+    out = np.zeros((len(shared), table.shape[1]), table.dtype)
+    out[dst] = table[src]
+    return out
+
+
 def collapse_game_model(
     params: Dict[str, np.ndarray],
     shards: Dict[str, str],
@@ -299,26 +326,15 @@ def collapse_game_model(
             )
             continue
         # cogroup random-effect tables on raw entity ids
-        raw_ids: List = []
-        seen = set()
-        for n in names:
-            for raw in entity_vocabs[n]:
-                if raw not in seen:
-                    seen.add(raw)
-                    raw_ids.append(raw)
-        merged_vocab = {raw: i for i, raw in enumerate(raw_ids)}
+        merged_vocab = union_entity_vocab(
+            entity_vocabs[n] for n in names
+        )
         d = np.asarray(params[names[0]]).shape[1]
-        table = np.zeros((len(raw_ids), d))
+        table = np.zeros((len(merged_vocab), d))
         for n in names:
-            t = np.asarray(params[n])
-            src = np.fromiter(
-                entity_vocabs[n].values(), np.int64,
-                count=len(entity_vocabs[n]),
+            table += remap_entity_rows(
+                params[n], entity_vocabs[n], merged_vocab
             )
-            dst = np.asarray(
-                [merged_vocab[raw] for raw in entity_vocabs[n]], np.int64
-            )
-            np.add.at(table, dst, t[src])
         out_params[merged_name] = table
         out_evocabs[merged_name] = merged_vocab
     return out_params, out_shards, out_res, out_evocabs

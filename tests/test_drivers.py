@@ -1041,3 +1041,64 @@ class TestNonLogisticDrivers:
             ]
             agree = np.sign(table[e][idx]) == np.sign(w_u[u])
             assert agree.all(), (u, table[e][idx], w_u[u])
+
+
+class TestSharedRandomEffectTypeScoring:
+    def test_coordinates_sharing_re_type_score_correctly(
+        self, rng, tmp_path
+    ):
+        """Two coordinates share randomEffectType userId with DIFFERENT
+        entity sets/orders on disk: scoring must cogroup by raw id, not
+        first-coordinate-wins row indexing (regression: scores were
+        silently misattributed)."""
+        from photon_ml_tpu.io.models import save_game_model
+        from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+        root = str(tmp_path / "model")
+        vocab = FeatureVocabulary(
+            [feature_key("uf0", ""), feature_key("uf1", "")]
+        )
+        save_game_model(
+            root,
+            params={
+                "a": np.asarray([[1.0, 0.0], [2.0, 0.0]]),  # u0, u1
+                "b": np.asarray([[30.0, 0.0], [40.0, 0.0]]),  # u1, u2
+            },
+            shards={"a": "us", "b": "us"},
+            vocabs={"a": vocab, "b": vocab},
+            entity_vocabs={
+                "a": {"u0": 0, "u1": 1},
+                "b": {"u1": 0, "u2": 1},
+            },
+            random_effects={"a": "userId", "b": "userId"},
+        )
+        vocab.save(os.path.join(root, "feature-index-us.txt"))
+
+        sdir = tmp_path / "score"
+        sdir.mkdir()
+        recs = [
+            {
+                "uid": f"r{i}",
+                "label": 0.0,
+                "features": [
+                    {"name": "uf0", "term": "", "value": 1.0}
+                ],
+                "metadataMap": {"userId": u},
+                "weight": None,
+                "offset": None,
+            }
+            for i, u in enumerate(["u0", "u1", "u2"])
+        ]
+        write_avro_file(
+            str(sdir / "p.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+        )
+        srun = run_scoring(
+            {
+                "input": [str(sdir)],
+                "model_dir": root,
+                "output_dir": str(tmp_path / "out"),
+                "model_kind": "game",
+            }
+        )
+        # u0 -> a only (1); u1 -> a + b (2 + 30); u2 -> b only (40)
+        np.testing.assert_allclose(srun.scores, [1.0, 32.0, 40.0])
