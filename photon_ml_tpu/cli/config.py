@@ -70,6 +70,10 @@ class GLMDriverParams:
     # float64 matches the reference's double-precision solves; silently
     # degrades to float32 when x64 is disabled (default on TPU backends)
     precision: str = "float64"
+    # device mesh for the solve: {"data": N} row-shards the batch (GSPMD
+    # psum aggregation), {"data": N, "feature": M} additionally shards the
+    # coefficient axis (the huge-d regime). None = single-device.
+    mesh_shape: Optional[Dict[str, int]] = None
     # emit a jax.profiler trace of the train phase under
     # <output_dir>/profile (TensorBoard-loadable) — SURVEY §5.1
     profile: bool = False
@@ -95,6 +99,34 @@ class GLMDriverParams:
             raise ValueError(
                 "validate_per_iteration requires validate_input"
             )
+        if self.mesh_shape is not None:
+            unknown = set(self.mesh_shape) - {"data", "feature"}
+            if unknown:
+                raise ValueError(
+                    f"mesh_shape axes must be 'data'/'feature': {unknown}"
+                )
+            if any(
+                not isinstance(v, int) or v < 1
+                for v in self.mesh_shape.values()
+            ):
+                raise ValueError(
+                    f"mesh_shape sizes must be integers >= 1: "
+                    f"{self.mesh_shape}"
+                )
+            # fail feature-sharding incompatibilities BEFORE data ingest
+            if self.mesh_shape.get("feature", 1) > 1:
+                if self.sparse:
+                    raise ValueError(
+                        "feature sharding currently requires dense features"
+                    )
+                if self.normalization != "NONE":
+                    raise ValueError(
+                        "feature sharding requires NONE normalization"
+                    )
+                if self.constraint_file:
+                    raise ValueError(
+                        "feature sharding does not support box constraints"
+                    )
         if self.diagnostics and not self.validate_input:
             raise ValueError(
                 "diagnostics requires validate_input (the model diagnostics "

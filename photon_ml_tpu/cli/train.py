@@ -232,7 +232,48 @@ def run_glm_training(params) -> GLMTrainingRun:
             # features start at 0)
             initial, _ = load_glm_model(init_path, vocab)
             logger.info(f"warm-starting from {init_path}")
-        models = list(train_glm(batch, cfg, initial_coefficients=initial))
+        if params.mesh_shape:
+            # mesh-sharded solve: 'data' row-shards (GSPMD psum), adding
+            # 'feature' also shards the coefficient axis (huge-d regime)
+            import jax
+
+            from photon_ml_tpu.parallel import (
+                distributed_train_glm,
+                feature_sharded_train_glm,
+                make_feature_mesh,
+                make_mesh,
+            )
+
+            n_data = params.mesh_shape.get("data", 1)
+            n_feat = params.mesh_shape.get("feature", 1)
+            if n_data * n_feat > len(jax.devices()):
+                raise ValueError(
+                    f"mesh {params.mesh_shape} needs {n_data * n_feat} "
+                    f"devices, have {len(jax.devices())}"
+                )
+            logger.info(f"mesh solve over {params.mesh_shape}")
+            if n_feat > 1:
+                models = list(
+                    feature_sharded_train_glm(
+                        batch,
+                        cfg,
+                        make_feature_mesh(n_data, n_feat),
+                        initial_coefficients=initial,
+                    )
+                )
+            else:
+                models = list(
+                    distributed_train_glm(
+                        batch,
+                        cfg,
+                        make_mesh(n_data),
+                        initial_coefficients=initial,
+                    )
+                )
+        else:
+            models = list(
+                train_glm(batch, cfg, initial_coefficients=initial)
+            )
         for tm in models:
             logger.info(
                 f"lambda={tm.reg_weight}: iters={int(tm.result.iterations)} "

@@ -69,6 +69,7 @@ def feature_sharded_train_glm(
     batch: LabeledBatch,
     config: GLMTrainingConfig,
     mesh: Mesh,
+    initial_coefficients: Optional[Coefficients] = None,
     **kwargs,
 ) -> Sequence[TrainedModel]:
     """``train_glm`` with the design sharded over BOTH ('data', 'feature')
@@ -112,10 +113,14 @@ def feature_sharded_train_glm(
         weights=jax.device_put(padded.weights, row_spec),
         mask=jax.device_put(padded.mask, row_spec),
     )
-    w0 = jax.device_put(
-        jnp.zeros((d_pad,), padded.features.dtype),
-        NamedSharding(mesh, P(FEATURE_AXIS)),
-    )
+    if initial_coefficients is not None:
+        w0_host = jnp.pad(
+            jnp.asarray(initial_coefficients.means, padded.features.dtype),
+            (0, d_pad - d),
+        )
+    else:
+        w0_host = jnp.zeros((d_pad,), padded.features.dtype)
+    w0 = jax.device_put(w0_host, NamedSharding(mesh, P(FEATURE_AXIS)))
     with jax.set_mesh(mesh):
         models = train_glm(
             padded,

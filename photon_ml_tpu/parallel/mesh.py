@@ -34,6 +34,10 @@ def make_mesh(
     devs = list(devices) if devices is not None else jax.devices()
     if n_data is None:
         n_data = len(devs)
+    if n_data > len(devs):
+        raise ValueError(
+            f"mesh of {n_data} 'data' devices requested, have {len(devs)}"
+        )
     return Mesh(np.asarray(devs[:n_data]), (DATA_AXIS,))
 
 

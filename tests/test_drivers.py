@@ -1102,3 +1102,56 @@ class TestSharedRandomEffectTypeScoring:
         )
         # u0 -> a only (1); u1 -> a + b (2 + 30); u2 -> b only (40)
         np.testing.assert_allclose(srun.scores, [1.0, 32.0, 40.0])
+
+
+class TestMeshShardedDriver:
+    def test_data_and_feature_mesh_match_local(self, rng, glm_fixture):
+        """mesh_shape through the CLI: 'data' and 'data'+'feature' sharded
+        solves reproduce the single-device solution."""
+        train, valid, tmp = glm_fixture
+        common = {
+            "train_input": [train],
+            "optimizer": "TRON",
+            "reg_weights": [1.0],
+            "max_iters": 60,
+            "tolerance": 1e-12,
+        }
+        local = run_glm_training(
+            {**common, "output_dir": str(tmp / "mlocal")}
+        )
+        data_sharded = run_glm_training(
+            {
+                **common,
+                "output_dir": str(tmp / "mdata"),
+                "mesh_shape": {"data": 4},
+            }
+        )
+        feat_sharded = run_glm_training(
+            {
+                **common,
+                "output_dir": str(tmp / "mfeat"),
+                "mesh_shape": {"data": 2, "feature": 4},
+            }
+        )
+        w = np.asarray(local.models[0].model.coefficients.means)
+        np.testing.assert_allclose(
+            np.asarray(data_sharded.models[0].model.coefficients.means),
+            w,
+            atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(feat_sharded.models[0].model.coefficients.means),
+            w,
+            atol=1e-8,
+        )
+
+    def test_mesh_shape_validation(self, rng, glm_fixture):
+        train, _, tmp = glm_fixture
+        with pytest.raises(ValueError, match="axes must be"):
+            run_glm_training(
+                {
+                    "train_input": [train],
+                    "output_dir": str(tmp / "mbad"),
+                    "mesh_shape": {"entity": 2},
+                }
+            )
