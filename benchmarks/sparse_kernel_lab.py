@@ -1,0 +1,316 @@
+"""Device lab for the sparse ELL hot ops (matvec gather, rmatvec scatter).
+
+Round-2 bench measured XLA's scatter/gather at ~130M elem/s on the
+200k x 120k (nnz 32/row) shape — 49-53 ms per 6.4M-element pass, which
+dominates the sparse solve. This script races candidate implementations on
+the real chip so the production kernel choice in ops/sparse.py is
+measurement-driven, not guessed:
+
+  A. XLA gather / scatter-add (current production path, the baseline)
+  B. Pallas kernel with the gather table resident in VMEM (tests whether
+     Mosaic's dynamic-gather lowering beats XLA's HBM gather)
+  C. One-hot MXU kernel over column-sorted entries (gather/reduce become
+     block-local one-hot matmuls — no scatter instruction at all)
+  D. Hybrid: dense slab for hot columns (MXU matmul) + XLA scatter for the
+     cold tail (power-law feature data makes the dense slab cover most nnz)
+
+Usage: python benchmarks/sparse_kernel_lab.py [n] [k] [d]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def make_data(n, k, d, seed=0):
+    """Zipf-distributed column ids (power-law features, like CTR data)."""
+    rng = np.random.default_rng(seed)
+    # Zipf exponent ~1.1 truncated to d columns.
+    ranks = rng.zipf(1.1, size=(n, k)).astype(np.int64)
+    cols = (ranks - 1) % d
+    vals = rng.standard_normal((n, k)).astype(np.float32)
+    return cols.astype(np.int32), vals
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    d = int(sys.argv[3]) if len(sys.argv) > 3 else 120_000
+    nnz = n * k
+    print(f"n={n} k={k} d={d} nnz={nnz / 1e6:.1f}M backend={jax.default_backend()}")
+
+    cols_np, vals_np = make_data(n, k, d)
+    cols = jnp.asarray(cols_np)
+    vals = jnp.asarray(vals_np)
+    w = jnp.asarray(np.random.default_rng(1).standard_normal(d).astype(np.float32))
+    a = jnp.asarray(np.random.default_rng(2).standard_normal(n).astype(np.float32))
+
+    # ---- A. XLA baselines ---------------------------------------------------
+    @jax.jit
+    def xla_matvec(cols, vals, w):
+        return jnp.sum(vals * w.at[cols].get(mode="fill", fill_value=0.0), axis=-1)
+
+    @jax.jit
+    def xla_rmatvec(cols, vals, a):
+        upd = (vals * a[:, None]).reshape(-1)
+        return jnp.zeros((d,), jnp.float32).at[cols.reshape(-1)].add(upd, mode="drop")
+
+    t, z_ref = timeit(xla_matvec, cols, vals, w)
+    print(f"A1 XLA gather-matvec:   {t * 1e3:8.2f} ms  ({nnz / t / 1e6:7.0f} M elem/s)")
+    t, g_ref = timeit(xla_rmatvec, cols, vals, a)
+    print(f"A2 XLA scatter-rmatvec: {t * 1e3:8.2f} ms  ({nnz / t / 1e6:7.0f} M elem/s)")
+
+    # ---- B. Pallas VMEM-resident gather ------------------------------------
+    if HAVE_PALLAS:
+        d_pad = ((d + 127) // 128) * 128
+        w_pad = jnp.pad(w, (0, d_pad - d))
+        TR = 1024  # rows per tile
+
+        def gather_kernel(cols_ref, w_ref, out_ref):
+            idx = cols_ref[:]
+            tbl = w_ref[:]
+            out_ref[:] = jnp.take(tbl, idx, axis=0, fill_value=0.0)
+
+        @jax.jit
+        def pallas_matvec(cols, vals, w_pad):
+            gathered = pl.pallas_call(
+                gather_kernel,
+                grid=(n // TR,),
+                in_specs=[
+                    pl.BlockSpec((TR, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                    pl.BlockSpec((d_pad,), lambda i: (0,), memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((TR, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct((n, k), jnp.float32),
+            )(cols, w_pad)
+            return jnp.sum(vals * gathered, axis=-1)
+
+        try:
+            t, z_b = timeit(pallas_matvec, cols, vals, w_pad)
+            err = float(jnp.max(jnp.abs(z_b - z_ref)))
+            print(f"B  Pallas VMEM gather:  {t * 1e3:8.2f} ms  ({nnz / t / 1e6:7.0f} M elem/s)  maxerr={err:.2e}")
+        except Exception as e:  # noqa: BLE001
+            print(f"B  Pallas VMEM gather:  FAILED  {type(e).__name__}: {str(e)[:300]}")
+
+    # ---- C. one-hot MXU over column-sorted entries --------------------------
+    # Host prep (once per dataset): sort entries by column, pad each
+    # column-block's run to a multiple of T.
+    CB = 512  # columns per block
+    T = 1024  # entries per tile
+    flat_cols = cols_np.reshape(-1)
+    flat_rows = np.repeat(np.arange(n, dtype=np.int32), k)
+    flat_vals = vals_np.reshape(-1)
+    order = np.argsort(flat_cols, kind="stable")
+    sc, sr, sv = flat_cols[order], flat_rows[order], flat_vals[order]
+    blk = sc // CB
+    nblocks = (d + CB - 1) // CB
+    counts = np.bincount(blk, minlength=nblocks)
+    padded = ((counts + T - 1) // T) * T
+    total = int(padded.sum())
+    starts = np.concatenate([[0], np.cumsum(padded)])[:-1]
+    psc = np.zeros(total, np.int32)
+    psr = np.zeros(total, np.int32)
+    psv = np.zeros(total, np.float32)
+    src_starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    for b in range(nblocks):
+        s, c = src_starts[b], counts[b]
+        psc[starts[b] : starts[b] + c] = sc[s : s + c] - b * CB
+        psr[starts[b] : starts[b] + c] = sr[s : s + c]
+        psv[starts[b] : starts[b] + c] = sv[s : s + c]
+        # padding slots: local col CB (out of block) -> masked by onehot miss
+        psc[starts[b] + c : starts[b] + padded[b]] = CB
+    ntiles = total // T
+    tile_block = np.repeat(np.arange(nblocks, dtype=np.int32), padded // T)
+    print(f"C  prep: {total / 1e6:.1f}M padded entries ({100 * (total - nnz) / nnz:.1f}% pad), {ntiles} tiles")
+
+    if HAVE_PALLAS:
+        psc_j = jnp.asarray(psc.reshape(ntiles, T))
+        psv_j = jnp.asarray(psv.reshape(ntiles, T))
+        tb_j = jnp.asarray(tile_block)
+        w_blocks = jnp.pad(w, (0, nblocks * CB - d)).reshape(nblocks, CB)
+
+        # C1: gather side (matvec's w[cols]): e = onehot(cols_local) @ w_block
+        def onehot_gather_kernel(tb_ref, cols_ref, vals_ref, wb_ref, out_ref):
+            lc = cols_ref[:].reshape(T, 1)
+            onehot = (lc == jax.lax.broadcasted_iota(jnp.int32, (T, CB), 1)).astype(jnp.float32)
+            wv = wb_ref[:].reshape(CB, 1)
+            e = jax.lax.dot_general(
+                onehot, wv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ).reshape(T)
+            out_ref[:] = vals_ref[:] * e
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(ntiles,),
+            in_specs=[
+                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, CB), lambda i, tb: (tb[i], 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
+        )
+
+        def onehot_gather_kernel2(tb_ref, cols_ref, vals_ref, wb_ref, out_ref):
+            lc = cols_ref[0].reshape(T, 1)
+            onehot = (lc == jax.lax.broadcasted_iota(jnp.int32, (T, CB), 1)).astype(jnp.float32)
+            wv = wb_ref[0].reshape(CB, 1)
+            e = jax.lax.dot_general(
+                onehot, wv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ).reshape(T)
+            out_ref[0] = vals_ref[0] * e
+
+        @jax.jit
+        def pallas_onehot_gather(tb, cols2, vals2, wb):
+            return pl.pallas_call(
+                onehot_gather_kernel2,
+                grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((ntiles, T), jnp.float32),
+            )(tb, cols2, vals2, wb)
+
+        try:
+            t, e_c = timeit(pallas_onehot_gather, tb_j, psc_j, psv_j, w_blocks)
+            # verify: scatter e_c by row to z and compare
+            z_c = (
+                jnp.zeros((n,), jnp.float32)
+                .at[jnp.asarray(psr)]
+                .add(e_c.reshape(-1))
+            )
+            err = float(jnp.max(jnp.abs(z_c - z_ref)))
+            print(f"C1 onehot MXU gather:   {t * 1e3:8.2f} ms  ({total / t / 1e6:7.0f} M elem/s)  maxerr={err:.2e}")
+        except Exception as e:  # noqa: BLE001
+            print(f"C1 onehot MXU gather:   FAILED  {type(e).__name__}: {str(e)[:300]}")
+
+        # C2: scatter side (rmatvec's reduce-by-col): G_block += onehot^T @ upd
+        def onehot_scatter_kernel(tb_ref, cols_ref, upd_ref, out_ref):
+            i = pl.program_id(0)
+            first = i == 0
+            lc = cols_ref[0].reshape(T, 1)
+            onehot = (lc == jax.lax.broadcasted_iota(jnp.int32, (T, CB), 1)).astype(jnp.float32)
+            contrib = jax.lax.dot_general(
+                onehot,
+                upd_ref[0].reshape(T, 1),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).reshape(1, CB)
+
+            @pl.when(first)
+            def _():
+                out_ref[...] = jnp.zeros_like(out_ref)
+
+            out_ref[0] += contrib[0]
+
+        grid_spec2 = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(ntiles,),
+            in_specs=[
+                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, CB), lambda i, tb: (tb[i], 0), memory_space=pltpu.VMEM),
+        )
+
+        @jax.jit
+        def pallas_onehot_scatter(tb, cols2, upd2):
+            return pl.pallas_call(
+                onehot_scatter_kernel,
+                grid_spec=grid_spec2,
+                out_shape=jax.ShapeDtypeStruct((nblocks, CB), jnp.float32),
+            )(tb, cols2, upd2)
+
+        # upd in column-sorted order needs a[rows_sorted]: time the XLA gather
+        # for it separately (it is the remaining hard op for rmatvec).
+        psr_j = jnp.asarray(psr.reshape(ntiles, T))
+
+        @jax.jit
+        def a_gather(a, psr2, psv2):
+            return psv2 * a.at[psr2].get(mode="fill", fill_value=0.0)
+
+        try:
+            t_g, upd2 = timeit(a_gather, a, psr_j, psv_j)
+            t, gb = timeit(pallas_onehot_scatter, tb_j, psc_j, upd2)
+            g_c = gb.reshape(-1)[:d]
+            err = float(jnp.max(jnp.abs(g_c - g_ref)))
+            print(f"C2 onehot MXU scatter:  {t * 1e3:8.2f} ms  (+{t_g * 1e3:.2f} ms a-gather)  maxerr={err:.2e}")
+        except Exception as e:  # noqa: BLE001
+            print(f"C2 onehot MXU scatter:  FAILED  {type(e).__name__}: {str(e)[:300]}")
+
+    # ---- D. hybrid dense-hot + sparse-cold ----------------------------------
+    col_counts = np.bincount(cols_np.reshape(-1), minlength=d)
+    for H in (1024, 4096):
+        hot = np.argsort(-col_counts)[:H]
+        hot_set = np.zeros(d, bool)
+        hot_set[hot] = True
+        frac = col_counts[hot].sum() / nnz
+        # dense slab: n x H
+        hot_rank = np.full(d, -1, np.int64)
+        hot_rank[hot] = np.arange(H)
+        dense = np.zeros((n, H), np.float32)
+        fr = np.repeat(np.arange(n), k)
+        fc = cols_np.reshape(-1)
+        fv = vals_np.reshape(-1)
+        m = hot_set[fc]
+        dense[fr[m], hot_rank[fc[m]]] += fv[m]
+        # cold tail as ELL with smaller k
+        cold_counts = np.bincount(fr[~m], minlength=n)
+        kc = max(int(cold_counts.max()), 1)
+        cold_idx = np.full((n, kc), d, np.int32)
+        cold_val = np.zeros((n, kc), np.float32)
+        slot = np.zeros(n, np.int64)
+        for r, c, v in zip(fr[~m], fc[~m], fv[~m]):
+            cold_idx[r, slot[r]] = c
+            cold_val[r, slot[r]] = v
+            slot[r] += 1
+        print(f"D  H={H}: dense covers {100 * frac:.1f}% nnz, cold k={kc}, slab {n * H * 4 / 1e9:.2f} GB")
+        dj = jnp.asarray(dense)
+        hj = jnp.asarray(hot.astype(np.int32))
+        cij = jnp.asarray(cold_idx)
+        cvj = jnp.asarray(cold_val)
+
+        @jax.jit
+        def hyb_matvec(dj, hj, cij, cvj, w):
+            wh = w[hj]
+            z = dj @ wh
+            return z + jnp.sum(cvj * w.at[cij].get(mode="fill", fill_value=0.0), axis=-1)
+
+        @jax.jit
+        def hyb_rmatvec(dj, hj, cij, cvj, a):
+            gh = a @ dj
+            g = jnp.zeros((d,), jnp.float32).at[hj].add(gh)
+            upd = (cvj * a[:, None]).reshape(-1)
+            return g.at[cij.reshape(-1)].add(upd, mode="drop")
+
+        t, z_d = timeit(hyb_matvec, dj, hj, cij, cvj, w)
+        err = float(jnp.max(jnp.abs(z_d - z_ref)))
+        print(f"D1 hybrid matvec H={H}:  {t * 1e3:8.2f} ms  maxerr={err:.2e}")
+        t, g_d = timeit(hyb_rmatvec, dj, hj, cij, cvj, a)
+        err = float(jnp.max(jnp.abs(g_d - g_ref)))
+        print(f"D2 hybrid rmatvec H={H}: {t * 1e3:8.2f} ms  maxerr={err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,7 +58,7 @@ from photon_ml_tpu.game.projected import (
 )
 from photon_ml_tpu.game.projectors import build_random_projection
 from photon_ml_tpu.game.scoring import score_game_data
-from photon_ml_tpu.io.ingest import game_data_from_avro
+
 from photon_ml_tpu.io.models import save_game_model
 from photon_ml_tpu.io.vocab import FeatureVocabulary
 from photon_ml_tpu.models.training import OptimizerType
@@ -265,14 +265,13 @@ def run_game_training(params) -> GameTrainingRun:
 
     # ---- prepare feature maps + dataset ---------------------------------
     with timed(logger, "prepare data"):
-        from photon_ml_tpu.io.ingest import normalize_field_names
+        from photon_ml_tpu.io.ingest import IngestSource
 
         date_range = resolve_date_range(params)
-        records = normalize_field_names(
-            read_records(expand_date_paths(params.train_input, date_range)),
+        source = IngestSource(
+            expand_date_paths(params.train_input, date_range),
             params.field_names,
         )
-        logger.info(f"read {len(records)} training records")
 
         shard_ids = {
             spec.shard for spec in params.coordinates.values()
@@ -287,8 +286,8 @@ def run_game_training(params) -> GameTrainingRun:
             else:
                 fallback_shards.append(shard)
                 if fallback_vocab is None:
-                    fallback_vocab = FeatureVocabulary.from_records(
-                        records, add_intercept=params.add_intercept
+                    fallback_vocab = source.build_vocab(
+                        add_intercept=params.add_intercept
                     )
                 shard_vocabs[shard] = fallback_vocab
         if len(fallback_shards) > 1:
@@ -307,9 +306,10 @@ def run_game_training(params) -> GameTrainingRun:
                 if spec.random_effect is not None
             }
         )
-        data, entity_vocabs, _uids = game_data_from_avro(
-            records, shard_vocabs, entity_keys
+        data, entity_vocabs, _uids, _present = source.game_data(
+            shard_vocabs, entity_keys
         )
+        logger.info(f"read {len(data.labels)} training records")
         entity_counts = {k: len(v) for k, v in entity_vocabs.items()}
         logger.info(
             f"shards: { {s: len(v) for s, v in shard_vocabs.items()} } "
@@ -318,16 +318,13 @@ def run_game_training(params) -> GameTrainingRun:
 
         vdata = None
         if params.validate_input:
-            vrecords = normalize_field_names(
-                read_records(
-                    expand_date_paths(params.validate_input, date_range)
-                ),
+            vdata, _, _, _ = IngestSource(
+                expand_date_paths(params.validate_input, date_range),
                 params.field_names,
+            ).game_data(
+                shard_vocabs, entity_keys, entity_vocabs=entity_vocabs
             )
-            vdata, _, _ = game_data_from_avro(
-                vrecords, shard_vocabs, entity_keys, entity_vocabs=entity_vocabs
-            )
-            logger.info(f"read {len(vrecords)} validation records")
+            logger.info(f"read {len(vdata.labels)} validation records")
 
     # ---- grid sweep ------------------------------------------------------
     shards_by_coord = {

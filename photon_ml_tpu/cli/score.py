@@ -24,13 +24,11 @@ import numpy as np
 from photon_ml_tpu.cli.config import ScoringParams, load_params
 from photon_ml_tpu.cli.train import (
     prepare_output_dir,
-    read_records,
     resolve_date_range,
 )
 from photon_ml_tpu.core.tasks import TaskType
 from photon_ml_tpu.game.scoring import score_game_data
 from photon_ml_tpu.io.avro import write_avro_file
-from photon_ml_tpu.io.ingest import game_data_from_avro, labeled_batch_from_avro
 from photon_ml_tpu.io.models import load_game_model, load_glm_model
 from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
 from photon_ml_tpu.io.vocab import FeatureVocabulary
@@ -99,13 +97,12 @@ def run_scoring(params) -> ScoringRun:
     )
     task = TaskType[params.task]
     date_range = resolve_date_range(params)
-    from photon_ml_tpu.io.ingest import normalize_field_names
+    from photon_ml_tpu.io.ingest import IngestSource
 
-    records = normalize_field_names(
-        read_records(expand_date_paths(params.input, date_range)),
-        params.field_names,
+    source = IngestSource(
+        expand_date_paths(params.input, date_range), params.field_names
     )
-    logger.info(f"scoring {len(records)} records with {params.model_kind} "
+    logger.info(f"scoring records with {params.model_kind} "
                 f"model from {params.model_dir}")
 
     with timed(logger, "score"):
@@ -142,8 +139,8 @@ def run_scoring(params) -> ScoringRun:
             coefficients, model_task = load_glm_model(model_path, vocab)
             if model_task is not None:
                 task = model_task
-            batch = labeled_batch_from_avro(
-                records, vocab, sparse=params.sparse, dtype=jnp.float64,
+            batch, uids, label_present = source.labeled_batch(
+                vocab, sparse=params.sparse, dtype=jnp.float64,
                 allow_null_labels=True,
             )
             from photon_ml_tpu.ops.sparse import matvec
@@ -154,7 +151,6 @@ def run_scoring(params) -> ScoringRun:
             )
             labels = np.asarray(batch.labels)
             weights = np.asarray(batch.effective_weights())
-            uids = np.asarray([r.get("uid") for r in records], object)
         else:
             # GAME directory layout; shard vocabs saved next to the model
             model_root, vocab_root = _resolve_game_dirs(params.model_dir)
@@ -231,8 +227,7 @@ def run_scoring(params) -> ScoringRun:
                     model_params[name] = remap_entity_rows(
                         p, own, shared
                     )
-            data, _, uids = game_data_from_avro(
-                records,
+            data, _, uids, label_present = source.game_data(
                 shard_vocabs,
                 entity_keys,
                 entity_vocabs=re_vocabs,
@@ -250,9 +245,6 @@ def run_scoring(params) -> ScoringRun:
     # ---- write ScoredItems (``ScoredItem.scala`` / scoring Driver) -------
     out_path = os.path.join(params.output_dir, "scores", "part-00000.avro")
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    label_present = np.asarray(
-        [r.get("label") is not None for r in records], bool
-    )
     has_labels = bool(label_present.any())
     score_records = [
         {
@@ -277,7 +269,7 @@ def run_scoring(params) -> ScoringRun:
             # the evaluation arrays entirely (this is a host-side metric
             # pass, so the dynamic shape is fine)
             logger.warn(
-                f"{int((~label_present).sum())} of {len(records)} records "
+                f"{int((~label_present).sum())} of {len(label_present)} records "
                 "have no label; excluding them from evaluation"
             )
             ev_labels = labels[label_present]

@@ -27,7 +27,6 @@ from photon_ml_tpu.core.tasks import TaskType
 from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.core.validators import DataValidationType, sanity_check_data
 from photon_ml_tpu.io.avro import read_avro_dir, read_avro_file
-from photon_ml_tpu.io.ingest import labeled_batch_from_avro
 from photon_ml_tpu.io.models import save_glm_model
 from photon_ml_tpu.io.vocab import FeatureVocabulary
 from photon_ml_tpu.models.selection import select_best_model
@@ -147,28 +146,24 @@ def run_glm_training(params) -> GLMTrainingRun:
 
     # ---- PREPROCESS ------------------------------------------------------
     with timed(logger, "preprocess"):
-        from photon_ml_tpu.io.ingest import normalize_field_names
+        from photon_ml_tpu.io.ingest import IngestSource
 
         date_range = resolve_date_range(params)
         train_paths = expand_date_paths(params.train_input, date_range)
-        records = normalize_field_names(
-            read_records(train_paths), params.field_names
-        )
-        logger.info(f"read {len(records)} training records")
+        source = IngestSource(train_paths, params.field_names)
 
         if params.feature_file:
             vocab = FeatureVocabulary.load(params.feature_file)
         else:
-            vocab = FeatureVocabulary.from_records(
-                records, add_intercept=params.add_intercept
-            )
+            vocab = source.build_vocab(add_intercept=params.add_intercept)
         logger.info(f"feature space: {len(vocab)} columns "
                     f"(intercept={vocab.intercept_index})")
 
-        batch = labeled_batch_from_avro(
-            records, vocab, sparse=params.sparse,
+        batch, _uids, _present = source.labeled_batch(
+            vocab, sparse=params.sparse,
             dtype=driver_dtype(params.precision),
         )
+        logger.info(f"read {batch.labels.shape[0]} training records")
         task = TaskType[params.task]
         sanity_check_data(
             batch, task, DataValidationType[params.data_validation]
@@ -234,9 +229,8 @@ def run_glm_training(params) -> GLMTrainingRun:
             logger.info(f"warm-starting from {init_path}")
         if params.mesh_shape:
             # mesh-sharded solve: 'data' row-shards (GSPMD psum), adding
-            # 'feature' also shards the coefficient axis (huge-d regime)
-            import jax
-
+            # 'feature' also shards the coefficient axis (huge-d regime);
+            # device-count validation lives in the mesh constructors
             from photon_ml_tpu.parallel import (
                 distributed_train_glm,
                 feature_sharded_train_glm,
@@ -246,11 +240,6 @@ def run_glm_training(params) -> GLMTrainingRun:
 
             n_data = params.mesh_shape.get("data", 1)
             n_feat = params.mesh_shape.get("feature", 1)
-            if n_data * n_feat > len(jax.devices()):
-                raise ValueError(
-                    f"mesh {params.mesh_shape} needs {n_data * n_feat} "
-                    f"devices, have {len(jax.devices())}"
-                )
             logger.info(f"mesh solve over {params.mesh_shape}")
             if n_feat > 1:
                 models = list(
@@ -288,14 +277,11 @@ def run_glm_training(params) -> GLMTrainingRun:
     if params.validate_input:
         tracker.assert_at_least(DriverStage.TRAINED)
         with timed(logger, "validate"):
-            vrecords = normalize_field_names(
-                read_records(
-                    expand_date_paths(params.validate_input, date_range)
-                ),
+            vbatch, _vuids, _vpresent = IngestSource(
+                expand_date_paths(params.validate_input, date_range),
                 params.field_names,
-            )
-            vbatch = labeled_batch_from_avro(
-                vrecords, vocab, sparse=params.sparse,
+            ).labeled_batch(
+                vocab, sparse=params.sparse,
                 dtype=driver_dtype(params.precision),
             )
             for tm in models:
@@ -430,7 +416,7 @@ def run_glm_training(params) -> GLMTrainingRun:
         best=best,
         best_index=best_index,
         validation_metrics=validation_metrics,
-        num_training_rows=len(records),
+        num_training_rows=int(batch.labels.shape[0]),
         num_features=len(vocab),
         summary=summary,
     )

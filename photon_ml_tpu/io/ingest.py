@@ -165,6 +165,37 @@ def training_examples_to_sparse(
     return features, columns
 
 
+def index_entity_strings(
+    raw_entities: Dict[str, np.ndarray],
+    entity_vocabs: Optional[Dict[str, dict]] = None,
+) -> Tuple[Dict[str, np.ndarray], Dict[str, dict]]:
+    """Per-row entity strings -> int32 index columns + vocabularies.
+
+    "" means the row does not carry the key (index -1). When
+    ``entity_vocabs`` provides a key's vocabulary (scoring against a
+    trained model) it is applied; otherwise one is built from the rows
+    that carry the key (training)."""
+    from photon_ml_tpu.game.data import (
+        apply_entity_vocabulary,
+        build_entity_vocabulary,
+    )
+
+    entity_ids: Dict[str, np.ndarray] = {}
+    out_vocabs: Dict[str, dict] = {}
+    for k, raw in raw_entities.items():
+        known = np.asarray([r != "" for r in raw])
+        if entity_vocabs is not None and k in entity_vocabs:
+            vocab_k = dict(entity_vocabs[k])
+            idx = apply_entity_vocabulary(vocab_k, raw)
+        else:
+            vocab_k, _ = build_entity_vocabulary(raw[known])
+            idx = apply_entity_vocabulary(vocab_k, raw)
+        idx = np.where(known, idx, -1).astype(np.int32)
+        entity_ids[k] = idx
+        out_vocabs[k] = vocab_k
+    return entity_ids, out_vocabs
+
+
 def game_data_from_avro(
     records: List[dict],
     shard_vocabs: Dict[str, "FeatureVocabulary"],
@@ -215,26 +246,10 @@ def game_data_from_avro(
         if vocab.intercept_index is not None:
             features[shard][:, vocab.intercept_index] = 1.0
 
-    from photon_ml_tpu.game.data import (
-        apply_entity_vocabulary,
-        build_entity_vocabulary,
+    entity_ids, out_vocabs = index_entity_strings(
+        {k: np.asarray(v, object) for k, v in raw_entities.items()},
+        entity_vocabs,
     )
-
-    entity_ids: Dict[str, np.ndarray] = {}
-    out_vocabs: Dict[str, dict] = {}
-    for k in entity_keys:
-        raw = np.asarray(raw_entities[k], object)
-        known = np.asarray([r != "" for r in raw_entities[k]])
-        if entity_vocabs is not None and k in entity_vocabs:
-            vocab_k = dict(entity_vocabs[k])
-            idx = apply_entity_vocabulary(vocab_k, raw)
-        else:
-            # build only from rows that actually carry the key
-            vocab_k, _ = build_entity_vocabulary(raw[known])
-            idx = apply_entity_vocabulary(vocab_k, raw)
-        idx = np.where(known, idx, -1).astype(np.int32)
-        entity_ids[k] = idx
-        out_vocabs[k] = vocab_k
 
     data = GameData.create(
         features=features,
@@ -279,6 +294,244 @@ def labeled_batch_from_avro(
         weights=cols["weights"],
         dtype=dtype or jnp.float32,
     )
+
+
+class IngestSource:
+    """Avro input files -> vocabulary / LabeledBatch / GameData, using the
+    native C++ decoder (:mod:`photon_ml_tpu.io.native`) when it is
+    available and the writer schema is in its supported family, with
+    transparent fallback to the pure-Python codec.
+
+    The native path runs one streaming decode pass per artifact and never
+    materializes Python record dicts; the fallback decodes records once
+    and caches them. Drivers construct one source per input set (the
+    executor-side parse of ``avro/AvroIOUtils.scala:46-139`` /
+    ``GLMSuite.scala:96-353`` collapses into this object).
+    """
+
+    def __init__(self, paths, field_names: str = TRAINING_EXAMPLE_FIELDS):
+        import os
+
+        if isinstance(paths, str):
+            paths = [paths]
+        files: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                part = sorted(
+                    os.path.join(p, f)
+                    for f in os.listdir(p)
+                    if f.endswith(".avro")
+                )
+                if not part:
+                    raise FileNotFoundError(f"no .avro files under {p}")
+                files.extend(part)
+            else:
+                files.append(p)
+        if not files:
+            raise FileNotFoundError(f"no input files in {paths!r}")
+        self.files = files
+        self.field_names = field_names
+        self._records: Optional[List[dict]] = None
+
+    # -- shared -------------------------------------------------------------
+
+    @property
+    def label_field(self) -> str:
+        return (
+            "response"
+            if self.field_names == RESPONSE_PREDICTION_FIELDS
+            else "label"
+        )
+
+    def _native(self):
+        try:
+            from photon_ml_tpu.io import native
+
+            return native if native.native_available() else None
+        except Exception:  # noqa: BLE001 — any failure means fallback
+            return None
+
+    def _check_nonempty(self, n: int):
+        """Valid-but-empty inputs fail loudly here rather than training a
+        degenerate model (the old read_records guard)."""
+        if n == 0:
+            raise ValueError(f"no records found in {self.files}")
+
+    def records(self) -> List[dict]:
+        """Python-codec records (fallback path, cached)."""
+        if self._records is None:
+            from photon_ml_tpu.io.avro import read_avro_file
+
+            recs: List[dict] = []
+            for f in self.files:
+                _, r = read_avro_file(f)
+                recs.extend(r)
+            self._check_nonempty(len(recs))
+            self._records = normalize_field_names(recs, self.field_names)
+        return self._records
+
+    def _read_native(self, vocabs, entity_keys, allow_null_labels):
+        native = self._native()
+        if native is None:
+            return None
+        try:
+            return native.read_columnar(
+                self.files,
+                vocabs,
+                entity_keys,
+                label_field=self.label_field,
+                allow_null_labels=allow_null_labels,
+            )
+        except native.UnsupportedSchema:
+            return None
+
+    def _native_nonempty(self, out):
+        if out is not None:
+            self._check_nonempty(out["n"])
+        return out
+
+    # -- artifacts ----------------------------------------------------------
+
+    def build_vocab(
+        self,
+        add_intercept: bool = True,
+        selected_keys: Optional[set] = None,
+    ) -> FeatureVocabulary:
+        """Distinct (name, term) scan (``FeatureIndexingJob`` analog)."""
+        native = self._native()
+        if native is not None:
+            try:
+                keys = native.scan_feature_keys(
+                    self.files, label_field=self.label_field
+                )
+                if selected_keys is not None:
+                    keys = [k for k in keys if k in selected_keys]
+                return FeatureVocabulary(
+                    sorted(keys), add_intercept=add_intercept
+                )
+            except native.UnsupportedSchema:
+                pass
+        return FeatureVocabulary.from_records(
+            self.records(),
+            add_intercept=add_intercept,
+            selected_keys=selected_keys,
+        )
+
+    def labeled_batch(
+        self,
+        vocab: FeatureVocabulary,
+        dtype=None,
+        sparse: bool = False,
+        nnz_per_row: int = 0,
+        allow_null_labels: bool = False,
+    ):
+        """-> (LabeledBatch, uids, label_present)."""
+        import jax.numpy as jnp
+
+        out = self._native_nonempty(
+            self._read_native([vocab], (), allow_null_labels)
+        )
+        if out is None:
+            recs = self.records()
+            batch = labeled_batch_from_avro(
+                recs,
+                vocab,
+                dtype=dtype,
+                sparse=sparse,
+                nnz_per_row=nnz_per_row,
+                allow_null_labels=allow_null_labels,
+            )
+            uids = np.asarray([r.get("uid") for r in recs], object)
+            present = np.asarray(
+                [r.get("label") is not None for r in recs], bool
+            )
+            return batch, uids, present
+        n = out["n"]
+        rows, cols, vals = out["coo"][0]
+        icpt = vocab.intercept_index
+        if icpt is not None:
+            rows = np.concatenate([rows, np.arange(n, dtype=rows.dtype)])
+            cols = np.concatenate(
+                [cols, np.full(n, icpt, dtype=cols.dtype)]
+            )
+            vals = np.concatenate([vals, np.ones(n)])
+        if sparse:
+            from photon_ml_tpu.ops.sparse import from_coo
+
+            features = from_coo(
+                rows, cols, vals, n, len(vocab),
+                nnz_per_row=nnz_per_row, dtype=dtype or jnp.float32,
+            )
+        else:
+            features = np.zeros((n, len(vocab)), np.float64)
+            np.add.at(
+                features,
+                (rows.astype(np.int64), cols.astype(np.int64)),
+                vals,
+            )
+        batch = LabeledBatch.create(
+            features,
+            out["labels"],
+            offsets=out["offsets"],
+            weights=out["weights"],
+            dtype=dtype or jnp.float32,
+        )
+        return batch, out["uids"], out["label_present"]
+
+    def game_data(
+        self,
+        shard_vocabs: Dict[str, FeatureVocabulary],
+        entity_keys: List[str],
+        entity_vocabs: Optional[Dict[str, dict]] = None,
+        allow_null_labels: bool = False,
+    ):
+        """-> (GameData, entity_vocabs, uids, label_present)."""
+        shards = list(shard_vocabs)
+        out = self._native_nonempty(
+            self._read_native(
+                [shard_vocabs[s] for s in shards],
+                tuple(entity_keys),
+                allow_null_labels,
+            )
+        )
+        if out is None:
+            recs = self.records()
+            data, vocabs, uids = game_data_from_avro(
+                recs,
+                shard_vocabs,
+                entity_keys,
+                entity_vocabs=entity_vocabs,
+                allow_null_labels=allow_null_labels,
+            )
+            present = np.asarray(
+                [r.get("label") is not None for r in recs], bool
+            )
+            return data, vocabs, uids, present
+        from photon_ml_tpu.game.data import GameData
+
+        n = out["n"]
+        features = {}
+        for si, shard in enumerate(shards):
+            vocab = shard_vocabs[shard]
+            rows, cols, vals = out["coo"][si]
+            x = np.zeros((n, len(vocab)), np.float64)
+            np.add.at(
+                x, (rows.astype(np.int64), cols.astype(np.int64)), vals
+            )
+            if vocab.intercept_index is not None:
+                x[:, vocab.intercept_index] = 1.0
+            features[shard] = x
+        entity_ids, out_vocabs = index_entity_strings(
+            {k: out["entities"][k] for k in entity_keys}, entity_vocabs
+        )
+        data = GameData.create(
+            features=features,
+            labels=out["labels"],
+            offsets=out["offsets"],
+            weights=out["weights"],
+            entity_ids=entity_ids,
+        )
+        return data, out_vocabs, out["uids"], out["label_present"]
 
 
 def make_training_example(

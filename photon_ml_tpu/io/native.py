@@ -1,0 +1,595 @@
+"""Native (C++) Avro -> columnar ingest fast path.
+
+The reference decodes Avro on a fleet of JVM executors
+(``avro/AvroIOUtils.scala:46-139``); here a single host feeds the TPU, so
+ingest throughput is the analog of SURVEY §7 hard-part 6. The pure-Python
+codec (:mod:`photon_ml_tpu.io.avro`) interprets the schema per value; this
+module compiles the schema once into a flat opcode program and hands whole
+container blocks to ``native/avro_reader.cpp`` which decodes records,
+performs the vocabulary join ((name, term) -> column id, the
+``GLMSuite.scala:348-352`` per-partition IndexMap lookup) and accumulates
+columnar outputs natively. Python only sees numpy arrays.
+
+The shared library builds on first use with ``g++`` (no pybind11 in the
+image — plain C ABI + ctypes); if the toolchain or zlib is missing every
+entry point reports unavailable and callers fall back to the Python codec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+import json
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.io.avro import MAGIC, _decode_bytes, _decode_long
+
+# ---------------------------------------------------------------------------
+# opcode constants (must mirror native/avro_reader.cpp)
+# ---------------------------------------------------------------------------
+
+OP_SCALAR_COL = 1
+OP_UID = 2
+OP_FEATURES = 3
+OP_METADATA = 4
+OP_SKIP = 5
+OPTIONAL_BIT = 1 << 8
+NULL_SECOND_BIT = 1 << 9
+
+W_NULL = 0
+W_BOOLEAN = 1
+W_INT = 2
+W_LONG = 3
+W_FLOAT = 4
+W_DOUBLE = 5
+W_STRING = 6
+W_BYTES = 7
+W_FEATURE_ARRAY = 8
+W_STRING_MAP = 9
+
+_PRIM_WIRE = {
+    "null": W_NULL,
+    "boolean": W_BOOLEAN,
+    "int": W_INT,
+    "long": W_LONG,
+    "float": W_FLOAT,
+    "double": W_DOUBLE,
+    "string": W_STRING,
+    "bytes": W_BYTES,
+}
+
+# scalar column slots (fixed layout, see ingest wrappers below)
+COL_LABEL, COL_OFFSET, COL_WEIGHT = 0, 1, 2
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native",
+                    "avro_reader.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "_build", "libpml_avro.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+
+def _build_and_load() -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                _SRC, "-o", _SO, "-lz",
+            ]
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=300
+            )
+            if proc.returncode != 0:
+                return None, f"native build failed: {proc.stderr[-2000:]}"
+        lib = ctypes.CDLL(_SO)
+        lib.pml_reader_new.restype = ctypes.c_void_p
+        lib.pml_reader_new.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.pml_reader_keys_bytes.restype = ctypes.c_int64
+        lib.pml_reader_keys_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.pml_reader_keys.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p
+        ]
+        lib.pml_reader_feed.restype = ctypes.c_int64
+        lib.pml_reader_feed.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32,
+        ]
+        lib.pml_reader_nrecords.restype = ctypes.c_int64
+        lib.pml_reader_nrecords.argtypes = [ctypes.c_void_p]
+        lib.pml_reader_sizes.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)
+        ]
+        lib.pml_reader_scalar.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.pml_reader_strings.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+        ]
+        lib.pml_reader_coo.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.pml_reader_error.restype = ctypes.c_char_p
+        lib.pml_reader_error.argtypes = [ctypes.c_void_p]
+        lib.pml_reader_free.argtypes = [ctypes.c_void_p]
+        return lib, None
+    except Exception as e:  # noqa: BLE001 — any failure means "unavailable"
+        return None, f"{type(e).__name__}: {e}"
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_error
+    with _lib_lock:
+        if _lib is None and _lib_error is None:
+            _lib, _lib_error = _build_and_load()
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def native_error() -> Optional[str]:
+    get_lib()
+    return _lib_error
+
+
+# ---------------------------------------------------------------------------
+# schema -> opcode program
+# ---------------------------------------------------------------------------
+
+
+class UnsupportedSchema(ValueError):
+    """Raised when the native path cannot handle a schema; callers fall
+    back to the Python codec."""
+
+
+def _unwrap_optional(ftype):
+    """[null, X] / [X, null] -> (X, optional?, null_second?)."""
+    if isinstance(ftype, list):
+        if len(ftype) == 2 and "null" in ftype:
+            null_second = ftype[1] == "null"
+            inner = ftype[0] if null_second else ftype[1]
+            return inner, True, null_second
+        raise UnsupportedSchema(f"unsupported union {ftype!r}")
+    return ftype, False, False
+
+
+def _wire_of(ftype) -> int:
+    if isinstance(ftype, str):
+        if ftype in _PRIM_WIRE:
+            return _PRIM_WIRE[ftype]
+        raise UnsupportedSchema(f"named-type reference {ftype!r}")
+    if isinstance(ftype, dict):
+        t = ftype.get("type")
+        if t in _PRIM_WIRE:
+            return _PRIM_WIRE[t]
+        if t == "map" and ftype.get("values") == "string":
+            return W_STRING_MAP
+    raise UnsupportedSchema(f"unsupported field type {ftype!r}")
+
+
+_SCALAR_WIRES = (W_BOOLEAN, W_INT, W_LONG, W_FLOAT, W_DOUBLE)
+
+
+def compile_schema(
+    schema: dict,
+    *,
+    label_field: str = "label",
+    want_entities: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Compile a TrainingExample-family record schema into the native
+    field program. Returns (field_prog (nfields, 3) int32, feat_desc int32).
+
+    ``label_field`` follows the active field-name set ("label" for
+    TRAINING_EXAMPLE, "response" for RESPONSE_PREDICTION,
+    ``avro/FieldNamesType.scala:20``).
+    """
+    if schema.get("type") != "record":
+        raise UnsupportedSchema("top-level schema must be a record")
+    prog: List[Tuple[int, int, int]] = []
+    feat_desc: Optional[List[int]] = None
+    for f in schema["fields"]:
+        name = f["name"]
+        ftype, optional, null_second = _unwrap_optional(f["type"])
+        bits = (OPTIONAL_BIT if optional else 0) | (
+            NULL_SECOND_BIT if null_second else 0
+        )
+        if name == label_field:
+            wire = _wire_of(ftype)
+            if wire not in _SCALAR_WIRES:
+                raise UnsupportedSchema(f"label field has wire {wire}")
+            prog.append((OP_SCALAR_COL | bits, wire, COL_LABEL))
+        elif name == "offset":
+            prog.append((OP_SCALAR_COL | bits, _wire_of(ftype), COL_OFFSET))
+        elif name == "weight":
+            prog.append((OP_SCALAR_COL | bits, _wire_of(ftype), COL_WEIGHT))
+        elif name == "uid":
+            wire = _wire_of(ftype)
+            if wire != W_STRING:
+                raise UnsupportedSchema("uid must be a string")
+            prog.append((OP_UID | bits, wire, 0))
+        elif name == "features":
+            if not (isinstance(ftype, dict) and ftype.get("type") == "array"):
+                raise UnsupportedSchema("features must be an array")
+            items = ftype["items"]
+            if not (isinstance(items, dict) and items.get("type") == "record"):
+                raise UnsupportedSchema("features items must be records")
+            fname = fterm = fvalue = -1
+            wires: List[Tuple[int, int]] = []
+            for i, ff in enumerate(items["fields"]):
+                it, iopt, insec = _unwrap_optional(ff["type"])
+                if insec:
+                    raise UnsupportedSchema(
+                        "feature-record [X, null] unions unsupported"
+                    )
+                w = _wire_of(it)
+                wires.append((w, 1 if iopt else 0))
+                if ff["name"] == "name":
+                    fname = i
+                elif ff["name"] == "term":
+                    fterm = i
+                elif ff["name"] == "value":
+                    fvalue = i
+            if fname < 0 or fvalue < 0:
+                raise UnsupportedSchema("feature record needs name+value")
+            feat_desc = [len(wires), fname, fterm, fvalue]
+            for w, o in wires:
+                feat_desc += [w, o]
+            prog.append((OP_FEATURES | bits, W_FEATURE_ARRAY, 0))
+        elif name == "metadataMap" and want_entities:
+            wire = _wire_of(ftype)
+            if wire != W_STRING_MAP:
+                raise UnsupportedSchema("metadataMap must be map<string>")
+            prog.append((OP_METADATA | bits, wire, 0))
+        else:
+            prog.append((OP_SKIP | bits, _wire_of(ftype), 0))
+    if feat_desc is None:
+        raise UnsupportedSchema("schema has no features array")
+    return (
+        np.asarray(prog, np.int32),
+        np.asarray(feat_desc, np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class NativeAvroReader:
+    """Streams Avro container files into native columnar accumulators.
+
+    vocab_keys: per vocabulary, the ordered feature keys (name\\x01term).
+    entity_keys: metadataMap keys to extract as per-row string columns.
+    """
+
+    def __init__(
+        self,
+        field_prog: np.ndarray,
+        feat_desc: np.ndarray,
+        vocab_keys: Sequence[Sequence[str]],
+        vocab_intercepts: Sequence[int],
+        entity_keys: Sequence[str] = (),
+        collect_keys: bool = False,
+    ):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native reader unavailable: {_lib_error}")
+        self._lib = lib
+        self._nvocabs = len(vocab_keys)
+        self._nentities = len(entity_keys)
+        # keys travel as one byte blob + explicit offsets, never joined by
+        # a separator byte — feature names may contain any character.
+        key_bytes = [
+            k.encode("utf-8") for keys in vocab_keys for k in keys
+        ]
+        vocab_blob = b"".join(key_bytes)
+        key_offsets = np.zeros(len(key_bytes) + 1, np.int64)
+        np.cumsum([len(b) for b in key_bytes], out=key_offsets[1:])
+        vocab_counts = np.asarray([len(k) for k in vocab_keys], np.int32)
+        intercepts = np.asarray(
+            [(-1 if i is None else i) for i in vocab_intercepts], np.int32
+        )
+        ent_bytes = [k.encode("utf-8") for k in entity_keys]
+        entity_blob = b"".join(ent_bytes)
+        entity_offsets = np.zeros(len(ent_bytes) + 1, np.int64)
+        np.cumsum([len(b) for b in ent_bytes], out=entity_offsets[1:])
+        self._handle = lib.pml_reader_new(
+            _i32p(np.ascontiguousarray(field_prog)),
+            len(field_prog),
+            _i32p(np.ascontiguousarray(feat_desc)),
+            vocab_blob,
+            _i64p(key_offsets),
+            _i32p(vocab_counts) if self._nvocabs else _i32p(np.zeros(1, np.int32)),
+            _i32p(intercepts) if self._nvocabs else _i32p(np.zeros(1, np.int32)),
+            self._nvocabs,
+            entity_blob,
+            _i64p(entity_offsets),
+            self._nentities,
+            1 if collect_keys else 0,
+        )
+        if not self._handle:
+            raise RuntimeError("pml_reader_new failed")
+        # keep buffers alive for the handle's lifetime
+        self._keepalive = (vocab_blob, entity_blob, key_offsets, entity_offsets)
+
+    def feed_file(self, path: str, expected_schema: Optional[dict] = None):
+        """Parse container framing (header, sync markers) in Python; hand
+        each block's payload to the native decoder. When
+        ``expected_schema`` is given, a file written with a different
+        schema raises :class:`UnsupportedSchema` (the caller falls back to
+        the schema-general Python codec) instead of misdecoding."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        buf = io.BytesIO(raw)
+        if buf.read(4) != MAGIC:
+            raise ValueError(f"{path} is not an Avro container file")
+        meta = {}
+        while True:
+            count = _decode_long(buf)
+            if count == 0:
+                break
+            if count < 0:
+                _decode_long(buf)
+                count = -count
+            for _ in range(count):
+                k = _decode_bytes(buf).decode("utf-8")
+                meta[k] = _decode_bytes(buf)
+        if expected_schema is not None:
+            schema = json.loads(meta["avro.schema"])
+            if schema != expected_schema:
+                raise UnsupportedSchema(
+                    f"{path} was written with a different schema than the "
+                    "compiled program"
+                )
+        codec_name = meta.get("avro.codec", b"null").decode()
+        codec = {"null": 0, "deflate": 1}.get(codec_name)
+        if codec is None:
+            raise ValueError(f"unsupported codec {codec_name!r}")
+        sync = buf.read(16)
+        size = len(raw)
+        while buf.tell() < size:
+            count = _decode_long(buf)
+            nbytes = _decode_long(buf)
+            payload = buf.read(nbytes)
+            got = self._lib.pml_reader_feed(
+                self._handle, payload, nbytes, count, codec
+            )
+            if got < 0:
+                err = self._lib.pml_reader_error(self._handle).decode()
+                raise ValueError(f"{path}: native decode failed: {err}")
+            if buf.read(16) != sync:
+                raise ValueError(f"{path}: bad sync marker (corrupt file)")
+        return json.loads(meta["avro.schema"])
+
+    # -- extraction ---------------------------------------------------------
+
+    @property
+    def num_records(self) -> int:
+        return int(self._lib.pml_reader_nrecords(self._handle))
+
+    def _sizes(self) -> np.ndarray:
+        out = np.zeros(1 + self._nentities + self._nvocabs, np.int64)
+        self._lib.pml_reader_sizes(self._handle, _i64p(out))
+        return out
+
+    def scalar(self, col: int) -> Tuple[np.ndarray, np.ndarray]:
+        n = self.num_records
+        vals = np.zeros(n, np.float64)
+        seen = np.zeros(n, np.uint8)
+        self._lib.pml_reader_scalar(self._handle, col, _f64p(vals), _u8p(seen))
+        return vals, seen.astype(bool)
+
+    def _strings(self, which: int, nbytes: int) -> np.ndarray:
+        n = self.num_records
+        offsets = np.zeros(n + 1, np.int64)
+        raw = ctypes.create_string_buffer(max(nbytes, 1))
+        self._lib.pml_reader_strings(self._handle, which, _i64p(offsets), raw)
+        blob = raw.raw[:nbytes]  # offsets are BYTE positions: slice bytes,
+        return np.asarray(       # decode per string (multi-byte UTF-8 safe)
+            [
+                blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+                for i in range(n)
+            ],
+            object,
+        )
+
+    def uids(self) -> np.ndarray:
+        nbytes = int(self._sizes()[0])
+        out = self._strings(-1, nbytes)
+        # the pool cannot distinguish null from "": treat empty as absent,
+        # matching the optional-uid semantics of ingest
+        out[out == ""] = None
+        return out
+
+    def entities(self, which: int) -> np.ndarray:
+        nbytes = int(self._sizes()[1 + which])
+        return self._strings(which, nbytes)
+
+    def distinct_keys(self) -> List[str]:
+        """Distinct feature keys seen (requires collect_keys=True) — the
+        native ``FeatureIndexingJob`` analog. Unordered; callers sort."""
+        nkeys = ctypes.c_int64(0)
+        nbytes = int(
+            self._lib.pml_reader_keys_bytes(self._handle, ctypes.byref(nkeys))
+        )
+        n = int(nkeys.value)
+        offsets = np.zeros(n + 1, np.int64)
+        raw = ctypes.create_string_buffer(max(nbytes, 1))
+        self._lib.pml_reader_keys(self._handle, _i64p(offsets), raw)
+        blob = raw.raw[:nbytes]
+        return [
+            blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+            for i in range(n)
+        ]
+
+    def coo(self, vocab: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        nnz = int(self._sizes()[1 + self._nentities + vocab])
+        rows = np.zeros(nnz, np.int32)
+        cols = np.zeros(nnz, np.int32)
+        vals = np.zeros(nnz, np.float64)
+        if nnz:
+            self._lib.pml_reader_coo(
+                self._handle, vocab, _i32p(rows), _i32p(cols), _f64p(vals)
+            )
+        return rows, cols, vals
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.pml_reader_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover — best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# high-level ingest entry points
+# ---------------------------------------------------------------------------
+
+
+def _read_header_schema(path: str) -> dict:
+    with open(path, "rb") as f:
+        head = f.read(4 * 1024 * 1024)
+    buf = io.BytesIO(head)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path} is not an Avro container file")
+    meta = {}
+    while True:
+        count = _decode_long(buf)
+        if count == 0:
+            break
+        if count < 0:
+            _decode_long(buf)
+            count = -count
+        for _ in range(count):
+            k = _decode_bytes(buf).decode("utf-8")
+            meta[k] = _decode_bytes(buf)
+    return json.loads(meta["avro.schema"])
+
+
+def scan_feature_keys(
+    paths: Sequence[str], *, label_field: str = "label"
+) -> List[str]:
+    """Native distinct-feature-key scan over Avro files — the
+    ``FeatureIndexingJob.scala:48-160`` vocabulary-building pass."""
+    if not paths:
+        raise FileNotFoundError("no input files")
+    schema = _read_header_schema(paths[0])
+    field_prog, feat_desc = compile_schema(
+        schema, label_field=label_field, want_entities=False
+    )
+    reader = NativeAvroReader(
+        field_prog, feat_desc, [], [], (), collect_keys=True
+    )
+    try:
+        for p in paths:
+            reader.feed_file(p, expected_schema=schema)
+        return reader.distinct_keys()
+    finally:
+        reader.close()
+
+
+def read_columnar(
+    paths: Sequence[str],
+    vocabs: Sequence,
+    entity_keys: Sequence[str] = (),
+    *,
+    label_field: str = "label",
+    allow_null_labels: bool = False,
+) -> Dict[str, object]:
+    """Read Avro files into columnar arrays with native decode + vocab join.
+
+    vocabs: FeatureVocabulary objects (ordered keys + intercept index).
+    Returns {labels, offsets, weights, uids, entities: {key: str array},
+    coo: [(rows, cols, vals), ...] per vocab, n}.
+
+    Matches the Python path's semantics: weight/offset nulls default to
+    1.0/0.0, null labels only allowed when ``allow_null_labels`` (scoring),
+    features missing from a vocabulary are dropped, intercept column left
+    for the caller to inject (as ingest does).
+    """
+    if not paths:
+        raise FileNotFoundError("no input files")
+    # compile against the first file's writer schema
+    schema = _read_header_schema(paths[0])
+    field_prog, feat_desc = compile_schema(
+        schema, label_field=label_field, want_entities=bool(entity_keys)
+    )
+    reader = NativeAvroReader(
+        field_prog,
+        feat_desc,
+        [v.index_to_key for v in vocabs],
+        [v.intercept_index for v in vocabs],
+        entity_keys,
+    )
+    try:
+        for p in paths:
+            reader.feed_file(p, expected_schema=schema)
+        n = reader.num_records
+        labels, label_seen = reader.scalar(COL_LABEL)
+        if not allow_null_labels and not label_seen.all():
+            i = int(np.argmin(label_seen))
+            raise ValueError(
+                f"record {i} has a null/missing label; training input "
+                "requires labels (pass allow_null_labels=True only for "
+                "scoring)"
+            )
+        offsets, _ = reader.scalar(COL_OFFSET)
+        weights, w_seen = reader.scalar(COL_WEIGHT)
+        weights = np.where(w_seen, weights, 1.0)
+        out: Dict[str, object] = {
+            "n": n,
+            "labels": labels,
+            "label_present": label_seen,
+            "offsets": offsets,
+            "weights": weights,
+            "uids": reader.uids(),
+            "entities": {
+                k: reader.entities(i) for i, k in enumerate(entity_keys)
+            },
+            "coo": [reader.coo(i) for i in range(len(vocabs))],
+        }
+        return out
+    finally:
+        reader.close()

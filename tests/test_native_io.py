@@ -1,0 +1,391 @@
+"""Native (C++) Avro ingest: equivalence against the Python codec.
+
+The native decoder must be a pure fast path: every artifact it produces
+(vocabulary, LabeledBatch, GameData, uids, label flags) must match the
+Python-codec path bit-for-bit on the same files — the analog of the
+reference's executor-side parse being exercised through
+``DriverIntegTest``-style fixtures (SURVEY §4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.ingest import (
+    RESPONSE_PREDICTION_FIELDS,
+    IngestSource,
+    make_training_example,
+)
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+
+native = pytest.importorskip("photon_ml_tpu.io.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(),
+    reason=f"native reader unavailable: {native.native_error()}",
+)
+
+
+def _records(n, d=200, seed=0, with_meta=True, null_labels=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        feats = {
+            (f"f{j}", "t"): float(rng.standard_normal())
+            for j in rng.choice(d, min(8, d), replace=False)
+        }
+        # one duplicate (name, term) per third record: dedup-by-sum cover
+        if i % 3 == 0:
+            k = next(iter(feats))
+            rec_feats = list(feats.items()) + [(k, 0.5)]
+        else:
+            rec_feats = list(feats.items())
+        rec = make_training_example(
+            label=float(rng.integers(0, 2)),
+            features={},
+            uid=f"u{i}" if i % 3 else None,
+            offset=float(rng.standard_normal()) if i % 2 else None,
+            weight=float(rng.uniform(0.5, 2.0)) if i % 5 else None,
+        )
+        rec["features"] = [
+            {"name": nm, "term": t, "value": float(v)}
+            for (nm, t), v in rec_feats
+        ]
+        if with_meta:
+            rec["metadataMap"] = (
+                {"userId": f"user{i % 11}", "songId": f"s{i % 7}"}
+                if i % 4
+                else None
+            )
+        if null_labels and i % 2:
+            rec["label"] = None
+        out.append(rec)
+    return out
+
+
+def _force_fallback(source: IngestSource) -> IngestSource:
+    source._native = lambda: None  # type: ignore[method-assign]
+    return source
+
+
+@pytest.fixture()
+def avro_file(tmp_path):
+    recs = _records(600)
+    path = str(tmp_path / "part-0.avro")
+    write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs, codec="deflate")
+    return path, recs
+
+
+class TestLabeledBatch:
+    @pytest.mark.parametrize("sparse", [False, True])
+    def test_matches_python_path(self, avro_file, sparse):
+        path, _ = avro_file
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        nat = IngestSource([path]).labeled_batch(vocab, sparse=sparse)
+        ref = _force_fallback(IngestSource([path])).labeled_batch(
+            vocab, sparse=sparse
+        )
+        for a, b in zip(nat[:1], ref[:1]):
+            if sparse:
+                from photon_ml_tpu.ops.sparse import to_dense
+
+                np.testing.assert_allclose(
+                    to_dense(a.features), to_dense(b.features), rtol=1e-6
+                )
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(a.features), np.asarray(b.features),
+                    rtol=1e-6,
+                )
+            np.testing.assert_array_equal(
+                np.asarray(a.labels), np.asarray(b.labels)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(a.offsets), np.asarray(b.offsets)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(a.weights), np.asarray(b.weights)
+            )
+        assert list(nat[1]) == list(ref[1])  # uids incl. None
+        np.testing.assert_array_equal(nat[2], ref[2])
+
+    def test_tiny_vocab(self, tmp_path):
+        """Vocabulary blobs short enough for std::string SSO — regression
+        for the in-place Vocab construction (a moved SSO string dangles
+        every string_view into it)."""
+        recs = _records(60, d=4)
+        path = str(tmp_path / "tiny.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs)
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(4)], add_intercept=False
+        )
+        nat = IngestSource([path]).labeled_batch(vocab)
+        ref = _force_fallback(IngestSource([path])).labeled_batch(vocab)
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), np.asarray(ref[0].features)
+        )
+
+    def test_null_codec(self, tmp_path):
+        recs = _records(50)
+        path = str(tmp_path / "plain.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs, codec="null")
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        nat = IngestSource([path]).labeled_batch(vocab)
+        ref = _force_fallback(IngestSource([path])).labeled_batch(vocab)
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), np.asarray(ref[0].features)
+        )
+
+    def test_null_label_policy(self, tmp_path):
+        """Training input refuses null labels; scoring coerces to 0."""
+        schema = dict(TRAINING_EXAMPLE_SCHEMA)
+        schema["fields"] = [
+            (
+                {"name": "label", "type": ["null", "double"], "default": None}
+                if f["name"] == "label"
+                else f
+            )
+            for f in TRAINING_EXAMPLE_SCHEMA["fields"]
+        ]
+        recs = _records(40, null_labels=True)
+        path = str(tmp_path / "nulls.avro")
+        write_avro_file(path, schema, recs)
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        with pytest.raises(ValueError, match="null/missing label"):
+            IngestSource([path]).labeled_batch(vocab)
+        batch, _, present = IngestSource([path]).labeled_batch(
+            vocab, allow_null_labels=True
+        )
+        assert not present.all() and present.any()
+        labels = np.asarray(batch.labels)
+        assert (labels[~present] == 0.0).all()
+
+
+class TestGameData:
+    def test_matches_python_path(self, avro_file):
+        path, _ = avro_file
+        vocab_a = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(120)], add_intercept=True
+        )
+        vocab_b = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(80, 200)], add_intercept=False
+        )
+        shard_vocabs = {"shardA": vocab_a, "shardB": vocab_b}
+        keys = ["userId", "songId"]
+        nat = IngestSource([path]).game_data(shard_vocabs, keys)
+        ref = _force_fallback(IngestSource([path])).game_data(
+            shard_vocabs, keys
+        )
+        for shard in shard_vocabs:
+            np.testing.assert_allclose(
+                np.asarray(nat[0].features[shard]),
+                np.asarray(ref[0].features[shard]),
+                rtol=1e-6,
+            )
+        for k in keys:
+            np.testing.assert_array_equal(
+                np.asarray(nat[0].entity_ids[k]),
+                np.asarray(ref[0].entity_ids[k]),
+            )
+            assert nat[1][k] == ref[1][k]
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].labels), np.asarray(ref[0].labels)
+        )
+        assert list(nat[2]) == list(ref[2])
+
+    def test_applied_entity_vocab(self, avro_file):
+        """Scoring mode: a trained model's entity vocab is applied; unknown
+        entities map to -1 semantics via apply_entity_vocabulary."""
+        path, _ = avro_file
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        given = {"userId": {f"user{i}": i for i in range(5)}}
+        nat = IngestSource([path]).game_data(
+            {"s": vocab}, ["userId"], entity_vocabs=given
+        )
+        ref = _force_fallback(IngestSource([path])).game_data(
+            {"s": vocab}, ["userId"], entity_vocabs=given
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].entity_ids["userId"]),
+            np.asarray(ref[0].entity_ids["userId"]),
+        )
+
+
+class TestVocabScan:
+    def test_matches_from_records(self, avro_file):
+        path, recs = avro_file
+        nat = IngestSource([path]).build_vocab(add_intercept=True)
+        ref = FeatureVocabulary.from_records(recs, add_intercept=True)
+        assert nat.index_to_key == ref.index_to_key
+
+    def test_selected_keys_filter(self, avro_file):
+        path, recs = avro_file
+        selected = {f"f{i}\x01t" for i in range(0, 200, 2)}
+        nat = IngestSource([path]).build_vocab(selected_keys=selected)
+        ref = FeatureVocabulary.from_records(recs, selected_keys=selected)
+        assert nat.index_to_key == ref.index_to_key
+
+
+class TestFieldNameSets:
+    def test_response_prediction(self, tmp_path):
+        """RESPONSE_PREDICTION reads "response" as the label
+        (``avro/ResponsePredictionFieldNames.scala``)."""
+        schema = {
+            "name": "ResponsePredictionAvro",
+            "type": "record",
+            "fields": [
+                {"name": "response", "type": "double"},
+                {
+                    "name": "features",
+                    "type": {
+                        "type": "array",
+                        "items": {
+                            "name": "F",
+                            "type": "record",
+                            "fields": [
+                                {"name": "name", "type": "string"},
+                                {"name": "term", "type": "string"},
+                                {"name": "value", "type": "double"},
+                            ],
+                        },
+                    },
+                },
+            ],
+        }
+        recs = [
+            {
+                "response": float(i % 2),
+                "features": [
+                    {"name": f"f{i % 7}", "term": "", "value": 1.0 + i}
+                ],
+            }
+            for i in range(30)
+        ]
+        path = str(tmp_path / "resp.avro")
+        write_avro_file(path, schema, recs)
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01" for i in range(7)], add_intercept=False
+        )
+        src = IngestSource([path], field_names=RESPONSE_PREDICTION_FIELDS)
+        nat = src.labeled_batch(vocab)
+        ref = _force_fallback(
+            IngestSource([path], field_names=RESPONSE_PREDICTION_FIELDS)
+        ).labeled_batch(vocab)
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].labels), np.asarray(ref[0].labels)
+        )
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), np.asarray(ref[0].features)
+        )
+
+
+class TestStringEdgeCases:
+    def test_non_ascii_strings(self, tmp_path):
+        """Multi-byte UTF-8 in uids, entity ids, and feature names must
+        round-trip exactly (byte offsets vs character offsets)."""
+        recs = []
+        for i in range(12):
+            recs.append(
+                make_training_example(
+                    label=float(i % 2),
+                    features={(f"caffé{i % 3}", "tèrm"): 1.0 + i},
+                    uid=f"usér{i}" if i % 2 else None,
+                )
+            )
+            recs[-1]["metadataMap"] = {"userId": f"ü{i % 4}"}
+        path = str(tmp_path / "utf8.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs)
+        vocab = IngestSource([path]).build_vocab(add_intercept=False)
+        ref_vocab = _force_fallback(IngestSource([path])).build_vocab(
+            add_intercept=False
+        )
+        assert vocab.index_to_key == ref_vocab.index_to_key
+        nat = IngestSource([path]).game_data({"s": vocab}, ["userId"])
+        ref = _force_fallback(IngestSource([path])).game_data(
+            {"s": ref_vocab}, ["userId"]
+        )
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features["s"]), np.asarray(ref[0].features["s"])
+        )
+        assert nat[1]["userId"] == ref[1]["userId"]
+        assert list(nat[2]) == list(ref[2])  # uids
+
+    def test_newline_in_feature_name(self, tmp_path):
+        """Keys travel as offset-framed bytes, so embedded newlines cannot
+        split or shift the vocabulary."""
+        recs = [
+            make_training_example(
+                label=1.0,
+                features={("a\nb", "t"): 7.0, ("c", "t"): 9.0},
+            )
+        ]
+        path = str(tmp_path / "nl.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs)
+        vocab = FeatureVocabulary(
+            ["a\nb\x01t", "c\x01t"], add_intercept=False
+        )
+        nat = IngestSource([path]).labeled_batch(vocab)
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), [[7.0, 9.0]]
+        )
+
+
+class TestEmptyInput:
+    def test_empty_file_raises(self, tmp_path):
+        path = str(tmp_path / "empty.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, [])
+        vocab = FeatureVocabulary(["f0\x01t"], add_intercept=False)
+        with pytest.raises(ValueError, match="no records found"):
+            IngestSource([path]).labeled_batch(vocab)
+        with pytest.raises(ValueError, match="no records found"):
+            _force_fallback(IngestSource([path])).labeled_batch(vocab)
+
+
+class TestSchemaGuards:
+    def test_mixed_schema_files_fall_back(self, tmp_path):
+        """Files with different writer schemas can't share one compiled
+        program; IngestSource must still produce correct output (via the
+        Python codec), not misdecode."""
+        recs_a = _records(20, seed=1)
+        path_a = str(tmp_path / "a.avro")
+        write_avro_file(path_a, TRAINING_EXAMPLE_SCHEMA, recs_a)
+        schema_b = dict(TRAINING_EXAMPLE_SCHEMA)
+        schema_b["fields"] = [
+            f
+            for f in TRAINING_EXAMPLE_SCHEMA["fields"]
+            if f["name"] != "weight"
+        ]
+        recs_b = _records(20, seed=2)
+        for r in recs_b:
+            r.pop("weight", None)
+        path_b = str(tmp_path / "b.avro")
+        write_avro_file(path_b, schema_b, recs_b)
+
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        nat = IngestSource([path_a, path_b]).labeled_batch(vocab)
+        ref = _force_fallback(
+            IngestSource([path_a, path_b])
+        ).labeled_batch(vocab)
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), np.asarray(ref[0].features)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].weights), np.asarray(ref[0].weights)
+        )
+
+    def test_unsupported_schema_compile(self):
+        with pytest.raises(native.UnsupportedSchema):
+            native.compile_schema({"type": "record", "fields": []})
