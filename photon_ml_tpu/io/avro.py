@@ -272,13 +272,19 @@ def write_avro_file(
     schema: dict,
     records: Iterable[dict],
     codec: str = "deflate",
-    sync_marker: bytes = b"\x13\x37" * 8,
+    sync_marker: bytes = None,
     block_size: int = 4096,
 ):
     """Write an Avro object container file (``avro/AvroIOUtils.scala``'s
-    saveAsSingleAvro analog)."""
+    saveAsSingleAvro analog). The sync marker is random per file as the
+    spec requires — split-seeking readers scan for it, so a fixed marker
+    risks resync-on-payload-bytes collisions."""
     if codec not in ("null", "deflate"):
         raise ValueError(f"unsupported codec {codec!r}")
+    if sync_marker is None:
+        sync_marker = os.urandom(16)
+    if len(sync_marker) != 16:
+        raise ValueError("sync_marker must be 16 bytes")
     names = _Names()
     _register_all(schema, names)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

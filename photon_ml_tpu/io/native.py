@@ -132,6 +132,14 @@ def _build_and_load() -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
         lib.pml_reader_error.restype = ctypes.c_char_p
         lib.pml_reader_error.argtypes = [ctypes.c_void_p]
         lib.pml_reader_free.argtypes = [ctypes.c_void_p]
+        lib.pml_write_columnar.restype = ctypes.c_int64
+        lib.pml_write_columnar.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+        ]
         return lib, None
     except Exception as e:  # noqa: BLE001 — any failure means "unavailable"
         return None, f"{type(e).__name__}: {e}"
@@ -528,6 +536,149 @@ def scan_feature_keys(
         return reader.distinct_keys()
     finally:
         reader.close()
+
+
+# write ops (must mirror native/avro_reader.cpp)
+WOP_DOUBLE = 1
+WOP_OPT_DOUBLE = 2
+WOP_OPT_STRING = 3
+WOP_NULL_UNION = 4
+
+
+def write_columnar_avro(
+    path: str,
+    schema: dict,
+    columns: Dict[str, object],
+    n: int,
+    codec: str = "deflate",
+) -> None:
+    """Write an Avro container file of FLAT records straight from columnar
+    arrays — the native fast path for the scoring driver's output
+    (``cli/game/scoring/Driver.scala`` ScoredItems write). Per field the
+    column value is:
+
+    - ``double``           -> (n,) float array
+    - ``[null, double]``   -> ((n,) floats, (n,) present bools)
+    - ``[null, string]``   -> (n,) object array of str/None ("" == null)
+    - ``[null, <any>]`` always-null -> None
+
+    Schemas outside this family raise :class:`UnsupportedSchema`; callers
+    fall back to the Python codec."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(f"native writer unavailable: {_lib_error}")
+    if schema.get("type") != "record":
+        raise UnsupportedSchema("top-level schema must be a record")
+    ops: List[Tuple[int, int]] = []
+    dcols: List[np.ndarray] = []
+    pcols: List[np.ndarray] = []
+    pools: List[np.ndarray] = []
+    def _col(arr, what):
+        a = np.asarray(arr)
+        if a.shape != (n,):
+            raise ValueError(
+                f"{what}: expected shape ({n},), got {a.shape}"
+            )
+        return a
+
+    # schema-family check over ALL fields first, so an unsupported schema
+    # reports UnsupportedSchema (-> Python-codec fallback) rather than a
+    # missing-column error for some earlier field
+    for f in schema["fields"]:
+        ftype = f["type"]
+        if not (
+            ftype in ("double", "float")
+            or (
+                isinstance(ftype, list)
+                and len(ftype) == 2
+                and ftype[0] == "null"
+            )
+        ):
+            raise UnsupportedSchema(f"field {f['name']!r} type {ftype!r}")
+    for f in schema["fields"]:
+        name = f["name"]
+        ftype = f["type"]
+        if name not in columns:
+            # absent-by-typo must not silently become all-null output
+            raise KeyError(
+                f"no column provided for schema field {name!r} "
+                "(pass None explicitly for always-null fields)"
+            )
+        value = columns[name]
+        if ftype == "double" or ftype == "float":
+            ops.append((WOP_DOUBLE, len(dcols)))
+            dcols.append(_col(value, name).astype(np.float64))
+        elif isinstance(ftype, list) and len(ftype) == 2 and ftype[0] == "null":
+            inner = ftype[1]
+            if value is None:
+                ops.append((WOP_NULL_UNION, 0))
+            elif inner == "double" or inner == "float":
+                vals, present = value
+                ops.append((WOP_OPT_DOUBLE, len(dcols)))
+                dcols.append(_col(vals, name).astype(np.float64))
+                pcols.append(
+                    _col(present, f"{name} present flags").astype(np.uint8)
+                )
+            elif inner == "string":
+                ops.append((WOP_OPT_STRING, len(pools)))
+                pools.append(_col(np.asarray(value, object), name))
+            else:
+                ops.append((WOP_NULL_UNION, 0))
+                if value is not None and any(v is not None for v in np.atleast_1d(value)):
+                    raise UnsupportedSchema(
+                        f"field {name!r}: only always-null {inner} unions "
+                        "are supported natively"
+                    )
+    # doubles: stacked (ncols, n); present flags: aligned to the same col
+    # index as their doubles column (plain doubles get all-1 rows)
+    nd = len(dcols)
+    doubles = (
+        np.ascontiguousarray(np.stack(dcols)) if nd else np.zeros((1, 1))
+    )
+    present = np.ones((max(nd, 1), n), np.uint8)
+    pi = 0
+    for (op, arg) in ops:
+        if op == WOP_OPT_DOUBLE:
+            present[arg] = pcols[pi]
+            pi += 1
+    # pools: absolute offsets into one concatenated byte blob
+    offset_rows = []
+    blobs = []
+    base = 0
+    for pool in pools:
+        enc = [
+            b"" if v is None else str(v).encode("utf-8") for v in pool
+        ]
+        lens = np.asarray([len(e) for e in enc], np.int64)
+        offs = np.zeros(n + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        offset_rows.append(offs + base)
+        blob = b"".join(enc)
+        blobs.append(blob)
+        base += len(blob)
+    pool_offsets = (
+        np.ascontiguousarray(np.concatenate(offset_rows))
+        if pools
+        else np.zeros(1, np.int64)
+    )
+    pool_bytes = b"".join(blobs)
+    ops_arr = np.asarray(ops, np.int32).reshape(-1)
+    rc = lib.pml_write_columnar(
+        path.encode("utf-8"),
+        json.dumps(schema).encode("utf-8"),
+        n,
+        _i32p(np.ascontiguousarray(ops_arr)),
+        len(ops),
+        _f64p(doubles),
+        _u8p(np.ascontiguousarray(present)),
+        _i64p(pool_offsets),
+        pool_bytes,
+        os.urandom(16),
+        {"null": 0, "deflate": 1}[codec],
+        4096,
+    )
+    if rc != 0:
+        raise IOError(f"native Avro write failed (rc={rc}) for {path}")
 
 
 def read_columnar(

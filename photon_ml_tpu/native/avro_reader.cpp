@@ -587,3 +587,169 @@ const char* pml_reader_error(void* handle) {
 void pml_reader_free(void* handle) { delete static_cast<Reader*>(handle); }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// columnar writer (the scoring driver's output path)
+// ---------------------------------------------------------------------------
+// Flat-record encoder: Python passes per-field write ops over columnar
+// arrays; the container framing (header with schema JSON, deflate blocks,
+// sync markers) is produced here. Schemas with arrays/maps of values fall
+// back to the Python codec — the hot write path (ScoringResultAvro) is
+// flat scalars + optional strings + always-null unions.
+
+namespace {
+
+enum WriteOp : int32_t {
+  WOP_DOUBLE = 1,       // non-null double from column `arg`
+  WOP_OPT_DOUBLE = 2,   // [null, double] union from column + present flags
+  WOP_OPT_STRING = 3,   // [null, string] union from pool `arg`
+  WOP_NULL_UNION = 4,   // union whose value is always null (branch 0)
+};
+
+void put_varlong(std::string& out, int64_t v) {
+  uint64_t n = (static_cast<uint64_t>(v) << 1) ^
+               static_cast<uint64_t>(v >> 63);
+  while (true) {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (n) {
+      out.push_back(static_cast<char>(b | 0x80));
+    } else {
+      out.push_back(static_cast<char>(b));
+      return;
+    }
+  }
+}
+
+bool deflate_raw(const std::string& src, std::string& dst) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  // raw deflate (windowBits -15), default level
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, -15, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK)
+    return false;
+  dst.resize(deflateBound(&zs, src.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(src.data()));
+  zs.avail_in = static_cast<uInt>(src.size());
+  zs.next_out = reinterpret_cast<Bytef*>(dst.data());
+  zs.avail_out = static_cast<uInt>(dst.size());
+  int rc = deflate(&zs, Z_FINISH);
+  bool ok = rc == Z_STREAM_END;
+  dst.resize(dst.size() - zs.avail_out);
+  deflateEnd(&zs);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write an Avro container file of flat records from columnar arrays.
+// ops: int32 pairs (op, arg). doubles: [ncols][n] row-major as one flat
+// array. present: per optional-double column, n flags (may alias). pools:
+// per string column, n+1 byte offsets + bytes (empty string == null).
+// sync: 16 random bytes from the caller (the spec's per-file marker).
+// codec: 0 = null, 1 = deflate. Returns 0 on success, negative on error.
+int64_t pml_write_columnar(const char* path, const char* schema_json,
+                           int64_t n, const int32_t* ops, int32_t nops,
+                           const double* doubles,
+                           const uint8_t* present_flags,
+                           const int64_t* pool_offsets,
+                           const char* pool_bytes, const char* sync,
+                           int32_t codec, int64_t block_records) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  // header
+  std::string header;
+  header.append("Obj\x01", 4);
+  put_varlong(header, 2);  // two metadata entries
+  auto put_str = [&header](const std::string& s) {
+    put_varlong(header, static_cast<int64_t>(s.size()));
+    header.append(s);
+  };
+  put_str("avro.schema");
+  put_str(schema_json);
+  put_str("avro.codec");
+  put_str(codec == 1 ? "deflate" : "null");
+  put_varlong(header, 0);
+  header.append(sync, 16);
+  if (std::fwrite(header.data(), 1, header.size(), f) != header.size()) {
+    std::fclose(f);
+    return -2;
+  }
+
+  if (block_records <= 0) block_records = 4096;
+  std::string block, packed, framed;
+  // column layout bookkeeping: each op's `arg` indexes the shared arrays
+  for (int64_t start = 0; start < n; start += block_records) {
+    int64_t count = std::min(block_records, n - start);
+    block.clear();
+    for (int64_t i = start; i < start + count; ++i) {
+      for (int32_t o = 0; o < nops; ++o) {
+        int32_t op = ops[2 * o];
+        int32_t arg = ops[2 * o + 1];
+        switch (op) {
+          case WOP_DOUBLE: {
+            double v = doubles[static_cast<int64_t>(arg) * n + i];
+            char buf[8];
+            std::memcpy(buf, &v, 8);
+            block.append(buf, 8);
+            break;
+          }
+          case WOP_OPT_DOUBLE: {
+            bool present =
+                present_flags[static_cast<int64_t>(arg) * n + i] != 0;
+            put_varlong(block, present ? 1 : 0);  // [null, double]
+            if (present) {
+              double v = doubles[static_cast<int64_t>(arg) * n + i];
+              char buf[8];
+              std::memcpy(buf, &v, 8);
+              block.append(buf, 8);
+            }
+            break;
+          }
+          case WOP_OPT_STRING: {
+            int64_t a = pool_offsets[static_cast<int64_t>(arg) * (n + 1) + i];
+            int64_t b =
+                pool_offsets[static_cast<int64_t>(arg) * (n + 1) + i + 1];
+            if (b > a) {
+              put_varlong(block, 1);  // [null, string]
+              put_varlong(block, b - a);
+              block.append(pool_bytes + a, static_cast<size_t>(b - a));
+            } else {
+              put_varlong(block, 0);
+            }
+            break;
+          }
+          case WOP_NULL_UNION:
+            put_varlong(block, 0);
+            break;
+          default:
+            std::fclose(f);
+            return -3;
+        }
+      }
+    }
+    const std::string* payload = &block;
+    if (codec == 1) {
+      if (!deflate_raw(block, packed)) {
+        std::fclose(f);
+        return -4;
+      }
+      payload = &packed;
+    }
+    framed.clear();
+    put_varlong(framed, count);
+    put_varlong(framed, static_cast<int64_t>(payload->size()));
+    framed.append(*payload);
+    framed.append(sync, 16);
+    if (std::fwrite(framed.data(), 1, framed.size(), f) != framed.size()) {
+      std::fclose(f);
+      return -2;
+    }
+  }
+  if (std::fclose(f) != 0) return -2;
+  return 0;
+}
+
+}  // extern "C"
