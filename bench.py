@@ -526,6 +526,72 @@ def bench_sparse():
     w_dev = np.asarray(tm.model.coefficients.means)
     tpu_s = time.perf_counter() - t0
 
+    # hybrid dense-hot/sparse-cold split (ops.sparse.HybridFeatures,
+    # docs/PERF.md). The split targets POWER-LAW columns — the uniform
+    # config above has no head to densify — so it gets its own
+    # Zipf-distributed dataset (CTR-like) with a paired ELL control on
+    # identical data.
+    from photon_ml_tpu.ops.sparse import to_hybrid
+
+    from photon_ml_tpu.ops.sparse import (
+        cold_padded_slots,
+        from_coo,
+        stored_cold_entries,
+    )
+
+    zranks = rng.zipf(1.1, size=(n, nnz))
+    zidx = ((zranks - 1) % d).astype(np.int32)
+    zvals = rng.standard_normal((n, nnz)).astype(np.float32)
+    # dedup-by-sum through from_coo (to_hybrid's invariant; every ingest
+    # path guarantees it the same way)
+    zsf = from_coo(
+        np.repeat(np.arange(n), nnz),
+        zidx.reshape(-1),
+        zvals.reshape(-1),
+        n,
+        d,
+        dtype=jnp.float32,
+    )
+    w_pad = np.append(w_true, 0.0).astype(np.float32)
+    zlogits = np.einsum(
+        "nk,nk->n", np.asarray(zsf.values), w_pad[np.asarray(zsf.indices)]
+    )
+    zy = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-zlogits))).astype(
+        np.float32
+    )
+    zell = LabeledBatch.create(zsf, zy, dtype=jnp.float32)
+    zhf = to_hybrid(zsf, hot_columns=-1)
+    zperm = np.asarray(zhf.row_perm)
+    zhyb = LabeledBatch.create(zhf, zy[zperm], dtype=jnp.float32)
+    h_cols = int(zhf.dense.shape[1])
+    ell_slots = int(np.prod(zsf.indices.shape))
+    log(
+        f"zipf hybrid split: {h_cols} hot cols densified; "
+        f"{stored_cold_entries(zhf) / (n * nnz):.0%} of entries stay "
+        f"sparse in {len(zhf.cold_segments)} row buckets "
+        f"({cold_padded_slots(zhf) / 1e6:.1f}M padded slots vs "
+        f"{ell_slots / 1e6:.1f}M ELL)"
+    )
+    t0 = time.perf_counter()
+    (ze,) = train_glm(zell, cfg(10.0))
+    np.asarray(ze.result.w)
+    (zh,) = train_glm(zhyb, cfg(10.0))
+    np.asarray(zh.result.w)
+    log(f"zipf compiles: {time.perf_counter() - t0:.2f}s")
+    t0 = time.perf_counter()
+    (ze,) = train_glm(zell, cfg(1.0))
+    w_zell = np.asarray(ze.model.coefficients.means)
+    zipf_ell_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    (zh,) = train_glm(zhyb, cfg(1.0))
+    w_zhyb = np.asarray(zh.model.coefficients.means)
+    hybrid_s = time.perf_counter() - t0
+    drift = float(np.max(np.abs(w_zhyb - w_zell)))
+    log(
+        f"zipf 200kx120k: hybrid {hybrid_s:.3f}s vs ELL {zipf_ell_s:.3f}s "
+        f"({zipf_ell_s / hybrid_s:.2f}x, max|dw|={drift:.2e})"
+    )
+
     from scipy.sparse import csr_matrix
     from sklearn.linear_model import LogisticRegression
 
@@ -560,6 +626,9 @@ def bench_sparse():
         "cpu_s": cpu_s,
         "auc_device": auc_dev,
         "auc_cpu": auc_cpu,
+        "hybrid_s": hybrid_s,
+        "zipf_ell_s": zipf_ell_s,
+        "hybrid_hot_columns": h_cols,
     }
 
 
@@ -663,6 +732,10 @@ def main():
         "achieved_tflops": round(glm["achieved_tflops"], 2),
         "sparse_200kx120k_s": round(sparse["tpu_s"], 3),
         "sparse_vs_sklearn": round(sparse["cpu_s"] / sparse["tpu_s"], 3),
+        "sparse_zipf_hybrid_s": round(sparse["hybrid_s"], 3),
+        "sparse_zipf_hybrid_vs_ell": round(
+            sparse["zipf_ell_s"] / sparse["hybrid_s"], 3
+        ),
         "game_cd_iters_per_s": round(game["iters_per_s"], 3),
         "game_multi_re_mf_iters_per_s": round(
             game_multi["iters_per_s"], 3

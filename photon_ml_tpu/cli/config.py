@@ -42,6 +42,11 @@ class GLMDriverParams:
     tolerance: float = 1e-7
     add_intercept: bool = True
     sparse: bool = False
+    # with sparse=True: densify the hottest columns into an MXU slab and
+    # keep only the power-law tail in the ELL scatter path (ops.sparse
+    # HybridFeatures). 0 = off, -1 = auto (count-threshold split), N > 0 =
+    # exactly-N hottest columns.
+    hot_columns: int = 0
     validate_input: List[str] = dataclasses.field(default_factory=list)
     data_validation: str = "VALIDATE_FULL"
     feature_file: Optional[str] = None  # pinned vocabulary (one key per line)
@@ -90,6 +95,19 @@ class GLMDriverParams:
         if self.date_range and self.date_range_days_ago:
             raise ValueError(
                 "date_range and date_range_days_ago are mutually exclusive"
+            )
+        if self.hot_columns and not self.sparse:
+            raise ValueError("hot_columns requires sparse=True")
+        if self.hot_columns and self.mesh_shape:
+            raise ValueError(
+                "hot_columns (hybrid features) is single-device for now: "
+                "the bucketed cold segments have unequal row counts, "
+                "which the row-sharded mesh path does not partition"
+            )
+        if self.hot_columns and self.optimizer == "NEWTON":
+            raise ValueError(
+                "NEWTON materializes the exact Hessian from dense "
+                "features; hot_columns (hybrid) is not supported"
             )
         if self.training_diagnostics and not self.diagnostics:
             raise ValueError(

@@ -61,6 +61,35 @@ def read_records(paths: List[str]) -> List[dict]:
     return records
 
 
+def _hybridize(batch, params, logger):
+    """Split an ELL batch into the dense-hot + bucketed sparse-cold
+    representation (``ops.sparse.HybridFeatures``): the power-law head
+    rides the MXU, the tail keeps the scatter path at near-zero padding
+    (docs/PERF.md sparse section). The batch's row-aligned fields are
+    permuted to the hybrid's stored order (training is row-order
+    invariant)."""
+    import dataclasses as _dc
+
+    from photon_ml_tpu.ops.sparse import stored_cold_entries, to_hybrid
+
+    hf = to_hybrid(batch.features, hot_columns=params.hot_columns)
+    perm = np.asarray(hf.row_perm)
+    widths = [seg.nnz_per_row for seg in hf.cold_segments]
+    logger.info(
+        f"hybrid split: {hf.dense.shape[1]} hot columns densified, "
+        f"{stored_cold_entries(hf)} entries stay sparse over "
+        f"{len(widths)} row buckets (widths {widths})"
+    )
+    return _dc.replace(
+        batch,
+        features=hf,
+        labels=batch.labels[perm],
+        offsets=batch.offsets[perm],
+        weights=batch.weights[perm],
+        mask=batch.mask[perm],
+    )
+
+
 def resolve_date_range(params) -> Optional[DateRange]:
     if params.date_range:
         return DateRange.from_dates(params.date_range)
@@ -164,6 +193,8 @@ def run_glm_training(params) -> GLMTrainingRun:
             dtype=driver_dtype(params.precision),
         )
         logger.info(f"read {batch.labels.shape[0]} training records")
+        if params.sparse and params.hot_columns:
+            batch = _hybridize(batch, params, logger)
         task = TaskType[params.task]
         sanity_check_data(
             batch, task, DataValidationType[params.data_validation]
@@ -284,6 +315,8 @@ def run_glm_training(params) -> GLMTrainingRun:
                 vocab, sparse=params.sparse,
                 dtype=driver_dtype(params.precision),
             )
+            if params.sparse and params.hot_columns:
+                vbatch = _hybridize(vbatch, params, logger)
             for tm in models:
                 margins = tm.model.compute_margin(
                     vbatch.features, vbatch.offsets
@@ -440,6 +473,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-iters", type=int)
     p.add_argument("--tolerance", type=float)
     p.add_argument("--sparse", action="store_true", default=None)
+    p.add_argument(
+        "--hot-columns", type=int, default=None,
+        help="with --sparse: densify the N hottest columns (-1 = auto)",
+    )
     p.add_argument("--overwrite", action="store_true", default=None)
     p.add_argument("--diagnostics", action="store_true", default=None)
     p.add_argument(

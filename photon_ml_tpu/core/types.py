@@ -83,9 +83,20 @@ class LabeledBatch:
         mask=None,
         dtype=jnp.float32,
     ) -> "LabeledBatch":
-        from photon_ml_tpu.ops.sparse import is_sparse
+        from photon_ml_tpu.ops.sparse import is_hybrid, is_sparse
 
-        if is_sparse(features):
+        if is_hybrid(features):
+            features = dataclasses.replace(
+                features,
+                dense=jnp.asarray(features.dense, dtype),
+                cold_segments=tuple(
+                    dataclasses.replace(
+                        seg, values=jnp.asarray(seg.values, dtype)
+                    )
+                    for seg in features.cold_segments
+                ),
+            )
+        elif is_sparse(features):
             features = dataclasses.replace(
                 features, values=jnp.asarray(features.values, dtype)
             )
@@ -116,7 +127,7 @@ class LabeledBatch:
 
         features = (
             sparse_ops.pad_rows(batch.features, pad)
-            if sparse_ops.is_sparse(batch.features)
+            if sparse_ops.is_structured(batch.features)
             else pad_rows(batch.features)
         )
         return LabeledBatch(

@@ -31,11 +31,16 @@ class DataValidationType(enum.Enum):
 
 def _row_checks(batch: LabeledBatch, task: TaskType) -> Dict[str, jax.Array]:
     """Per-check boolean (n,) arrays; True = row VIOLATES the check."""
-    from photon_ml_tpu.ops.sparse import is_sparse
+    from photon_ml_tpu.ops.sparse import is_hybrid, is_sparse
 
     m = batch.mask > 0
     x = batch.features
-    if is_sparse(x):
+    if is_hybrid(x):
+        cold_finite = jnp.concatenate(
+            [jnp.all(jnp.isfinite(seg.values), axis=-1) for seg in x.cold_segments]
+        )
+        feats_finite = jnp.all(jnp.isfinite(x.dense), axis=-1) & cold_finite
+    elif is_sparse(x):
         # only stored slots can be non-finite; padding slots hold 0.0
         feats_finite = jnp.all(jnp.isfinite(x.values), axis=-1)
     else:

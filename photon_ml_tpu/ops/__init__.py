@@ -7,12 +7,18 @@ from photon_ml_tpu.ops.losses import (
     loss_for_task,
 )
 from photon_ml_tpu.ops.objective import GLMObjective, RegularizationContext
-from photon_ml_tpu.ops.sparse import SparseFeatures
+from photon_ml_tpu.ops.sparse import (
+    HybridFeatures,
+    SparseFeatures,
+    to_hybrid,
+)
 from photon_ml_tpu.ops.stats import BasicStatisticalSummary, summarize_features
 from photon_ml_tpu.ops import metrics, sparse
 
 __all__ = [
+    "HybridFeatures",
     "SparseFeatures",
+    "to_hybrid",
     "sparse",
     "RegularizationContext",
     "BasicStatisticalSummary",

@@ -217,7 +217,9 @@ class GLMObjective:
                 "shifts change X densely; use hessian_vector instead)"
             )
         x = batch.features
-        if hasattr(x, "values"):
+        from photon_ml_tpu.ops.sparse import is_structured
+
+        if is_structured(x):
             raise ValueError("hessian_full requires dense features")
         z = self.margins(w, batch)
         c = batch.effective_weights() * self.loss.d2(z, batch.labels)

@@ -75,6 +75,89 @@ def _unflatten(d, children):
 jax.tree_util.register_pytree_node(SparseFeatures, _flatten, _unflatten)
 
 
+@dataclasses.dataclass(frozen=True)
+class HybridFeatures:
+    """Power-law split of a sparse matrix: dense slab for the hot columns,
+    row-bucketed padded-ELL for the cold tail.
+
+    On TPU the ~130 M elem/s XLA gather/scatter bound makes every stored
+    ELL SLOT (incl. padding) cost ~8 ns, while a dense slab column costs
+    one MXU/HBM pass (~n * 4 bytes at full bandwidth) regardless of
+    sparsity — so any column with enough entries is cheaper densified
+    (the "feature-hashing into dense-ish blocks" direction of SURVEY §7
+    hard-part 3; docs/PERF.md has the measured rates). CTR-style feature
+    data is Zipf-distributed, so a small slab absorbs most entries.
+
+    Because the irregular cost scales with padded SLOTS, the cold tail is
+    additionally row-bucketed: rows sorted by cold-entry count, split
+    into contiguous segments by the same exact-DP padding minimizer the
+    GAME random-effect designs use (``game/data.py``), each segment an
+    ELL at its own width. Rows therefore live in a PERMUTED order;
+    ``row_perm[i]`` is the original index of stored row i. Training is
+    row-order-invariant — callers permute the rest of the batch once at
+    construction (labels, offsets, weights, mask) and everything else
+    follows.
+
+    dense:         (n, H) slab holding the hot columns (stored row order).
+    hot_ids:       (H,) int32 original column ids of the slab columns.
+    cold_segments: contiguous-row ELL segments over the SAME d covering
+                   all n rows in stored order (hot columns never appear).
+    row_perm:      (n,) int32 stored-row -> original-row map.
+    """
+
+    dense: jax.Array
+    hot_ids: jax.Array
+    cold_segments: Tuple[SparseFeatures, ...]
+    row_perm: jax.Array
+
+    @property
+    def d(self) -> int:
+        return self.cold_segments[0].d
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.dense.shape[-2], self.d)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.dense.dtype
+
+    def segment_bounds(self) -> Tuple[Tuple[int, int], ...]:
+        """[(lo, hi)) stored-row ranges, one per cold segment (static)."""
+        bounds = []
+        lo = 0
+        for seg in self.cold_segments:
+            hi = lo + seg.indices.shape[-2]
+            bounds.append((lo, hi))
+            lo = hi
+        return tuple(bounds)
+
+    def __matmul__(self, w: jax.Array) -> jax.Array:
+        return matvec(self, w)
+
+
+def _flatten_hybrid(hf: HybridFeatures):
+    return (hf.dense, hf.hot_ids, hf.cold_segments, hf.row_perm), None
+
+
+def _unflatten_hybrid(_, children):
+    return HybridFeatures(
+        dense=children[0],
+        hot_ids=children[1],
+        cold_segments=tuple(children[2]),
+        row_perm=children[3],
+    )
+
+
+jax.tree_util.register_pytree_node(
+    HybridFeatures, _flatten_hybrid, _unflatten_hybrid
+)
+
+
 # -- kernels (dispatch on representation) -----------------------------------
 
 
@@ -82,8 +165,24 @@ def is_sparse(x) -> bool:
     return isinstance(x, SparseFeatures)
 
 
+def is_hybrid(x) -> bool:
+    return isinstance(x, HybridFeatures)
+
+
+def is_structured(x) -> bool:
+    """Any non-plain-array representation this module owns."""
+    return is_sparse(x) or is_hybrid(x)
+
+
 def matvec(x, w: jax.Array) -> jax.Array:
-    """margins contraction: (n, d) @ (d,) -> (n,)."""
+    """margins contraction: (n, d) @ (d,) -> (n,). Hybrid output is in
+    STORED (permuted) row order, matching the permuted batch."""
+    if is_hybrid(x):
+        # dtype promotion mirrors the dense path (bf16 slab @ f32 w -> f32)
+        cold = jnp.concatenate(
+            [matvec(seg, w) for seg in x.cold_segments]
+        )
+        return x.dense @ w[x.hot_ids] + cold
     if not is_sparse(x):
         return x @ w
     gathered = w.at[x.indices].get(mode="fill", fill_value=0.0)
@@ -91,7 +190,13 @@ def matvec(x, w: jax.Array) -> jax.Array:
 
 
 def rmatvec(x, a: jax.Array) -> jax.Array:
-    """gradient back-projection: (n, d)^T @ (n,) -> (d,)."""
+    """gradient back-projection: (n, d)^T @ (n,) -> (d,). Hybrid `a` is
+    in stored row order."""
+    if is_hybrid(x):
+        g = jnp.zeros((x.d,), a.dtype)
+        for (lo, hi), seg in zip(x.segment_bounds(), x.cold_segments):
+            g = g + rmatvec(seg, a[lo:hi])
+        return g.at[x.hot_ids].add(a @ x.dense)
     if not is_sparse(x):
         return x.T @ a
     upd = (x.values * a[..., None]).reshape(-1)
@@ -104,6 +209,13 @@ def rmatvec(x, a: jax.Array) -> jax.Array:
 
 def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
     """sum_i c_i * x_ij (or x_ij^2) -> (d,): the Hessian-diagonal sums."""
+    if is_hybrid(x):
+        v = x.dense * x.dense if square else x.dense
+        hot = jnp.einsum("n,nh->h", c, v)
+        g = jnp.zeros((x.d,), c.dtype)
+        for (lo, hi), seg in zip(x.segment_bounds(), x.cold_segments):
+            g = g + colsum(seg, c[lo:hi], square=square)
+        return g.at[x.hot_ids].add(hot)
     if not is_sparse(x):
         v = x * x if square else x
         return jnp.einsum("n,nd->d", c, v)
@@ -116,21 +228,74 @@ def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
     )
 
 
-def pad_rows(sf: SparseFeatures, pad: int) -> SparseFeatures:
+def pad_rows(x, pad: int):
     """Append `pad` all-padding rows (index d, value 0), preserving the
     padding invariant that plain zero-padding would break."""
+    if is_hybrid(x):
+        n = x.dense.shape[-2]
+        segs = list(x.cold_segments)
+        segs[-1] = pad_rows(segs[-1], pad)
+        return HybridFeatures(
+            dense=jnp.pad(x.dense, ((0, pad), (0, 0))),
+            hot_ids=x.hot_ids,
+            cold_segments=tuple(segs),
+            row_perm=jnp.concatenate(
+                [x.row_perm, jnp.arange(n, n + pad, dtype=jnp.int32)]
+            ),
+        )
     return SparseFeatures(
-        indices=jnp.pad(sf.indices, ((0, pad), (0, 0)), constant_values=sf.d),
-        values=jnp.pad(sf.values, ((0, pad), (0, 0))),
-        d=sf.d,
+        indices=jnp.pad(x.indices, ((0, pad), (0, 0)), constant_values=x.d),
+        values=jnp.pad(x.values, ((0, pad), (0, 0))),
+        d=x.d,
     )
 
 
 def row_density(x) -> jax.Array:
-    """Per-row stored-entry count (diagnostic)."""
+    """Per-row stored-entry count (diagnostic; hybrid in stored order)."""
+    if is_hybrid(x):
+        cold = jnp.concatenate(
+            [row_density(seg) for seg in x.cold_segments]
+        )
+        return jnp.sum(x.dense != 0, axis=-1) + cold
     if not is_sparse(x):
         return jnp.sum(x != 0, axis=-1)
     return jnp.sum(x.indices < x.d, axis=-1)
+
+
+def stored_cold_entries(hf: HybridFeatures) -> int:
+    """Total stored (non-padding) entries across the cold segments."""
+    return sum(
+        int(np.sum(np.asarray(seg.indices) < seg.d))
+        for seg in hf.cold_segments
+    )
+
+
+def cold_padded_slots(hf: HybridFeatures) -> int:
+    """Total padded ELL slots across the cold segments (the quantity the
+    irregular-access cost scales with)."""
+    return sum(
+        int(np.prod(seg.indices.shape)) for seg in hf.cold_segments
+    )
+
+
+def cold_as_single_ell(hf: HybridFeatures) -> SparseFeatures:
+    """Concatenate the cold segments back into one ELL at the max segment
+    width (stored row order). Re-inflates padding — for once-per-run
+    consumers (statistics), not hot kernels."""
+    kmax = max(seg.nnz_per_row for seg in hf.cold_segments)
+    ind = []
+    val = []
+    for seg in hf.cold_segments:
+        extra = kmax - seg.nnz_per_row
+        ind.append(
+            jnp.pad(seg.indices, ((0, 0), (0, extra)), constant_values=seg.d)
+        )
+        val.append(jnp.pad(seg.values, ((0, 0), (0, extra))))
+    return SparseFeatures(
+        indices=jnp.concatenate(ind),
+        values=jnp.concatenate(val),
+        d=hf.d,
+    )
 
 
 # -- construction ------------------------------------------------------------
@@ -180,6 +345,124 @@ def from_coo(
     )
 
 
+def to_hybrid(
+    sf: SparseFeatures,
+    hot_columns: int = -1,
+    dtype=None,
+    min_count: int = 64,
+    max_slab_bytes: int = 1 << 30,
+    num_row_buckets: int = 8,
+) -> HybridFeatures:
+    """Split an ELL matrix into dense-hot + bucketed sparse-cold
+    (host-side, once per dataset).
+
+    ``hot_columns`` = H picks the H highest-count columns; -1 sizes the
+    slab automatically: columns whose stored-entry count exceeds
+    ``min_count`` (the measured v5e break-even — ~64 irregular accesses
+    cost about one dense n-row column pass, docs/PERF.md), hottest
+    first, until the slab reaches ``max_slab_bytes`` at the target dtype.
+    A slab with zero qualifying columns degrades to H=1 so shapes stay
+    static.
+
+    The cold rows are then sorted by remaining-entry count and split
+    into at most ``num_row_buckets`` contiguous ELL segments by the
+    exact-DP padding minimizer (``game/data.py``) — the irregular-access
+    cost scales with padded SLOTS, and a single max-width ELL would hand
+    the hottest row's width to every row. The returned ``row_perm``
+    records stored-row -> original-row; callers permute the rest of the
+    batch to match.
+
+    The input must be dedup-summed — no (row, column) pair stored twice
+    (``from_coo``'s invariant, which every ingest path goes through).
+    Duplicate slots would sum into one slab cell, changing the SQUARED
+    statistics (colsum(square=True) -> Hessian diagonal / variances)
+    relative to the ELL, so they are rejected here rather than silently
+    diverging.
+    """
+    from photon_ml_tpu.game.data import _split_minimizing_padding
+
+    out_dtype = np.dtype(jnp.dtype(dtype or sf.values.dtype))
+    ind = np.asarray(sf.indices)
+    val = np.asarray(sf.values)
+    n, k = ind.shape
+    sorted_cols = np.sort(np.where(ind < sf.d, ind, -1), axis=-1)
+    dup_rows = np.flatnonzero(
+        ((sorted_cols[:, 1:] == sorted_cols[:, :-1])
+         & (sorted_cols[:, 1:] >= 0)).any(axis=-1)
+    )
+    if dup_rows.size:
+        raise ValueError(
+            f"to_hybrid requires dedup-summed input (from_coo's "
+            f"invariant); {dup_rows.size} rows store a (row, column) "
+            f"pair twice, e.g. row {int(dup_rows[0])}"
+        )
+    flat = ind.reshape(-1)
+    keep = flat < sf.d
+    counts = np.bincount(flat[keep], minlength=sf.d)
+    if hot_columns < 0:
+        hot = np.flatnonzero(counts > min_count)
+        hot = hot[np.argsort(-counts[hot], kind="stable")]
+        h_cap = max(1, max_slab_bytes // (n * out_dtype.itemsize))
+        hot = hot[:h_cap]
+        if hot.size == 0:
+            hot = np.argsort(-counts, kind="stable")[:1]
+    else:
+        h = max(1, min(hot_columns, sf.d))
+        hot = np.argsort(-counts, kind="stable")[:h]
+    H = hot.size
+    hot_rank = np.full(sf.d + 1, -1, np.int64)
+    hot_rank[hot] = np.arange(H)
+    is_hot = hot_rank[ind] >= 0  # (n, k); padding col d is never hot
+
+    # row permutation: ascending cold-entry count (matches the DP input)
+    cold_entry = ~is_hot & (ind < sf.d)
+    cold_counts = cold_entry.sum(axis=1)
+    row_perm = np.argsort(cold_counts, kind="stable").astype(np.int32)
+    sorted_counts = cold_counts[row_perm]
+    bounds = _split_minimizing_padding(
+        sorted_counts, max(1, num_row_buckets)
+    ) or [(0, n)]
+
+    # slab built at the target dtype's f32/f64 (never narrower than f32 —
+    # bf16 accumulation would lose dedup sums), in STORED row order
+    acc_dtype = np.float64 if out_dtype == np.float64 else np.float32
+    dense = np.zeros((n, H), acc_dtype)
+    rows = np.broadcast_to(np.arange(n)[:, None], ind.shape)
+    np.add.at(dense, (rows[is_hot], hot_rank[ind[is_hot]]), val[is_hot])
+    inv_perm = np.empty(n, np.int64)
+    inv_perm[row_perm] = np.arange(n)
+    dense = dense[row_perm]
+
+    # cold segments: contiguous stored-row ranges, each its own ELL width
+    stored_rows = inv_perm[rows[cold_entry]]
+    cold_cols = ind[cold_entry]
+    cold_vals = val[cold_entry]
+    order = np.argsort(stored_rows, kind="stable")
+    stored_rows = stored_rows[order]
+    cold_cols = cold_cols[order]
+    cold_vals = cold_vals[order]
+    entry_starts = np.searchsorted(stored_rows, [lo for lo, _ in bounds])
+    entry_ends = np.searchsorted(stored_rows, [hi for _, hi in bounds])
+    segments = []
+    for (lo, hi), es, ee in zip(bounds, entry_starts, entry_ends):
+        segments.append(
+            from_coo(
+                stored_rows[es:ee] - lo,
+                cold_cols[es:ee],
+                cold_vals[es:ee],
+                hi - lo,
+                sf.d,
+                dtype=dtype or sf.values.dtype,
+            )
+        )
+    return HybridFeatures(
+        dense=jnp.asarray(dense, dtype or sf.values.dtype),
+        hot_ids=jnp.asarray(hot.astype(np.int32)),
+        cold_segments=tuple(segments),
+        row_perm=jnp.asarray(row_perm),
+    )
+
+
 def from_dense(x: np.ndarray, nnz_per_row: int = 0, dtype=jnp.float32) -> SparseFeatures:
     """Sparsify a dense matrix (testing / oracles)."""
     x = np.asarray(x)
@@ -189,8 +472,19 @@ def from_dense(x: np.ndarray, nnz_per_row: int = 0, dtype=jnp.float32) -> Sparse
     )
 
 
-def to_dense(sf: SparseFeatures) -> np.ndarray:
-    """Densify (small problems / tests only)."""
+def to_dense(sf) -> np.ndarray:
+    """Densify (small problems / tests only). Hybrid matrices come back
+    in ORIGINAL row order (row_perm inverted)."""
+    if is_hybrid(sf):
+        stored = np.concatenate(
+            [to_dense(seg) for seg in sf.cold_segments]
+        )
+        stored[:, np.asarray(sf.hot_ids)] += np.asarray(
+            sf.dense, np.float64
+        ).astype(stored.dtype)
+        out = np.empty_like(stored)
+        out[np.asarray(sf.row_perm)] = stored
+        return out
     ind = np.asarray(sf.indices)
     val = np.asarray(sf.values)
     n, k = ind.shape

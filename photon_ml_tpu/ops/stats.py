@@ -54,6 +54,8 @@ def summarize_features(
     Sparse batches take the scatter-kernel path (implicit zeros included in
     every statistic, matching the dense semantics)."""
     x = batch.features
+    if sparse_ops.is_hybrid(x):
+        return _summarize_hybrid(batch, axis_name)
     if sparse_ops.is_sparse(x):
         return _summarize_sparse(batch, axis_name)
     m = batch.mask[:, None]
@@ -99,6 +101,40 @@ def _psum_min(v, axis_name):
 
 def _psum_max(v, axis_name):
     return jax.lax.pmax(v, axis_name) if axis_name is not None else v
+
+
+def _summarize_hybrid(
+    batch: LabeledBatch, axis_name: Optional[str] = None
+) -> BasicStatisticalSummary:
+    """Hybrid = disjoint column split, so per-column statistics merge by
+    overwrite: hot columns take the dense-slab stats (the cold pass sees
+    them as all-zero columns), cold columns keep the ELL-scatter stats."""
+    import dataclasses as _dc
+
+    x = batch.features
+    cold = summarize_features(
+        _dc.replace(batch, features=sparse_ops.cold_as_single_ell(x)),
+        axis_name,
+    )
+    slab = summarize_features(
+        _dc.replace(batch, features=x.dense), axis_name
+    )
+    hot = x.hot_ids
+
+    def merge(cold_v, slab_v):
+        return cold_v.at[hot].set(slab_v.astype(cold_v.dtype))
+
+    return BasicStatisticalSummary(
+        mean=merge(cold.mean, slab.mean),
+        variance=merge(cold.variance, slab.variance),
+        count=cold.count,
+        min=merge(cold.min, slab.min),
+        max=merge(cold.max, slab.max),
+        norm_l1=merge(cold.norm_l1, slab.norm_l1),
+        norm_l2=merge(cold.norm_l2, slab.norm_l2),
+        mean_abs=merge(cold.mean_abs, slab.mean_abs),
+        num_nonzeros=merge(cold.num_nonzeros, slab.num_nonzeros),
+    )
 
 
 def _summarize_sparse(
