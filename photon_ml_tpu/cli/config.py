@@ -237,12 +237,31 @@ class GameDriverParams:
     # merge coordinates sharing (effect type, shard) by coefficient
     # addition at save (``ModelProcessingUtils.collapseGameModel``)
     collapse_output: bool = False
+    # shards stored as padded-ELL sparse matrices (the wide fixed-effect
+    # bag regime). Sparse shards serve plain fixed-effect coordinates
+    # only: per-entity designs gather dense rows.
+    sparse_shards: List[str] = dataclasses.field(default_factory=list)
 
     def validate(self) -> None:
         if not self.train_input:
             raise ValueError("train_input is required")
         if not self.updating_sequence:
             raise ValueError("updating_sequence is required")
+        sparse = set(self.sparse_shards)
+        if sparse:
+            for name, spec in self.coordinates.items():
+                uses_sparse = spec.shard in sparse
+                entityish = (
+                    spec.random_effect is not None
+                    or spec.latent_dim is not None
+                    or spec.projector
+                )
+                if uses_sparse and entityish:
+                    raise ValueError(
+                        f"coordinate {name!r} uses sparse shard "
+                        f"{spec.shard!r} but random/factored/projected "
+                        "effects need dense per-row features"
+                    )
         for name in self.updating_sequence:
             if name not in self.coordinates:
                 raise ValueError(
@@ -304,6 +323,9 @@ class ScoringParams:
     task: str = "LOGISTIC_REGRESSION"
     evaluate: bool = False  # requires labels in the input
     sparse: bool = False
+    # GAME only: shards stored sparse (must match how the model was
+    # trained structurally — fixed-effect shards only)
+    sparse_shards: List[str] = dataclasses.field(default_factory=list)
     date_range: Optional[str] = None
     date_range_days_ago: Optional[str] = None
     field_names: str = "TRAINING_EXAMPLE"

@@ -307,7 +307,8 @@ def run_game_training(params) -> GameTrainingRun:
             }
         )
         data, entity_vocabs, _uids, _present = source.game_data(
-            shard_vocabs, entity_keys
+            shard_vocabs, entity_keys,
+            sparse_shards=set(params.sparse_shards),
         )
         logger.info(f"read {len(data.labels)} training records")
         entity_counts = {k: len(v) for k, v in entity_vocabs.items()}
@@ -322,7 +323,8 @@ def run_game_training(params) -> GameTrainingRun:
                 expand_date_paths(params.validate_input, date_range),
                 params.field_names,
             ).game_data(
-                shard_vocabs, entity_keys, entity_vocabs=entity_vocabs
+                shard_vocabs, entity_keys, entity_vocabs=entity_vocabs,
+                sparse_shards=set(params.sparse_shards),
             )
             logger.info(f"read {len(vdata.labels)} validation records")
 

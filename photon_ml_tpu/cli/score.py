@@ -287,6 +287,7 @@ def run_scoring(params) -> ScoringRun:
                 entity_keys,
                 entity_vocabs=re_vocabs,
                 allow_null_labels=True,
+                sparse_shards=set(params.sparse_shards),
             )
             margins = (
                 score_game_data(model_params, shards, random_effects, data)

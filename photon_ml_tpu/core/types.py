@@ -83,25 +83,9 @@ class LabeledBatch:
         mask=None,
         dtype=jnp.float32,
     ) -> "LabeledBatch":
-        from photon_ml_tpu.ops.sparse import is_hybrid, is_sparse
+        from photon_ml_tpu.ops.sparse import cast_values
 
-        if is_hybrid(features):
-            features = dataclasses.replace(
-                features,
-                dense=jnp.asarray(features.dense, dtype),
-                cold_segments=tuple(
-                    dataclasses.replace(
-                        seg, values=jnp.asarray(seg.values, dtype)
-                    )
-                    for seg in features.cold_segments
-                ),
-            )
-        elif is_sparse(features):
-            features = dataclasses.replace(
-                features, values=jnp.asarray(features.values, dtype)
-            )
-        else:
-            features = jnp.asarray(features, dtype)
+        features = cast_values(features, dtype)
         n = features.shape[-2]
         labels = jnp.asarray(labels, dtype)
         offsets = jnp.zeros((n,), dtype) if offsets is None else jnp.asarray(offsets, dtype)

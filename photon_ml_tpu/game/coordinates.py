@@ -227,9 +227,11 @@ class FixedEffectCoordinate:
         # expected keep count plus a 6-sigma margin, so every pass reuses
         # one compilation. The dense path only — gathering padded-ELL rows
         # is the sparse container's own re-pack problem.
+        from photon_ml_tpu.ops.sparse import is_structured
+
         self._ds_budget = None
-        if self._downsample is not None and not hasattr(
-            batch.features, "values"
+        if self._downsample is not None and not is_structured(
+            batch.features
         ):
             self._ds_budget = _downsample_budget(
                 np.asarray(batch.labels),

@@ -4,8 +4,12 @@ Rebuild of the reference's L5 (``data/GameDatum.scala:32``,
 ``data/FixedEffectDataSet.scala``, ``data/RandomEffectDataSet.scala:39-381``,
 ``data/LocalDataSet.scala``). A GAME dataset here is:
 
-  - feature shards: dict shard_id -> dense (n, d_shard) matrix (the
-    reference's featureShardContainer, one Breeze vector per row per shard)
+  - feature shards: dict shard_id -> dense (n, d_shard) matrix, or a
+    padded-ELL ``ops.sparse.SparseFeatures`` for wide shards (the
+    reference's featureShardContainer, one Breeze vector per row per
+    shard — sparse Breeze vectors map to the ELL container). Sparse
+    shards serve FIXED-EFFECT coordinates; per-entity designs need the
+    dense row gather and reject them.
   - response/offset/weight columns (n,)
   - entity columns: dict random_effect_id -> (n,) int32 entity indices
     (index -1 = entity unseen at vocabulary build; scores 0 like the
@@ -54,16 +58,28 @@ class GameData:
         weights=None,
         entity_ids: Optional[Mapping[str, np.ndarray]] = None,
     ) -> "GameData":
+        from photon_ml_tpu.ops.sparse import is_hybrid, is_structured
+
         labels = np.asarray(labels, np.float64)
         n = labels.shape[0]
         for name, v in {**features, **(entity_ids or {})}.items():
-            if np.shape(v)[0] != n:
+            if is_hybrid(v):
+                # hybrid rows are permuted relative to every other column;
+                # GAME joins shards/entities/scores BY ROW
                 raise ValueError(
-                    f"column {name!r} has {np.shape(v)[0]} rows, labels "
-                    f"have {n}"
+                    f"shard {name!r} is a HybridFeatures container; GAME "
+                    "shards must be dense or plain ELL (row-aligned)"
+                )
+            rows = v.shape[0] if is_structured(v) else np.shape(v)[0]
+            if rows != n:
+                raise ValueError(
+                    f"column {name!r} has {rows} rows, labels have {n}"
                 )
         return GameData(
-            features={k: np.asarray(v) for k, v in features.items()},
+            features={
+                k: (v if is_structured(v) else np.asarray(v))
+                for k, v in features.items()
+            },
             labels=labels,
             offsets=(
                 np.zeros(n) if offsets is None else np.asarray(offsets, np.float64)
@@ -275,6 +291,14 @@ def build_random_effect_design(
     One global row cap means one hot entity inflates padding for all; use
     :func:`build_bucketed_random_effect_design` when entity sizes are skewed.
     """
+    from photon_ml_tpu.ops.sparse import is_structured
+
+    if is_structured(data.features[shard]):
+        raise ValueError(
+            f"random effect {random_effect!r}: per-entity designs gather "
+            f"dense rows; shard {shard!r} is sparse (sparse shards serve "
+            "fixed-effect coordinates only)"
+        )
     eids = np.asarray(data.entity_ids[random_effect])
     if active_cap is not None and active_cap <= 0:
         raise ValueError(f"active_cap must be positive, got {active_cap}")
@@ -396,6 +420,14 @@ def build_bucketed_random_effect_design(
     count (still bounded by `active_cap`, with the same weight-preserving
     rescale). `entity_multiple` pads each bucket's entity axis up to a
     multiple (the entity-mesh-axis size) so buckets shard evenly."""
+    from photon_ml_tpu.ops.sparse import is_structured
+
+    if is_structured(data.features[shard]):
+        raise ValueError(
+            f"random effect {random_effect!r}: per-entity designs gather "
+            f"dense rows; shard {shard!r} is sparse (sparse shards serve "
+            "fixed-effect coordinates only)"
+        )
     eids = np.asarray(data.entity_ids[random_effect])
     if active_cap is not None and active_cap <= 0:
         raise ValueError(f"active_cap must be positive, got {active_cap}")

@@ -22,7 +22,9 @@ from photon_ml_tpu.game.data import GameData
 
 @jax.jit
 def _fixed_scores(w, feats):
-    return feats @ w
+    from photon_ml_tpu.ops.sparse import matvec
+
+    return matvec(feats, w)
 
 
 @jax.jit
@@ -53,12 +55,29 @@ def score_game_data(
     data offsets; add ``data.offsets`` for the full margin). Rows whose
     entity is unknown to a random effect contribute 0 for that coordinate
     (``RandomEffectModel.scala:117-146``)."""
+    from photon_ml_tpu.ops.sparse import cast_values, is_structured
+
     n = data.num_rows
     total = jnp.zeros((n,), dtype)
+    from photon_ml_tpu.ops.sparse import is_hybrid
+
     for name, p in params.items():
         shard = shards[name]
-        feats = jnp.asarray(data.features[shard], dtype)
+        raw = data.features[shard]
+        if is_hybrid(raw):
+            # HybridFeatures rows live in a permuted order private to the
+            # GLM training batch; GAME scoring sums coordinates by ROW
+            raise ValueError(
+                f"shard {shard!r} is a HybridFeatures container; GAME "
+                "shards must be dense or plain ELL (row-aligned)"
+            )
+        feats = cast_values(raw, dtype)
         re_key = random_effects.get(name)
+        if re_key is not None and is_structured(raw):
+            raise ValueError(
+                f"coordinate {name!r}: random/factored effects need the "
+                f"dense per-row gather; shard {shard!r} is sparse"
+            )
         if re_key is None:
             total = total + _fixed_scores(jnp.asarray(p, dtype), feats)
         elif hasattr(p, "gamma"):  # FactoredParams

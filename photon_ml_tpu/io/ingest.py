@@ -196,22 +196,77 @@ def index_entity_strings(
     return entity_ids, out_vocabs
 
 
+def _inject_intercept(rows, cols, vals, n, intercept_index):
+    """Append one (row, intercept, 1.0) triplet per row — the shared
+    intercept-column injection (the decoders skip intercept-aliasing raw
+    features, so the column is otherwise empty)."""
+    if intercept_index is None:
+        return rows, cols, vals
+    return (
+        np.concatenate([rows, np.arange(n, dtype=np.int64)]),
+        np.concatenate(
+            [cols, np.full(n, intercept_index, dtype=np.int64)]
+        ),
+        np.concatenate([vals, np.ones(n)]),
+    )
+
+
+def _assemble_shard_features(
+    shard_vocabs: Dict[str, "FeatureVocabulary"],
+    shard_triplets: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    n: int,
+    sparse_shards: Optional[set] = None,
+):
+    """COO triplets per shard -> dense (n, d) matrices, or padded-ELL
+    ``SparseFeatures`` for shards named in ``sparse_shards`` (wide
+    fixed-effect bags). The intercept column (if the vocabulary has one)
+    is injected as value 1.0 either way. Everything stays HOST-side
+    (float64); device placement/casting happens once per consumer
+    (``fixed_effect_batch`` / ``score_game_data``)."""
+    sparse_shards = sparse_shards or set()
+    unknown = sparse_shards - set(shard_vocabs)
+    if unknown:
+        raise ValueError(f"sparse_shards not in shard_vocabs: {unknown}")
+    features: Dict[str, object] = {}
+    for shard, vocab in shard_vocabs.items():
+        rows, cols, vals = shard_triplets[shard]
+        rows, cols, vals = _inject_intercept(
+            rows, cols, vals, n, vocab.intercept_index
+        )
+        if shard in sparse_shards:
+            from photon_ml_tpu.ops.sparse import from_coo
+
+            features[shard] = from_coo(
+                rows, cols, vals, n, len(vocab),
+                dtype=np.float64, as_numpy=True,
+            )
+        else:
+            x = np.zeros((n, len(vocab)), np.float64)
+            np.add.at(
+                x, (rows.astype(np.int64), cols.astype(np.int64)), vals
+            )
+            features[shard] = x
+    return features
+
+
 def game_data_from_avro(
     records: List[dict],
     shard_vocabs: Dict[str, "FeatureVocabulary"],
     entity_keys: List[str],
     entity_vocabs: Optional[Dict[str, dict]] = None,
     allow_null_labels: bool = False,
+    sparse_shards: Optional[set] = None,
 ):
     """TrainingExampleAvro records -> (GameData, entity_vocabs, uids).
 
     The GAME analog of ``DataProcessingUtils.getGameDataSetFromGenericRecords``
     (``DataProcessingUtils.scala:34-131``): each feature shard gets its own
-    (n, d_shard) matrix indexed by its vocabulary (a feature lands in every
-    shard whose vocabulary contains it — the reference's section-key bags),
-    and each entity key is read from the record's metadataMap into an int32
-    index column (unknown entity -> -1, scoring 0). When ``entity_vocabs``
-    is given (scoring against a trained model) it is applied; otherwise
+    (n, d_shard) matrix — padded-ELL for shards in ``sparse_shards`` —
+    indexed by its vocabulary (a feature lands in every shard whose
+    vocabulary contains it — the reference's section-key bags), and each
+    entity key is read from the record's metadataMap into an int32 index
+    column (unknown entity -> -1, scoring 0). When ``entity_vocabs`` is
+    given (scoring against a trained model) it is applied; otherwise
     vocabularies are built from the data (training).
     """
     from photon_ml_tpu.game.data import GameData
@@ -221,9 +276,8 @@ def game_data_from_avro(
     offsets = np.zeros(n, np.float64)
     weights = np.ones(n, np.float64)
     uids: List[Optional[str]] = []
-    features = {
-        shard: np.zeros((n, len(vocab)), np.float64)
-        for shard, vocab in shard_vocabs.items()
+    triplets: Dict[str, Tuple[list, list, list]] = {
+        shard: ([], [], []) for shard in shard_vocabs
     }
     raw_entities: Dict[str, List[str]] = {k: [] for k in entity_keys}
     for i, rec in enumerate(records):
@@ -241,10 +295,23 @@ def game_data_from_avro(
             for shard, vocab in shard_vocabs.items():
                 j = vocab.key_to_index.get(key)
                 if j is not None and j != vocab.intercept_index:
-                    features[shard][i, j] += f["value"]
-    for shard, vocab in shard_vocabs.items():
-        if vocab.intercept_index is not None:
-            features[shard][:, vocab.intercept_index] = 1.0
+                    r, c, v = triplets[shard]
+                    r.append(i)
+                    c.append(j)
+                    v.append(f["value"])
+    features = _assemble_shard_features(
+        shard_vocabs,
+        {
+            shard: (
+                np.asarray(r, np.int64),
+                np.asarray(c, np.int64),
+                np.asarray(v, np.float64),
+            )
+            for shard, (r, c, v) in triplets.items()
+        },
+        n,
+        sparse_shards,
+    )
 
     entity_ids, out_vocabs = index_entity_strings(
         {k: np.asarray(v, object) for k, v in raw_entities.items()},
@@ -448,13 +515,9 @@ class IngestSource:
             return batch, uids, present
         n = out["n"]
         rows, cols, vals = out["coo"][0]
-        icpt = vocab.intercept_index
-        if icpt is not None:
-            rows = np.concatenate([rows, np.arange(n, dtype=rows.dtype)])
-            cols = np.concatenate(
-                [cols, np.full(n, icpt, dtype=cols.dtype)]
-            )
-            vals = np.concatenate([vals, np.ones(n)])
+        rows, cols, vals = _inject_intercept(
+            rows, cols, vals, n, vocab.intercept_index
+        )
         if sparse:
             from photon_ml_tpu.ops.sparse import from_coo
 
@@ -484,6 +547,7 @@ class IngestSource:
         entity_keys: List[str],
         entity_vocabs: Optional[Dict[str, dict]] = None,
         allow_null_labels: bool = False,
+        sparse_shards: Optional[set] = None,
     ):
         """-> (GameData, entity_vocabs, uids, label_present)."""
         shards = list(shard_vocabs)
@@ -502,6 +566,7 @@ class IngestSource:
                 entity_keys,
                 entity_vocabs=entity_vocabs,
                 allow_null_labels=allow_null_labels,
+                sparse_shards=sparse_shards,
             )
             present = np.asarray(
                 [r.get("label") is not None for r in recs], bool
@@ -510,17 +575,15 @@ class IngestSource:
         from photon_ml_tpu.game.data import GameData
 
         n = out["n"]
-        features = {}
-        for si, shard in enumerate(shards):
-            vocab = shard_vocabs[shard]
-            rows, cols, vals = out["coo"][si]
-            x = np.zeros((n, len(vocab)), np.float64)
-            np.add.at(
-                x, (rows.astype(np.int64), cols.astype(np.int64)), vals
-            )
-            if vocab.intercept_index is not None:
-                x[:, vocab.intercept_index] = 1.0
-            features[shard] = x
+        features = _assemble_shard_features(
+            shard_vocabs,
+            {
+                shard: out["coo"][si]
+                for si, shard in enumerate(shards)
+            },
+            n,
+            sparse_shards,
+        )
         entity_ids, out_vocabs = index_entity_strings(
             {k: out["entities"][k] for k in entity_keys}, entity_vocabs
         )

@@ -174,6 +174,30 @@ def is_structured(x) -> bool:
     return is_sparse(x) or is_hybrid(x)
 
 
+def cast_values(x, dtype):
+    """Representation-preserving device cast: plain arrays, ELL values,
+    or hybrid slab+cold values to ``dtype``. The one place that knows how
+    to move every feature container to the device at a target precision."""
+    if is_hybrid(x):
+        return dataclasses.replace(
+            x,
+            dense=jnp.asarray(x.dense, dtype),
+            cold_segments=tuple(
+                dataclasses.replace(
+                    seg, values=jnp.asarray(seg.values, dtype)
+                )
+                for seg in x.cold_segments
+            ),
+        )
+    if is_sparse(x):
+        return dataclasses.replace(
+            x,
+            indices=jnp.asarray(x.indices),
+            values=jnp.asarray(x.values, dtype),
+        )
+    return jnp.asarray(x, dtype)
+
+
 def matvec(x, w: jax.Array) -> jax.Array:
     """margins contraction: (n, d) @ (d,) -> (n,). Hybrid output is in
     STORED (permuted) row order, matching the permuted batch."""
@@ -309,10 +333,13 @@ def from_coo(
     num_cols: int,
     nnz_per_row: int = 0,
     dtype=jnp.float32,
+    as_numpy: bool = False,
 ) -> SparseFeatures:
     """Build from COO triplets (host-side). Duplicate (row, col) entries are
     summed (the reference's dedup-by-sum, ``DataProcessingUtils.scala:70-76``).
-    ``nnz_per_row`` pads/caps the row width; 0 means the max observed."""
+    ``nnz_per_row`` pads/caps the row width; 0 means the max observed.
+    ``as_numpy`` keeps the buffers host-side (no device placement) for
+    containers that are re-cast per consumer (e.g. GAME shards)."""
     rows = np.asarray(rows, np.int64)
     cols = np.asarray(cols, np.int64)
     vals = np.asarray(vals, np.float64)
@@ -338,6 +365,12 @@ def from_coo(
     slot = np.arange(uniq.size) - starts[r]
     indices[r, slot] = c
     values[r, slot] = summed
+    if as_numpy:
+        return SparseFeatures(
+            indices=indices.astype(np.int32),
+            values=values.astype(np.dtype(jnp.dtype(dtype))),
+            d=num_cols,
+        )
     return SparseFeatures(
         indices=jnp.asarray(indices, jnp.int32),
         values=jnp.asarray(values, dtype),

@@ -352,6 +352,90 @@ class TestEmptyInput:
             _force_fallback(IngestSource([path])).labeled_batch(vocab)
 
 
+class TestCorruptInput:
+    """A native decoder must fail CLEANLY on malformed bytes — raise a
+    Python exception, never crash or mis-decode silently."""
+
+    @pytest.fixture()
+    def valid_file(self, tmp_path):
+        recs = _records(40)
+        path = str(tmp_path / "ok.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs, codec="deflate")
+        return path
+
+    def _vocab(self):
+        return FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+
+    def test_truncated_everywhere(self, valid_file, tmp_path):
+        raw = open(valid_file, "rb").read()
+        # cut points: inside header, inside block framing, inside payload
+        for frac in (0.05, 0.3, 0.6, 0.9, 0.99):
+            cut = int(len(raw) * frac)
+            p = str(tmp_path / f"cut{cut}.avro")
+            with open(p, "wb") as f:
+                f.write(raw[:cut])
+            with pytest.raises((ValueError, EOFError, KeyError)):
+                native.read_columnar([p], [self._vocab()])
+
+    def test_flipped_payload_bytes(self, valid_file, tmp_path):
+        raw = bytearray(open(valid_file, "rb").read())
+        # corrupt deflate payload mid-file: decompression or sync check
+        # must catch it
+        mid = len(raw) // 2
+        for i in range(mid, min(mid + 40, len(raw))):
+            raw[i] ^= 0xFF
+        p = str(tmp_path / "flip.avro")
+        with open(p, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(ValueError):
+            native.read_columnar([p], [self._vocab()])
+
+    def test_bad_magic(self, tmp_path):
+        p = str(tmp_path / "junk.avro")
+        with open(p, "wb") as f:
+            f.write(b"NOPE" + b"\x00" * 64)
+        with pytest.raises(ValueError, match="not an Avro container"):
+            native.read_columnar([p], [self._vocab()])
+
+    def test_lying_block_count(self, valid_file, tmp_path):
+        """A block declaring more records than its payload holds must
+        error (the C++ Slice guards), not read out of bounds."""
+        from photon_ml_tpu.io.avro import (
+            MAGIC,
+            _decode_bytes,
+            _decode_long,
+            _encode_long,
+        )
+        import io as _io
+
+        raw = open(valid_file, "rb").read()
+        buf = _io.BytesIO(raw)
+        assert buf.read(4) == MAGIC
+        while True:
+            count = _decode_long(buf)
+            if count == 0:
+                break
+            for _ in range(count):
+                _decode_bytes(buf)
+                _decode_bytes(buf)
+        buf.read(16)
+        header_end = buf.tell()
+        block_count = _decode_long(buf)
+        rest_pos = buf.tell()
+        forged = (
+            raw[:header_end]
+            + _encode_long(block_count * 1000)
+            + raw[rest_pos:]
+        )
+        p = str(tmp_path / "forged.avro")
+        with open(p, "wb") as f:
+            f.write(forged)
+        with pytest.raises(ValueError, match="native decode failed"):
+            native.read_columnar([p], [self._vocab()])
+
+
 class TestNativeWriter:
     def _roundtrip(self, tmp_path, codec):
         from photon_ml_tpu.io.avro import read_avro_file

@@ -1,0 +1,194 @@
+"""Sparse feature shards in GAME: the wide fixed-effect bag regime.
+
+The reference's featureShardContainer holds (sparse) Breeze vectors per
+shard; our analog stores a shard as padded-ELL ``SparseFeatures``. A
+sparse shard must train/score the fixed-effect coordinate identically to
+its dense twin, while per-entity (random/factored/projected) coordinates
+reject it loudly — they gather dense rows."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.game_train import run_game_training
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.ingest import IngestSource, make_training_example
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+from photon_ml_tpu.ops.sparse import is_sparse, to_dense
+
+
+@pytest.fixture()
+def game_files(rng, tmp_path):
+    n, d_global, d_user = 500, 24, 4
+    recs = []
+    for i in range(n):
+        feats = {}
+        for j in rng.choice(d_global, 6, replace=False):
+            feats[(f"g{j}", "")] = float(rng.normal())
+        for j in range(d_user):
+            feats[(f"u{j}", "")] = float(rng.normal())
+        rec = make_training_example(label=float(i % 2), features=feats)
+        rec["metadataMap"] = {"userId": f"user{i % 20}"}
+        recs.append(rec)
+    write_avro_file(
+        str(tmp_path / "train" / "p.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+    )
+    gvocab = tmp_path / "global.txt"
+    gvocab.write_text(
+        "".join(f"g{j}\x01\n" for j in range(d_global)) + "(INTERCEPT)\x01\n"
+    )
+    uvocab = tmp_path / "user.txt"
+    uvocab.write_text("".join(f"u{j}\x01\n" for j in range(d_user)))
+    return tmp_path, str(gvocab), str(uvocab)
+
+
+def _params(tmp_path, gvocab, uvocab, out, sparse_shards):
+    return {
+        "train_input": [str(tmp_path / "train")],
+        "validate_input": [str(tmp_path / "train")],
+        "output_dir": str(tmp_path / out),
+        "task": "LOGISTIC_REGRESSION",
+        "num_iterations": 2,
+        "updating_sequence": ["global", "per-user"],
+        "feature_shards": {"globalShard": gvocab, "userShard": uvocab},
+        "coordinates": {
+            "global": {
+                "shard": "globalShard",
+                "optimizer": "TRON",
+                "reg_weights": [1.0],
+                "max_iters": 40,
+                "tolerance": 1e-9,
+            },
+            "per-user": {
+                "shard": "userShard",
+                "optimizer": "TRON",
+                "reg_weights": [1.0],
+                "random_effect": "userId",
+                "max_iters": 40,
+                "tolerance": 1e-9,
+            },
+        },
+        "sparse_shards": sparse_shards,
+    }
+
+
+class TestSparseShardIngest:
+    def test_game_data_matches_dense(self, game_files):
+        tmp_path, gvocab, uvocab = game_files
+        vocabs = {
+            "globalShard": FeatureVocabulary.load(gvocab),
+            "userShard": FeatureVocabulary.load(uvocab),
+        }
+        src = IngestSource([str(tmp_path / "train")])
+        dense, _, _, _ = src.game_data(vocabs, ["userId"])
+        sp, _, _, _ = IngestSource([str(tmp_path / "train")]).game_data(
+            vocabs, ["userId"], sparse_shards={"globalShard"}
+        )
+        assert is_sparse(sp.features["globalShard"])
+        assert not is_sparse(sp.features["userShard"])
+        np.testing.assert_allclose(
+            to_dense(sp.features["globalShard"]),
+            np.asarray(dense.features["globalShard"]),
+            rtol=1e-12,
+        )
+        # fallback (Python codec) agrees too
+        fb = IngestSource([str(tmp_path / "train")])
+        fb._native = lambda: None
+        sp2, _, _, _ = fb.game_data(
+            vocabs, ["userId"], sparse_shards={"globalShard"}
+        )
+        np.testing.assert_allclose(
+            to_dense(sp2.features["globalShard"]),
+            np.asarray(dense.features["globalShard"]),
+            rtol=1e-12,
+        )
+
+
+class TestSparseShardTraining:
+    def test_fixed_effect_sparse_matches_dense(self, game_files):
+        tmp_path, gvocab, uvocab = game_files
+        r_dense = run_game_training(
+            _params(tmp_path, gvocab, uvocab, "out_dense", [])
+        )
+        r_sparse = run_game_training(
+            _params(tmp_path, gvocab, uvocab, "out_sparse", ["globalShard"])
+        )
+        md = r_dense.sweep[r_dense.best_index]
+        ms = r_sparse.sweep[r_sparse.best_index]
+        np.testing.assert_allclose(
+            np.asarray(ms["model"].params["global"]),
+            np.asarray(md["model"].params["global"]),
+            rtol=1e-6, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ms["model"].params["per-user"]),
+            np.asarray(md["model"].params["per-user"]),
+            rtol=1e-6, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            ms["validation_metric"], md["validation_metric"], rtol=1e-8
+        )
+
+    def test_scoring_driver_with_sparse_shard(self, game_files):
+        from photon_ml_tpu.cli.score import run_scoring
+
+        tmp_path, gvocab, uvocab = game_files
+        run_game_training(
+            _params(tmp_path, gvocab, uvocab, "m", ["globalShard"])
+        )
+        s_sparse = run_scoring(
+            {
+                "input": [str(tmp_path / "train")],
+                "model_dir": str(tmp_path / "m"),
+                "output_dir": str(tmp_path / "sc_sparse"),
+                "model_kind": "game",
+                "evaluate": True,
+                "sparse_shards": ["globalShard"],
+            }
+        )
+        s_dense = run_scoring(
+            {
+                "input": [str(tmp_path / "train")],
+                "model_dir": str(tmp_path / "m"),
+                "output_dir": str(tmp_path / "sc_dense"),
+                "model_kind": "game",
+                "evaluate": True,
+            }
+        )
+        np.testing.assert_allclose(
+            s_sparse.scores, s_dense.scores, rtol=1e-9
+        )
+        for k, v in s_dense.metrics.items():
+            np.testing.assert_allclose(s_sparse.metrics[k], v, rtol=1e-9)
+
+
+class TestSparseShardGuards:
+    def test_random_effect_on_sparse_shard_rejected(self, game_files):
+        tmp_path, gvocab, uvocab = game_files
+        params = _params(
+            tmp_path, gvocab, uvocab, "out_bad", ["userShard"]
+        )
+        with pytest.raises(ValueError, match="dense per-row features"):
+            run_game_training(params)
+
+    def test_design_builder_guard(self, game_files):
+        from photon_ml_tpu.game.data import (
+            GameData,
+            build_bucketed_random_effect_design,
+            build_random_effect_design,
+        )
+        from photon_ml_tpu.ops.sparse import from_dense
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(20, 5))
+        data = GameData.create(
+            features={"s": from_dense(x)},
+            labels=np.zeros(20),
+            entity_ids={"u": np.zeros(20, np.int32)},
+        )
+        for builder in (
+            build_random_effect_design,
+            build_bucketed_random_effect_design,
+        ):
+            with pytest.raises(ValueError, match="sparse"):
+                builder(data, "u", "s", 1)
