@@ -90,12 +90,17 @@ def _build_and_load() -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
             if proc.returncode != 0:
                 return None, f"native build failed: {proc.stderr[-2000:]}"
         lib = ctypes.CDLL(_SO)
+        lib.pml_vocabset_new.restype = ctypes.c_void_p
+        lib.pml_vocabset_new.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.pml_vocabset_free.argtypes = [ctypes.c_void_p]
         lib.pml_reader_new.restype = ctypes.c_void_p
         lib.pml_reader_new.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_void_p,
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int32, ctypes.c_int32,
         ]
@@ -110,6 +115,11 @@ def _build_and_load() -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
         lib.pml_reader_feed.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int32,
+        ]
+        lib.pml_reader_feed_blocks.restype = ctypes.c_int64
+        lib.pml_reader_feed_blocks.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_char_p,
         ]
         lib.pml_reader_nrecords.restype = ctypes.c_int64
         lib.pml_reader_nrecords.argtypes = [ctypes.c_void_p]
@@ -301,10 +311,67 @@ def _u8p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
+class NativeVocabSet:
+    """Immutable native vocabulary hash maps, built ONCE per ingest and
+    shared read-only by every per-file reader (and thread).
+
+    vocab_keys: per vocabulary, the ordered feature keys (name\\x01term),
+    transported as one byte blob + explicit offsets — never joined by a
+    separator byte, so feature names may contain any character."""
+
+    def __init__(
+        self,
+        vocab_keys: Sequence[Sequence[str]],
+        vocab_intercepts: Sequence[int],
+    ):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError(f"native reader unavailable: {_lib_error}")
+        self._lib = lib
+        self.nvocabs = len(vocab_keys)
+        key_bytes = [
+            k.encode("utf-8") for keys in vocab_keys for k in keys
+        ]
+        vocab_blob = b"".join(key_bytes)
+        key_offsets = np.zeros(len(key_bytes) + 1, np.int64)
+        np.cumsum([len(b) for b in key_bytes], out=key_offsets[1:])
+        vocab_counts = np.asarray(
+            [len(k) for k in vocab_keys], np.int32
+        )
+        intercepts = np.asarray(
+            [(-1 if i is None else i) for i in vocab_intercepts], np.int32
+        )
+        self._handle = lib.pml_vocabset_new(
+            vocab_blob,
+            _i64p(key_offsets),
+            _i32p(vocab_counts) if self.nvocabs else _i32p(np.zeros(1, np.int32)),
+            _i32p(intercepts) if self.nvocabs else _i32p(np.zeros(1, np.int32)),
+            self.nvocabs,
+        )
+        if not self._handle:
+            raise RuntimeError("pml_vocabset_new failed")
+
+    @property
+    def handle(self):
+        return self._handle
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.pml_vocabset_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover — best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class NativeAvroReader:
     """Streams Avro container files into native columnar accumulators.
 
-    vocab_keys: per vocabulary, the ordered feature keys (name\\x01term).
+    vocabset: a NativeVocabSet (may be shared across readers; must stay
+    alive for this reader's lifetime).
     entity_keys: metadataMap keys to extract as per-row string columns.
     """
 
@@ -312,8 +379,7 @@ class NativeAvroReader:
         self,
         field_prog: np.ndarray,
         feat_desc: np.ndarray,
-        vocab_keys: Sequence[Sequence[str]],
-        vocab_intercepts: Sequence[int],
+        vocabset: NativeVocabSet,
         entity_keys: Sequence[str] = (),
         collect_keys: bool = False,
     ):
@@ -321,20 +387,8 @@ class NativeAvroReader:
         if lib is None:
             raise RuntimeError(f"native reader unavailable: {_lib_error}")
         self._lib = lib
-        self._nvocabs = len(vocab_keys)
+        self._nvocabs = vocabset.nvocabs
         self._nentities = len(entity_keys)
-        # keys travel as one byte blob + explicit offsets, never joined by
-        # a separator byte — feature names may contain any character.
-        key_bytes = [
-            k.encode("utf-8") for keys in vocab_keys for k in keys
-        ]
-        vocab_blob = b"".join(key_bytes)
-        key_offsets = np.zeros(len(key_bytes) + 1, np.int64)
-        np.cumsum([len(b) for b in key_bytes], out=key_offsets[1:])
-        vocab_counts = np.asarray([len(k) for k in vocab_keys], np.int32)
-        intercepts = np.asarray(
-            [(-1 if i is None else i) for i in vocab_intercepts], np.int32
-        )
         ent_bytes = [k.encode("utf-8") for k in entity_keys]
         entity_blob = b"".join(ent_bytes)
         entity_offsets = np.zeros(len(ent_bytes) + 1, np.int64)
@@ -343,11 +397,7 @@ class NativeAvroReader:
             _i32p(np.ascontiguousarray(field_prog)),
             len(field_prog),
             _i32p(np.ascontiguousarray(feat_desc)),
-            vocab_blob,
-            _i64p(key_offsets),
-            _i32p(vocab_counts) if self._nvocabs else _i32p(np.zeros(1, np.int32)),
-            _i32p(intercepts) if self._nvocabs else _i32p(np.zeros(1, np.int32)),
-            self._nvocabs,
+            vocabset.handle,
             entity_blob,
             _i64p(entity_offsets),
             self._nentities,
@@ -355,8 +405,8 @@ class NativeAvroReader:
         )
         if not self._handle:
             raise RuntimeError("pml_reader_new failed")
-        # keep buffers alive for the handle's lifetime
-        self._keepalive = (vocab_blob, entity_blob, key_offsets, entity_offsets)
+        # the vocab set must outlive the reader (C side is non-owning)
+        self._keepalive = (vocabset, entity_blob, entity_offsets)
 
     def feed_file(self, path: str, expected_schema: Optional[dict] = None):
         """Parse container framing (header, sync markers) in Python; hand
@@ -392,19 +442,16 @@ class NativeAvroReader:
         if codec is None:
             raise ValueError(f"unsupported codec {codec_name!r}")
         sync = buf.read(16)
-        size = len(raw)
-        while buf.tell() < size:
-            count = _decode_long(buf)
-            nbytes = _decode_long(buf)
-            payload = buf.read(nbytes)
-            got = self._lib.pml_reader_feed(
-                self._handle, payload, nbytes, count, codec
-            )
-            if got < 0:
-                err = self._lib.pml_reader_error(self._handle).decode()
-                raise ValueError(f"{path}: native decode failed: {err}")
-            if buf.read(16) != sync:
-                raise ValueError(f"{path}: bad sync marker (corrupt file)")
+        # the whole body decodes in ONE C call: block framing, sync
+        # verification, inflate, and record decode all run with the GIL
+        # released, so multi-file ingest parallelizes across threads
+        body = raw[buf.tell():]
+        got = self._lib.pml_reader_feed_blocks(
+            self._handle, body, len(body), codec, sync
+        )
+        if got < 0:
+            err = self._lib.pml_reader_error(self._handle).decode()
+            raise ValueError(f"{path}: native decode failed: {err}")
         return json.loads(meta["avro.schema"])
 
     # -- extraction ---------------------------------------------------------
@@ -527,8 +574,9 @@ def scan_feature_keys(
     field_prog, feat_desc = compile_schema(
         schema, label_field=label_field, want_entities=False
     )
+    vocabset = NativeVocabSet([], [])
     reader = NativeAvroReader(
-        field_prog, feat_desc, [], [], (), collect_keys=True
+        field_prog, feat_desc, vocabset, (), collect_keys=True
     )
     try:
         for p in paths:
@@ -536,6 +584,7 @@ def scan_feature_keys(
         return reader.distinct_keys()
     finally:
         reader.close()
+        vocabset.close()
 
 
 # write ops (must mirror native/avro_reader.cpp)
@@ -681,6 +730,25 @@ def write_columnar_avro(
         raise IOError(f"native Avro write failed (rc={rc}) for {path}")
 
 
+def _extract_columns(reader: NativeAvroReader, entity_keys, nvocabs):
+    n = reader.num_records
+    labels, label_seen = reader.scalar(COL_LABEL)
+    offsets, _ = reader.scalar(COL_OFFSET)
+    weights, w_seen = reader.scalar(COL_WEIGHT)
+    return {
+        "n": n,
+        "labels": labels,
+        "label_present": label_seen,
+        "offsets": offsets,
+        "weights": np.where(w_seen, weights, 1.0),
+        "uids": reader.uids(),
+        "entities": {
+            k: reader.entities(i) for i, k in enumerate(entity_keys)
+        },
+        "coo": [reader.coo(i) for i in range(nvocabs)],
+    }
+
+
 def read_columnar(
     paths: Sequence[str],
     vocabs: Sequence,
@@ -688,6 +756,7 @@ def read_columnar(
     *,
     label_field: str = "label",
     allow_null_labels: bool = False,
+    max_workers: Optional[int] = None,
 ) -> Dict[str, object]:
     """Read Avro files into columnar arrays with native decode + vocab join.
 
@@ -699,48 +768,89 @@ def read_columnar(
     1.0/0.0, null labels only allowed when ``allow_null_labels`` (scoring),
     features missing from a vocabulary are dropped, intercept column left
     for the caller to inject (as ingest does).
+
+    Multi-file inputs decode in PARALLEL (one native reader per file;
+    ctypes releases the GIL during the C++ decode — the executor-side
+    parallelism of the reference's Spark ingest on one host), and the
+    per-file columns concatenate in path order so output row order is
+    identical to a sequential read.
     """
     if not paths:
         raise FileNotFoundError("no input files")
-    # compile against the first file's writer schema
+    # compile against the first file's writer schema; the vocab hash maps
+    # build ONCE and are shared read-only across per-file readers
     schema = _read_header_schema(paths[0])
     field_prog, feat_desc = compile_schema(
         schema, label_field=label_field, want_entities=bool(entity_keys)
     )
-    reader = NativeAvroReader(
-        field_prog,
-        feat_desc,
+    vocabset = NativeVocabSet(
         [v.index_to_key for v in vocabs],
         [v.intercept_index for v in vocabs],
-        entity_keys,
     )
-    try:
-        for p in paths:
-            reader.feed_file(p, expected_schema=schema)
-        n = reader.num_records
-        labels, label_seen = reader.scalar(COL_LABEL)
-        if not allow_null_labels and not label_seen.all():
-            i = int(np.argmin(label_seen))
+
+    def check_labels(part, path):
+        if not allow_null_labels and not part["label_present"].all():
+            i = int(np.argmin(part["label_present"]))
             raise ValueError(
-                f"record {i} has a null/missing label; training input "
-                "requires labels (pass allow_null_labels=True only for "
-                "scoring)"
+                f"record {i} of {path} has a null/missing label; training "
+                "input requires labels (pass allow_null_labels=True only "
+                "for scoring)"
             )
-        offsets, _ = reader.scalar(COL_OFFSET)
-        weights, w_seen = reader.scalar(COL_WEIGHT)
-        weights = np.where(w_seen, weights, 1.0)
-        out: Dict[str, object] = {
-            "n": n,
-            "labels": labels,
-            "label_present": label_seen,
-            "offsets": offsets,
-            "weights": weights,
-            "uids": reader.uids(),
-            "entities": {
-                k: reader.entities(i) for i, k in enumerate(entity_keys)
-            },
-            "coo": [reader.coo(i) for i in range(len(vocabs))],
-        }
-        return out
+        return part
+
+    def read_one(path: str) -> Dict[str, object]:
+        reader = NativeAvroReader(
+            field_prog, feat_desc, vocabset, entity_keys
+        )
+        try:
+            reader.feed_file(path, expected_schema=schema)
+            # per-part label check: a doomed training input fails before
+            # the remaining files/columns are extracted
+            return check_labels(
+                _extract_columns(reader, entity_keys, len(vocabs)), path
+            )
+        finally:
+            reader.close()
+
+    try:
+        if len(paths) == 1:
+            # common case: hand back the reader's arrays directly, no
+            # concatenate copies
+            return read_one(paths[0])
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = max_workers or min(len(paths), os.cpu_count() or 4, 16)
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            parts = list(pool.map(read_one, paths))
     finally:
-        reader.close()
+        vocabset.close()
+
+    # concatenate in path order; COO row ids shift by the running total
+    n = sum(p["n"] for p in parts)
+    row_base = np.cumsum([0] + [p["n"] for p in parts])[:-1]
+    coo = []
+    for vi in range(len(vocabs)):
+        rows = np.concatenate(
+            [
+                p["coo"][vi][0].astype(np.int64) + base
+                for p, base in zip(parts, row_base)
+            ]
+        )
+        cols = np.concatenate([p["coo"][vi][1] for p in parts])
+        vals = np.concatenate([p["coo"][vi][2] for p in parts])
+        coo.append((rows, cols, vals))
+    return {
+        "n": n,
+        "labels": np.concatenate([p["labels"] for p in parts]),
+        "label_present": np.concatenate(
+            [p["label_present"] for p in parts]
+        ),
+        "offsets": np.concatenate([p["offsets"] for p in parts]),
+        "weights": np.concatenate([p["weights"] for p in parts]),
+        "uids": np.concatenate([p["uids"] for p in parts]),
+        "entities": {
+            k: np.concatenate([p["entities"][k] for p in parts])
+            for k in entity_keys
+        },
+        "coo": coo,
+    }

@@ -208,6 +208,12 @@ struct Vocab {
   int32_t intercept = -1;  // intercept column: injected by Python, not here
 };
 
+// Immutable after construction; shared READ-ONLY by every reader (one
+// build per ingest, not per file/thread).
+struct VocabSet {
+  std::vector<Vocab> vocabs;
+};
+
 // ---------------------------------------------------------------------------
 // reader state
 // ---------------------------------------------------------------------------
@@ -233,7 +239,7 @@ struct Reader {
   std::vector<uint8_t> feat_optional;
   int32_t feat_name = -1, feat_term = -1, feat_value = -1;
 
-  std::vector<Vocab> vocabs;
+  const VocabSet* vocabset = nullptr;  // non-owning, shared, read-only
   std::vector<std::string> entity_keys;
 
   int64_t nrecords = 0;
@@ -352,10 +358,11 @@ bool decode_record(Reader& r, Slice& s) {
             r.scratch_key.append(term.data(), term.size());
             std::string_view key(r.scratch_key);
             if (r.collect_keys) r.keyset.insert(r.scratch_key);
-            for (size_t vi = 0; vi < r.vocabs.size(); ++vi) {
-              auto it = r.vocabs[vi].map.find(key);
-              if (it == r.vocabs[vi].map.end()) continue;
-              if (it->second == r.vocabs[vi].intercept) continue;
+            const auto& vocabs = r.vocabset->vocabs;
+            for (size_t vi = 0; vi < vocabs.size(); ++vi) {
+              auto it = vocabs[vi].map.find(key);
+              if (it == vocabs[vi].map.end()) continue;
+              if (it->second == vocabs[vi].intercept) continue;
               r.coo_rows[vi].push_back(static_cast<int32_t>(row));
               r.coo_cols[vi].push_back(it->second);
               r.coo_vals[vi].push_back(value);
@@ -413,22 +420,58 @@ bool decode_record(Reader& r, Slice& s) {
 
 extern "C" {
 
-// Create a reader. field_prog: flat int32 triples (op, wire, arg).
-// feat_desc: [nfields, name_pos, term_pos, value_pos, wire0, opt0, wire1,
-// opt1, ...]. Vocabulary keys arrive as one concatenated byte blob with an
-// explicit cumulative offset table (total_keys + 1 entries spanning every
-// vocab, in order) — offsets, not separators, so keys may contain ANY
-// byte ('\x01' separates name/term inside a key; names can embed
-// newlines). entity_blob/entity_offsets carry the requested metadataMap
-// keys the same way.
+// Build an immutable vocabulary set. Keys arrive as one concatenated byte
+// blob with an explicit cumulative offset table (total_keys + 1 entries
+// spanning every vocab, in order) — offsets, not separators, so keys may
+// contain ANY byte ('\x01' separates name/term inside a key; names can
+// embed newlines). Shared read-only by any number of readers/threads;
+// freed by the caller AFTER every reader using it.
+void* pml_vocabset_new(const char* vocab_blob, const int64_t* key_offsets,
+                       const int32_t* vocab_counts,
+                       const int32_t* vocab_intercepts, int32_t nvocabs) {
+  VocabSet* vs = new VocabSet();
+  // build each Vocab in place: the map's string_views point into
+  // v.storage, so the string must never move after the views are taken
+  // (short storage is SSO-inline and does NOT survive a move).
+  vs->vocabs.reserve(static_cast<size_t>(nvocabs));
+  int64_t key_base = 0;  // index into the global offset table
+  for (int32_t vi = 0; vi < nvocabs; ++vi) {
+    vs->vocabs.emplace_back();
+    Vocab& v = vs->vocabs.back();
+    int32_t count = vocab_counts[vi];
+    int64_t lo = key_offsets[key_base];
+    int64_t hi = key_offsets[key_base + count];
+    v.storage.assign(vocab_blob + lo, static_cast<size_t>(hi - lo));
+    v.intercept = vocab_intercepts[vi];
+    v.map.reserve(static_cast<size_t>(count) * 2);
+    for (int32_t i = 0; i < count; ++i) {
+      int64_t a = key_offsets[key_base + i] - lo;
+      int64_t b = key_offsets[key_base + i + 1] - lo;
+      std::string_view key(v.storage.data() + a,
+                           static_cast<size_t>(b - a));
+      v.map.emplace(key, i);
+    }
+    key_base += count;
+  }
+  return vs;
+}
+
+void pml_vocabset_free(void* handle) {
+  delete static_cast<VocabSet*>(handle);
+}
+
+// Create a reader bound to a (shared) vocabulary set. field_prog: flat
+// int32 triples (op, wire, arg). feat_desc: [nfields, name_pos, term_pos,
+// value_pos, wire0, opt0, wire1, opt1, ...]. entity_blob/entity_offsets
+// carry the requested metadataMap keys offset-framed like vocab keys.
 void* pml_reader_new(const int32_t* field_prog, int32_t nfields,
-                     const int32_t* feat_desc, const char* vocab_blob,
-                     const int64_t* key_offsets, const int32_t* vocab_counts,
-                     const int32_t* vocab_intercepts, int32_t nvocabs,
+                     const int32_t* feat_desc, void* vocabset,
                      const char* entity_blob, const int64_t* entity_offsets,
                      int32_t nentities, int32_t collect_keys) {
   Reader* r = new Reader();
   r->collect_keys = collect_keys != 0;
+  r->vocabset = static_cast<const VocabSet*>(vocabset);
+  int32_t nvocabs = static_cast<int32_t>(r->vocabset->vocabs.size());
   // the Python contract reserves columns 0..2 (label/offset/weight) even
   // when the schema lacks some of those fields; absent columns read as
   // all-default with seen=0.
@@ -453,29 +496,6 @@ void* pml_reader_new(const int32_t* field_prog, int32_t nfields,
     r->feat_optional.push_back(static_cast<uint8_t>(feat_desc[5 + 2 * i]));
   }
 
-  // build each Vocab in place: the map's string_views point into
-  // v.storage, so the string must never move after the views are taken
-  // (short storage is SSO-inline and does NOT survive a move).
-  r->vocabs.reserve(static_cast<size_t>(nvocabs));
-  int64_t key_base = 0;  // index into the global offset table
-  for (int32_t vi = 0; vi < nvocabs; ++vi) {
-    r->vocabs.emplace_back();
-    Vocab& v = r->vocabs.back();
-    int32_t count = vocab_counts[vi];
-    int64_t lo = key_offsets[key_base];
-    int64_t hi = key_offsets[key_base + count];
-    v.storage.assign(vocab_blob + lo, static_cast<size_t>(hi - lo));
-    v.intercept = vocab_intercepts[vi];
-    v.map.reserve(static_cast<size_t>(count) * 2);
-    for (int32_t i = 0; i < count; ++i) {
-      int64_t a = key_offsets[key_base + i] - lo;
-      int64_t b = key_offsets[key_base + i + 1] - lo;
-      std::string_view key(v.storage.data() + a,
-                           static_cast<size_t>(b - a));
-      v.map.emplace(key, i);
-    }
-    key_base += count;
-  }
   r->coo_rows.resize(nvocabs);
   r->coo_cols.resize(nvocabs);
   r->coo_vals.resize(nvocabs);
@@ -511,6 +531,42 @@ int64_t pml_reader_feed(void* handle, const uint8_t* data, int64_t len,
     }
   }
   return count;
+}
+
+// Feed an entire container-file BODY (everything after the header's sync
+// marker): iterates blocks natively — count, byte size, payload, sync —
+// so Python makes ONE GIL-releasing call per file. Returns total records
+// decoded, -1 on decode error, -2 on framing/sync error.
+int64_t pml_reader_feed_blocks(void* handle, const uint8_t* data,
+                               int64_t len, int32_t codec,
+                               const uint8_t* sync) {
+  Reader* r = static_cast<Reader*>(handle);
+  Slice s{data, static_cast<size_t>(len)};
+  int64_t total = 0;
+  while (s.off < s.n) {
+    int64_t count = read_long(s);
+    int64_t nbytes = read_long(s);
+    if (s.fail || count < 0 || nbytes < 0 ||
+        !s.need(static_cast<size_t>(nbytes))) {
+      r->error = "bad block framing";
+      return -2;
+    }
+    const uint8_t* payload = s.p + s.off;
+    s.off += static_cast<size_t>(nbytes);
+    if (!s.need(16)) {
+      r->error = "truncated sync marker";
+      return -2;
+    }
+    if (std::memcmp(s.p + s.off, sync, 16) != 0) {
+      r->error = "bad sync marker (corrupt file)";
+      return -2;
+    }
+    s.off += 16;
+    int64_t got = pml_reader_feed(handle, payload, nbytes, count, codec);
+    if (got < 0) return -1;
+    total += got;
+  }
+  return total;
 }
 
 int64_t pml_reader_nrecords(void* handle) {

@@ -352,6 +352,40 @@ class TestEmptyInput:
             _force_fallback(IngestSource([path])).labeled_batch(vocab)
 
 
+class TestParallelFiles:
+    def test_multi_file_parallel_matches_fallback(self, tmp_path):
+        """4 part files decode in parallel threads; row order must equal
+        the sequential Python-codec read (path order)."""
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        paths = []
+        for part in range(4):
+            recs = _records(120, seed=100 + part)
+            p = str(tmp_path / f"part-{part}.avro")
+            write_avro_file(p, TRAINING_EXAMPLE_SCHEMA, recs)
+            paths.append(p)
+        nat = IngestSource(paths).labeled_batch(vocab)
+        ref = _force_fallback(IngestSource(paths)).labeled_batch(vocab)
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), np.asarray(ref[0].features),
+            rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].labels), np.asarray(ref[0].labels)
+        )
+        assert list(nat[1]) == list(ref[1])
+        # entity columns concatenate in order too
+        nat_g = IngestSource(paths).game_data({"s": vocab}, ["userId"])
+        ref_g = _force_fallback(IngestSource(paths)).game_data(
+            {"s": vocab}, ["userId"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat_g[0].entity_ids["userId"]),
+            np.asarray(ref_g[0].entity_ids["userId"]),
+        )
+
+
 class TestCorruptInput:
     """A native decoder must fail CLEANLY on malformed bytes — raise a
     Python exception, never crash or mis-decode silently."""
