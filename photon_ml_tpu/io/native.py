@@ -119,7 +119,7 @@ def _build_and_load() -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
         lib.pml_reader_feed_blocks.restype = ctypes.c_int64
         lib.pml_reader_feed_blocks.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p,
         ]
         lib.pml_reader_nrecords.restype = ctypes.c_int64
         lib.pml_reader_nrecords.argtypes = [ctypes.c_void_p]
@@ -444,10 +444,10 @@ class NativeAvroReader:
         sync = buf.read(16)
         # the whole body decodes in ONE C call: block framing, sync
         # verification, inflate, and record decode all run with the GIL
-        # released, so multi-file ingest parallelizes across threads
-        body = raw[buf.tell():]
+        # released, so multi-file ingest parallelizes across threads.
+        # The file passes as-is with a start offset — no body-slice copy.
         got = self._lib.pml_reader_feed_blocks(
-            self._handle, body, len(body), codec, sync
+            self._handle, raw, buf.tell(), len(raw), codec, sync
         )
         if got < 0:
             err = self._lib.pml_reader_error(self._handle).decode()
@@ -543,6 +543,19 @@ class NativeAvroReader:
 # ---------------------------------------------------------------------------
 
 
+def _map_files(paths: Sequence[str], fn, max_workers: Optional[int]):
+    """Shared parallel scaffold for per-file native passes: single-file
+    shortcut, bounded thread pool (ctypes releases the GIL during the C
+    decode), results in path order."""
+    if len(paths) == 1:
+        return [fn(paths[0])]
+    from concurrent.futures import ThreadPoolExecutor
+
+    workers = max_workers or min(len(paths), os.cpu_count() or 4, 16)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(fn, paths))
+
+
 def _read_header_schema(path: str) -> dict:
     with open(path, "rb") as f:
         head = f.read(4 * 1024 * 1024)
@@ -564,10 +577,15 @@ def _read_header_schema(path: str) -> dict:
 
 
 def scan_feature_keys(
-    paths: Sequence[str], *, label_field: str = "label"
+    paths: Sequence[str],
+    *,
+    label_field: str = "label",
+    max_workers: Optional[int] = None,
 ) -> List[str]:
     """Native distinct-feature-key scan over Avro files — the
-    ``FeatureIndexingJob.scala:48-160`` vocabulary-building pass."""
+    ``FeatureIndexingJob.scala:48-160`` vocabulary-building pass.
+    Multi-file inputs scan in parallel (per-file keysets union'd, like
+    the reference's per-partition dedup + distinct())."""
     if not paths:
         raise FileNotFoundError("no input files")
     schema = _read_header_schema(paths[0])
@@ -575,15 +593,26 @@ def scan_feature_keys(
         schema, label_field=label_field, want_entities=False
     )
     vocabset = NativeVocabSet([], [])
-    reader = NativeAvroReader(
-        field_prog, feat_desc, vocabset, (), collect_keys=True
-    )
+
+    def scan_one(path: str) -> List[str]:
+        reader = NativeAvroReader(
+            field_prog, feat_desc, vocabset, (), collect_keys=True
+        )
+        try:
+            reader.feed_file(path, expected_schema=schema)
+            return reader.distinct_keys()
+        finally:
+            reader.close()
+
     try:
-        for p in paths:
-            reader.feed_file(p, expected_schema=schema)
-        return reader.distinct_keys()
+        per_file = _map_files(paths, scan_one, max_workers)
+        if len(per_file) == 1:
+            return per_file[0]
+        merged = set()
+        for keys in per_file:
+            merged.update(keys)
+        return list(merged)
     finally:
-        reader.close()
         vocabset.close()
 
 
@@ -813,15 +842,11 @@ def read_columnar(
             reader.close()
 
     try:
-        if len(paths) == 1:
+        parts = _map_files(paths, read_one, max_workers)
+        if len(parts) == 1:
             # common case: hand back the reader's arrays directly, no
             # concatenate copies
-            return read_one(paths[0])
-        from concurrent.futures import ThreadPoolExecutor
-
-        workers = max_workers or min(len(paths), os.cpu_count() or 4, 16)
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            parts = list(pool.map(read_one, paths))
+            return parts[0]
     finally:
         vocabset.close()
 

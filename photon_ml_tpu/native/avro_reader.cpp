@@ -538,10 +538,12 @@ int64_t pml_reader_feed(void* handle, const uint8_t* data, int64_t len,
 // so Python makes ONE GIL-releasing call per file. Returns total records
 // decoded, -1 on decode error, -2 on framing/sync error.
 int64_t pml_reader_feed_blocks(void* handle, const uint8_t* data,
-                               int64_t len, int32_t codec,
+                               int64_t start, int64_t len, int32_t codec,
                                const uint8_t* sync) {
+  // `start` lets Python pass the whole mapped file and skip the header
+  // without slicing a second full-size bytes object.
   Reader* r = static_cast<Reader*>(handle);
-  Slice s{data, static_cast<size_t>(len)};
+  Slice s{data + start, static_cast<size_t>(len - start)};
   int64_t total = 0;
   while (s.off < s.n) {
     int64_t count = read_long(s);

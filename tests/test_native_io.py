@@ -384,6 +384,10 @@ class TestParallelFiles:
             np.asarray(nat_g[0].entity_ids["userId"]),
             np.asarray(ref_g[0].entity_ids["userId"]),
         )
+        # parallel vocabulary scan unions per-file keysets
+        nat_v = IngestSource(paths).build_vocab()
+        ref_v = _force_fallback(IngestSource(paths)).build_vocab()
+        assert nat_v.index_to_key == ref_v.index_to_key
 
 
 class TestCorruptInput:
