@@ -27,6 +27,7 @@ from photon_ml_tpu.parallel.mesh import (
 )
 from photon_ml_tpu.parallel.multihost import (
     initialize_multihost,
+    process_local_paths,
     process_local_rows,
 )
 from photon_ml_tpu.parallel.distributed import (
@@ -50,5 +51,6 @@ __all__ = [
     "feature_sharded_train_glm",
     "shard_map_value_and_grad",
     "initialize_multihost",
+    "process_local_paths",
     "process_local_rows",
 ]

@@ -90,20 +90,53 @@ def split_rows(total_rows: int, num_processes: int, process_id: int) -> range:
     )
 
 
+def _require_joined(caller: str) -> None:
+    """A configured-but-unjoined runtime is a hard error: input-split
+    helpers called before :func:`initialize_multihost` would silently
+    hand every host the full input (duplicated ingest, corrupt global
+    arrays). "Configured" means ANY of the join triggers is set — the
+    same signals initialize_multihost() joins on."""
+    if jax.process_count() > 1:
+        return
+    configured = int(os.environ.get("JAX_NUM_PROCESSES", "1") or "1")
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if configured > 1 or coordinator:
+        raise RuntimeError(
+            f"multi-host runtime configured (JAX_NUM_PROCESSES="
+            f"{configured}, JAX_COORDINATOR_ADDRESS={coordinator!r}) but "
+            f"this process has not joined; call initialize_multihost() "
+            f"before {caller}()"
+        )
+
+
+def process_local_paths(paths):
+    """The subset of input part files THIS process should ingest — file
+    granularity input splits (round-robin by sorted position, so hosts
+    get near-equal counts even when the file list grows). Feed the result
+    to ``io.ingest.IngestSource``; each host then decodes only its slice
+    in parallel threads and places rows globally with
+    ``jax.make_array_from_process_local_data``. Single-process: all
+    paths. Same join-first contract as :func:`process_local_rows`."""
+    _require_joined("process_local_paths")
+    paths = sorted(paths)
+    n = jax.process_count()
+    # symmetric failure: EVERY host raises when any host's slice would be
+    # empty — one host erroring while the rest proceed to collectives
+    # turns a config error into a distributed hang
+    if len(paths) < n:
+        raise ValueError(
+            f"{len(paths)} part files for {n} processes — every process "
+            "needs at least one input file"
+        )
+    return paths[jax.process_index()::n]
+
+
 def process_local_rows(total_rows: int) -> range:
     """The contiguous row range THIS process should ingest — the even
     split of a global row space over processes (the analog of the
     reference's input-split assignment). Single-process: everything.
 
-    Must run AFTER :func:`initialize_multihost` on a pod — calling it
-    first would silently hand every host the full range (duplicated
-    ingest, corrupt global arrays), so a configured-but-unjoined runtime
-    is a hard error."""
-    configured = int(os.environ.get("JAX_NUM_PROCESSES", "1") or "1")
-    if jax.process_count() == 1 and configured > 1:
-        raise RuntimeError(
-            f"JAX_NUM_PROCESSES={configured} but this process has not "
-            "joined the multi-host runtime; call initialize_multihost() "
-            "before process_local_rows()"
-        )
+    Must run AFTER :func:`initialize_multihost` on a pod (see
+    :func:`_require_joined`)."""
+    _require_joined("process_local_rows")
     return split_rows(total_rows, jax.process_count(), jax.process_index())

@@ -307,3 +307,30 @@ class TestMultihost:
         ranges = [split_rows(total, n_proc, p) for p in range(n_proc)]
         flat = [i for r in ranges for i in r]
         assert flat == list(range(total))
+
+    def test_process_local_paths_single(self, monkeypatch):
+        from photon_ml_tpu.parallel import process_local_paths
+
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES"):
+            monkeypatch.delenv(var, raising=False)
+        paths = [f"part-{i}.avro" for i in range(5)]
+        assert process_local_paths(paths) == sorted(paths)
+        with pytest.raises(ValueError, match="part files"):
+            process_local_paths([])
+
+    def test_process_local_paths_guard(self, monkeypatch):
+        from photon_ml_tpu.parallel import (
+            process_local_paths,
+            process_local_rows,
+        )
+
+        # either join trigger alone must arm the guard
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+        with pytest.raises(RuntimeError, match="has not joined"):
+            process_local_paths(["a.avro"])
+        monkeypatch.delenv("JAX_NUM_PROCESSES")
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+        with pytest.raises(RuntimeError, match="has not joined"):
+            process_local_paths(["a.avro"])
+        with pytest.raises(RuntimeError, match="has not joined"):
+            process_local_rows(10)
