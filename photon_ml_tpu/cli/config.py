@@ -203,6 +203,10 @@ class CoordinateSpec:
     latent_reg_weight: Optional[float] = None  # default: reg weight
     latent_max_iters: Optional[int] = None  # default: max_iters
     latent_tolerance: Optional[float] = None  # default: tolerance
+    # fixed-effect coordinates on a SPARSE shard: densify the N hottest
+    # columns into the MXU slab (-1 = auto), ops.sparse.to_hybrid applied
+    # coordinate-locally (the row permutation never leaves the coordinate)
+    hot_columns: int = 0
 
 
 @dataclasses.dataclass
@@ -248,20 +252,31 @@ class GameDriverParams:
         if not self.updating_sequence:
             raise ValueError("updating_sequence is required")
         sparse = set(self.sparse_shards)
-        if sparse:
-            for name, spec in self.coordinates.items():
-                uses_sparse = spec.shard in sparse
-                entityish = (
-                    spec.random_effect is not None
-                    or spec.latent_dim is not None
-                    or spec.projector
+        for name, spec in self.coordinates.items():
+            uses_sparse = spec.shard in sparse
+            entityish = (
+                spec.random_effect is not None
+                or spec.latent_dim is not None
+                or spec.projector
+            )
+            if uses_sparse and entityish:
+                raise ValueError(
+                    f"coordinate {name!r} uses sparse shard "
+                    f"{spec.shard!r} but random/factored/projected "
+                    "effects need dense per-row features"
                 )
-                if uses_sparse and entityish:
-                    raise ValueError(
-                        f"coordinate {name!r} uses sparse shard "
-                        f"{spec.shard!r} but random/factored/projected "
-                        "effects need dense per-row features"
-                    )
+            if spec.hot_columns and (entityish or not uses_sparse):
+                raise ValueError(
+                    f"coordinate {name!r}: hot_columns applies to "
+                    "fixed-effect coordinates on a shard listed in "
+                    "sparse_shards"
+                )
+            if spec.hot_columns and spec.optimizer == "NEWTON":
+                raise ValueError(
+                    f"coordinate {name!r}: NEWTON materializes the exact "
+                    "Hessian from dense features; hot_columns (hybrid) "
+                    "is not supported"
+                )
         for name in self.updating_sequence:
             if name not in self.coordinates:
                 raise ValueError(

@@ -104,8 +104,26 @@ def build_coordinates(
         spec = params.coordinates[name]
         cfg = _coordinate_config(name, spec, task, reg_combo[name])
         if spec.random_effect is None:
+            hybrid_pack = None
+            if spec.hot_columns:
+                # the hybrid re-pack is combo-invariant: build once per
+                # grid sweep, like the random-effect designs
+                cache_key = f"{name}\x00hybrid"
+                if design_cache is not None and cache_key in design_cache:
+                    hybrid_pack = design_cache[cache_key]
+                else:
+                    hybrid_pack = FixedEffectCoordinate.hybridize_batch(
+                        data.fixed_effect_batch(spec.shard, dtype),
+                        spec.hot_columns,
+                    )
+                    if design_cache is not None:
+                        design_cache[cache_key] = hybrid_pack
             coords[name] = FixedEffectCoordinate(
-                data.fixed_effect_batch(spec.shard, dtype), cfg
+                data.fixed_effect_batch(spec.shard, dtype)
+                if hybrid_pack is None
+                else hybrid_pack[0],
+                cfg,
+                hybrid_pack=hybrid_pack,
             )
         else:
             if design_cache is not None and name in design_cache:

@@ -202,16 +202,92 @@ def _make_fixed_update_and_score_cached(config: CoordinateConfig):
     return run
 
 
-class FixedEffectCoordinate:
-    """Global GLM coordinate. Owns a device LabeledBatch (shard view)."""
+def _make_fixed_update_and_score_permuted(config: CoordinateConfig):
+    return _make_fixed_update_and_score_permuted_cached(
+        dataclasses.replace(config, reg_weight=0.0)
+    )
 
-    def __init__(self, batch: LabeledBatch, config: CoordinateConfig):
+
+@lru_cache(maxsize=128)
+def _make_fixed_update_and_score_permuted_cached(config: CoordinateConfig):
+    """Hybrid-representation variant: the batch lives in the hybrid's
+    stored (bucketed) row order, while partial scores arrive — and
+    rescores must leave — in the GLOBAL row order the descent loop sums
+    over. Both permutation gathers ride inside the single dispatch."""
+    solve = _make_solve(config, batched=False)
+
+    @jax.jit
+    def run(w, reg_weight, features, labels, offsets_base, partial_scores,
+            weights, mask, perm, inv):
+        offsets = offsets_base + partial_scores[perm]
+        result = solve(w, reg_weight, features, labels, offsets, weights, mask)
+        return result, (features @ result.w)[inv]
+
+    return run
+
+
+class FixedEffectCoordinate:
+    """Global GLM coordinate. Owns a device LabeledBatch (shard view).
+
+    ``hot_columns`` (with a padded-ELL batch) re-represents the shard as
+    dense-hot + bucketed-cold (``ops.sparse.to_hybrid``) INSIDE the
+    coordinate: the hybrid's row permutation is private here — incoming
+    partial scores and outgoing rescores are bridged by two in-dispatch
+    gathers, so the descent loop keeps its global row order."""
+
+    @staticmethod
+    def hybridize_batch(batch: LabeledBatch, hot_columns: int):
+        """(permuted hybrid batch, row_perm, inv_perm) — the host-side
+        re-pack, exposed so grid sweeps can build it ONCE per coordinate
+        (it depends on data + hot_columns, never on reg weight)."""
+        from photon_ml_tpu.ops.sparse import is_sparse, to_hybrid
+
+        if not is_sparse(batch.features):
+            raise ValueError(
+                "hot_columns requires a padded-ELL (sparse) shard"
+            )
+        hf = to_hybrid(batch.features, hot_columns=hot_columns)
+        perm = np.asarray(hf.row_perm)
+        batch = dataclasses.replace(
+            batch,
+            features=hf,
+            labels=batch.labels[perm],
+            offsets=batch.offsets[perm],
+            weights=batch.weights[perm],
+            mask=batch.mask[perm],
+        )
+        return batch, jnp.asarray(perm), jnp.asarray(np.argsort(perm))
+
+    def __init__(
+        self,
+        batch: LabeledBatch,
+        config: CoordinateConfig,
+        hot_columns: int = 0,
+        hybrid_pack=None,
+    ):
         if config.random_effect is not None:
             raise ValueError("config names a random effect; wrong coordinate")
+        self._row_perm = None
+        if hybrid_pack is not None:
+            batch, self._row_perm, self._inv_perm = hybrid_pack
+        elif hot_columns:
+            batch, self._row_perm, self._inv_perm = self.hybridize_batch(
+                batch, hot_columns
+            )
         self.batch = batch
         self.config = config
-        self._update_and_score = _make_fixed_update_and_score(config)
-        self._score = jax.jit(lambda w, feats: feats @ w)
+        self._update_and_score = (
+            _make_fixed_update_and_score_permuted(config)
+            if self._row_perm is not None
+            else _make_fixed_update_and_score(config)
+        )
+        from photon_ml_tpu.ops.sparse import matvec as _matvec
+
+        self._score = (
+            jax.jit(lambda w, feats: _matvec(feats, w)[self._inv_perm])
+            if self._row_perm is not None
+            else jax.jit(lambda w, feats: feats @ w)
+        )
         self._downsample = (
             jax.jit(_binary_downsample_weights, static_argnums=(3,))
             if config.down_sampling_rate is not None
@@ -266,7 +342,6 @@ class FixedEffectCoordinate:
         """update + full-batch rescore, fused into one dispatch (on
         remote/tunneled devices each dispatch is a round trip; the
         coordinate-descent loop uses this form)."""
-        offsets = self.batch.offsets + partial_scores
         weights = self.batch.weights
         if self._downsample is not None:
             if key is None:
@@ -286,17 +361,34 @@ class FixedEffectCoordinate:
                     jnp.asarray(self.config.reg_weight, w.dtype),
                     self.batch.features,
                     self.batch.labels,
-                    offsets,
+                    self.batch.offsets + partial_scores,
                     weights,
                     self.batch.mask,
                 )
                 return result.w, result, scores
+        if self._row_perm is not None:
+            # hybrid batch: partial scores arrive in global row order, the
+            # batch lives in stored order — the permutation gathers ride
+            # inside the dispatch
+            result, scores = self._update_and_score(
+                w,
+                jnp.asarray(self.config.reg_weight, w.dtype),
+                self.batch.features,
+                self.batch.labels,
+                self.batch.offsets,
+                partial_scores,
+                weights,
+                self.batch.mask,
+                self._row_perm,
+                self._inv_perm,
+            )
+            return result.w, result, scores
         result, scores = self._update_and_score(
             w,
             jnp.asarray(self.config.reg_weight, w.dtype),
             self.batch.features,
             self.batch.labels,
-            offsets,
+            self.batch.offsets + partial_scores,
             weights,
             self.batch.mask,
         )

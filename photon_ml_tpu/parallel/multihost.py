@@ -96,7 +96,9 @@ def _require_joined(caller: str) -> None:
     hand every host the full input (duplicated ingest, corrupt global
     arrays). "Configured" means ANY of the join triggers is set — the
     same signals initialize_multihost() joins on."""
-    if jax.process_count() > 1:
+    if _INITIALIZED or jax.process_count() > 1:
+        # joined (possibly a single-process pod smoke test): splits are
+        # whatever process_count says
         return
     configured = int(os.environ.get("JAX_NUM_PROCESSES", "1") or "1")
     coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")

@@ -42,7 +42,7 @@ def game_files(rng, tmp_path):
     return tmp_path, str(gvocab), str(uvocab)
 
 
-def _params(tmp_path, gvocab, uvocab, out, sparse_shards):
+def _params(tmp_path, gvocab, uvocab, out, sparse_shards, hot_columns=0):
     return {
         "train_input": [str(tmp_path / "train")],
         "validate_input": [str(tmp_path / "train")],
@@ -58,6 +58,7 @@ def _params(tmp_path, gvocab, uvocab, out, sparse_shards):
                 "reg_weights": [1.0],
                 "max_iters": 40,
                 "tolerance": 1e-9,
+                "hot_columns": hot_columns,
             },
             "per-user": {
                 "shard": "userShard",
@@ -129,6 +130,36 @@ class TestSparseShardTraining:
             ms["validation_metric"], md["validation_metric"], rtol=1e-8
         )
 
+    def test_hybrid_fixed_coordinate_matches_dense(self, game_files):
+        """hot_columns on the sparse fixed shard: the coordinate-local
+        hybrid (and its private row permutation) must not change the
+        solution, the per-user tables, or the validation metric."""
+        tmp_path, gvocab, uvocab = game_files
+        r_dense = run_game_training(
+            _params(tmp_path, gvocab, uvocab, "out_dense2", [])
+        )
+        r_hyb = run_game_training(
+            _params(
+                tmp_path, gvocab, uvocab, "out_hyb",
+                ["globalShard"], hot_columns=-1,
+            )
+        )
+        md = r_dense.sweep[r_dense.best_index]
+        mh = r_hyb.sweep[r_hyb.best_index]
+        np.testing.assert_allclose(
+            np.asarray(mh["model"].params["global"]),
+            np.asarray(md["model"].params["global"]),
+            rtol=1e-6, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mh["model"].params["per-user"]),
+            np.asarray(md["model"].params["per-user"]),
+            rtol=1e-6, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            mh["validation_metric"], md["validation_metric"], rtol=1e-8
+        )
+
     def test_scoring_driver_with_sparse_shard(self, game_files):
         from photon_ml_tpu.cli.score import run_scoring
 
@@ -169,6 +200,15 @@ class TestSparseShardGuards:
             tmp_path, gvocab, uvocab, "out_bad", ["userShard"]
         )
         with pytest.raises(ValueError, match="dense per-row features"):
+            run_game_training(params)
+
+    def test_hot_columns_requires_sparse_fixed(self, game_files):
+        tmp_path, gvocab, uvocab = game_files
+        # dense shard + hot_columns -> config error
+        params = _params(
+            tmp_path, gvocab, uvocab, "out_bad2", [], hot_columns=-1
+        )
+        with pytest.raises(ValueError, match="hot_columns applies"):
             run_game_training(params)
 
     def test_design_builder_guard(self, game_files):
