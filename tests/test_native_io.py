@@ -558,6 +558,124 @@ class TestNativeWriter:
             )
 
 
+class TestSchemaFuzz:
+    """Seeded random schemas in the supported family: the compiled native
+    program must agree with the schema-general Python codec on every
+    generated layout (field order, optional-ness, union branch order,
+    extra skipped fields)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_flat_schema_equivalence(self, tmp_path, seed):
+        rng = np.random.default_rng(seed)
+
+        def maybe_optional(t):
+            r = rng.integers(0, 3)
+            if r == 0:
+                return t, False
+            if r == 1:
+                return ["null", t], True
+            return [t, "null"], True
+
+        fields = []
+        makers = {}
+        feat_fields = [
+            {"name": "name", "type": "string"},
+            {"name": "term", "type": "string"},
+            {"name": "value", "type": "double"},
+        ]
+        rng.shuffle(feat_fields)
+        # core fields in random order, plus skippable extras
+        core = [
+            ("label", "double"),
+            ("offset", "double"),
+            ("weight", "double"),
+            ("uid", "string"),
+            ("features", None),
+        ]
+        extras = [
+            (f"extra{i}", rng.choice(["double", "long", "string", "boolean"]))
+            for i in range(rng.integers(0, 3))
+        ]
+        order = core + extras
+        rng.shuffle(order)
+        for fname, ftype in order:
+            if fname == "features":
+                fields.append(
+                    {
+                        "name": "features",
+                        "type": {
+                            "type": "array",
+                            "items": {
+                                "name": f"F{seed}",
+                                "type": "record",
+                                "fields": feat_fields,
+                            },
+                        },
+                    }
+                )
+                continue
+            t, optional = maybe_optional(str(ftype))
+            fields.append({"name": fname, "type": t})
+            makers[fname] = (ftype, optional)
+        schema = {"name": f"Fuzz{seed}", "type": "record", "fields": fields}
+
+        def value_of(ftype, i):
+            if ftype == "double":
+                return float(i) * 0.5
+            if ftype == "long":
+                return int(i)
+            if ftype == "boolean":
+                return bool(i % 2)
+            return f"s{i}"
+
+        recs = []
+        for i in range(40):
+            rec = {
+                "features": [
+                    {
+                        "name": f"f{int(j)}",
+                        "term": "t",
+                        "value": float(i + j),
+                    }
+                    for j in rng.choice(20, 3, replace=False)
+                ]
+            }
+            for fname, (ftype, optional) in makers.items():
+                if optional and i % 3 == 0:
+                    rec[fname] = None
+                else:
+                    rec[fname] = value_of(ftype, i)
+            recs.append(rec)
+        path = str(tmp_path / f"fuzz{seed}.avro")
+        write_avro_file(path, schema, recs)
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(20)], add_intercept=False
+        )
+        try:
+            nat = IngestSource([path]).labeled_batch(
+                vocab, allow_null_labels=True
+            )
+        except native.UnsupportedSchema:
+            return  # honest refusal is fine; silence would not be
+        ref = _force_fallback(IngestSource([path])).labeled_batch(
+            vocab, allow_null_labels=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(nat[0].features), np.asarray(ref[0].features),
+            rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].labels), np.asarray(ref[0].labels)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].offsets), np.asarray(ref[0].offsets)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(nat[0].weights), np.asarray(ref[0].weights)
+        )
+        np.testing.assert_array_equal(nat[2], ref[2])
+
+
 class TestSchemaGuards:
     def test_mixed_schema_files_fall_back(self, tmp_path):
         """Files with different writer schemas can't share one compiled
