@@ -193,6 +193,54 @@ class TestSparseShardTraining:
             np.testing.assert_allclose(s_sparse.metrics[k], v, rtol=1e-9)
 
 
+class TestBuildIndexJob:
+    def test_index_job_feeds_both_drivers(self, game_files):
+        """The standalone vocabulary job (FeatureIndexingJob analog)
+        produces files the GAME driver consumes as feature_shards; the
+        name-prefix filter partitions the namespace into bags."""
+        from photon_ml_tpu.cli.build_index import build_index
+
+        tmp_path, gvocab, uvocab = game_files
+        out = str(tmp_path / "index")
+        gpath = build_index(
+            [str(tmp_path / "train")], out, shard="globalShard",
+            name_prefix="g", add_intercept=True,
+        )
+        upath = build_index(
+            [str(tmp_path / "train")], out, shard="userShard",
+            name_prefix="u",
+        )
+        built_g = FeatureVocabulary.load(gpath)
+        built_u = FeatureVocabulary.load(upath)
+        assert all(
+            k.startswith("g") or k.startswith("(INTERCEPT)")
+            for k in built_g.index_to_key
+        )
+        assert built_g.intercept_index is not None
+        assert set(built_u.index_to_key) == set(
+            FeatureVocabulary.load(uvocab).index_to_key
+        )
+        # the GAME driver accepts the built files directly
+        params = _params(tmp_path, gpath, upath, "out_idx", [])
+        run = run_game_training(params)
+        assert run.sweep[run.best_index]["validation_metric"] is not None
+
+    def test_cli_main(self, game_files, capsys):
+        from photon_ml_tpu.cli.build_index import main
+
+        tmp_path, _, _ = game_files
+        main(
+            [
+                "--input", str(tmp_path / "train"),
+                "--output-dir", str(tmp_path / "idx2"),
+            ]
+        )
+        path = capsys.readouterr().out.strip()
+        assert path.endswith("feature-index.txt")
+        v = FeatureVocabulary.load(path)
+        assert len(v) > 0
+
+
 class TestSparseShardGuards:
     def test_random_effect_on_sparse_shard_rejected(self, game_files):
         tmp_path, gvocab, uvocab = game_files
