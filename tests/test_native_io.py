@@ -548,6 +548,39 @@ class TestNativeWriter:
         assert recs[8]["label"] == 8.0
         assert recs[9]["weight"] == 18.0
 
+    def test_writer_failure_falls_back_with_log(self, tmp_path, caplog):
+        """A native-writer failure must fall back to the Python codec AND
+        leave a log record — never silently (cli/score.py contract)."""
+        import logging
+
+        from photon_ml_tpu.cli.score import write_scored_items
+        from photon_ml_tpu.io import native as native_mod
+        from photon_ml_tpu.io.avro import read_avro_file
+
+        n = 20
+        scores = np.arange(n, dtype=np.float64)
+        uids = np.asarray([f"u{i}" for i in range(n)], object)
+        labels = np.ones(n)
+        present = np.ones(n, bool)
+        out = str(tmp_path / "scores.avro")
+
+        def boom(*a, **k):
+            raise IOError("native Avro write failed (rc=-4)")
+
+        orig = native_mod.write_columnar_avro
+        native_mod.write_columnar_avro = boom
+        try:
+            with caplog.at_level(logging.WARNING, "photon_ml_tpu"):
+                wrote = write_scored_items(out, scores, uids, labels, present)
+        finally:
+            native_mod.write_columnar_avro = orig
+        assert wrote == n
+        assert any(
+            "native Avro writer failed" in r.message for r in caplog.records
+        )
+        _, recs = read_avro_file(out)
+        assert [r["predictionScore"] for r in recs] == list(scores)
+
     def test_unsupported_write_schema(self, tmp_path):
         from photon_ml_tpu.io.native import write_columnar_avro
         from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA

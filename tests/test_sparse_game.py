@@ -193,6 +193,41 @@ class TestSparseShardTraining:
             np.testing.assert_allclose(s_sparse.metrics[k], v, rtol=1e-9)
 
 
+class TestSparseShardCheckpoint:
+    def test_resume_equals_uninterrupted(self, game_files):
+        """Checkpoint/resume across a sparse-shard GAME run: the resumed
+        run reproduces the uninterrupted one exactly (params + history),
+        with the ELL shard rebuilt from data at startup."""
+        tmp_path, gvocab, uvocab = game_files
+        full_params = _params(
+            tmp_path, gvocab, uvocab, "ck_full", ["globalShard"]
+        )
+        full_params["num_iterations"] = 3
+        r_full = run_game_training(full_params)
+
+        part = _params(tmp_path, gvocab, uvocab, "ck_part", ["globalShard"])
+        part["num_iterations"] = 2
+        part["checkpoint_every"] = 1
+        run_game_training(part)
+        resumed = dict(part)
+        resumed["num_iterations"] = 3
+        resumed["resume"] = True
+        r_res = run_game_training(resumed)
+
+        mf = r_full.sweep[r_full.best_index]["model"]
+        mr = r_res.sweep[r_res.best_index]["model"]
+        np.testing.assert_allclose(
+            np.asarray(mr.params["global"]),
+            np.asarray(mf.params["global"]),
+            rtol=1e-10,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mr.params["per-user"]),
+            np.asarray(mf.params["per-user"]),
+            rtol=1e-10,
+        )
+
+
 class TestBuildIndexJob:
     def test_index_job_feeds_both_drivers(self, game_files):
         """The standalone vocabulary job (FeatureIndexingJob analog)
