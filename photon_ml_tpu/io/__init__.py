@@ -21,6 +21,7 @@ from photon_ml_tpu.io.constraints import (
     parse_constraint_string,
 )
 from photon_ml_tpu.io.ingest import (
+    IngestSource,
     game_data_from_avro,
     labeled_batch_from_avro,
     training_examples_to_arrays,
@@ -44,6 +45,7 @@ __all__ = [
     "BAYESIAN_LINEAR_MODEL_SCHEMA",
     "LATENT_FACTOR_SCHEMA",
     "FeatureVocabulary",
+    "IngestSource",
     "labeled_batch_from_avro",
     "training_examples_to_arrays",
     "training_examples_to_sparse",
