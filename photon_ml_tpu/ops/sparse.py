@@ -158,6 +158,78 @@ jax.tree_util.register_pytree_node(
 )
 
 
+@dataclasses.dataclass(frozen=True)
+class FeatureShardedSparse:
+    """Column-blocked padded-ELL for coefficient-sharded (huge-d) solves.
+
+    The regime of the reference's off-heap coefficient index
+    (``util/PalDBIndexMap.scala:43-212``, "hundreds of billions of
+    coefficients" ``README.md:58``): w no longer fits replicated, so the
+    scatter TARGET — coefficients, gradient, CG vectors — must shard over
+    the 'feature' mesh axis. A flat ELL cannot express that (each row's
+    column ids cross shard boundaries), so entries are grouped by column
+    BLOCK: block f holds the original columns ``{c : c % F == f}``
+    (round-robin, so frequency-sorted vocabularies balance), stored with
+    LOCAL ids ``c // F``.
+
+    indices: (n, F, k) int32 local column ids; padding slots hold
+             ``d_shard`` (out of local bounds: gather-fills 0, scatter-drops).
+    values:  (n, F, k) float payloads; padding slots hold 0.0.
+    d_shard: columns per block (static). Solver-visible width = F * d_shard.
+    d_orig:  pre-blocking column count (static; blocked positions >= it in
+             no block are real columns — they solve to exactly 0).
+
+    Sharded P('data', 'feature', None) on a ('data', 'feature') mesh, every
+    kernel is SPMD with NO communication except one O(n) psum of margin
+    partials over 'feature' (matvec's block sum): the gather/scatter run
+    against each device's LOCAL (d_shard,) coefficient block — XLA
+    partitions the vmapped gather/scatter along the block axis, which is a
+    batch dimension on both operand and indices. This is the TPU analog of
+    the reference's per-feature-block aggregation
+    (``function/ValueAndGradientAggregator.scala:204-220``).
+    """
+
+    indices: jax.Array
+    values: jax.Array
+    d_shard: int
+    d_orig: int
+
+    @property
+    def num_blocks(self) -> int:
+        return self.indices.shape[-2]
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        # solver-visible width: the blocked coefficient vector
+        return (self.indices.shape[-3], self.num_blocks * self.d_shard)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __matmul__(self, w: jax.Array) -> jax.Array:
+        return matvec(self, w)
+
+
+def _flatten_fsharded(fs: FeatureShardedSparse):
+    return (fs.indices, fs.values), (fs.d_shard, fs.d_orig)
+
+
+def _unflatten_fsharded(aux, children):
+    return FeatureShardedSparse(
+        indices=children[0], values=children[1], d_shard=aux[0], d_orig=aux[1]
+    )
+
+
+jax.tree_util.register_pytree_node(
+    FeatureShardedSparse, _flatten_fsharded, _unflatten_fsharded
+)
+
+
 # -- kernels (dispatch on representation) -----------------------------------
 
 
@@ -169,9 +241,13 @@ def is_hybrid(x) -> bool:
     return isinstance(x, HybridFeatures)
 
 
+def is_feature_sharded(x) -> bool:
+    return isinstance(x, FeatureShardedSparse)
+
+
 def is_structured(x) -> bool:
     """Any non-plain-array representation this module owns."""
-    return is_sparse(x) or is_hybrid(x)
+    return is_sparse(x) or is_hybrid(x) or is_feature_sharded(x)
 
 
 def cast_values(x, dtype):
@@ -189,7 +265,7 @@ def cast_values(x, dtype):
                 for seg in x.cold_segments
             ),
         )
-    if is_sparse(x):
+    if is_sparse(x) or is_feature_sharded(x):
         return dataclasses.replace(
             x,
             indices=jnp.asarray(x.indices),
@@ -201,6 +277,14 @@ def cast_values(x, dtype):
 def matvec(x, w: jax.Array) -> jax.Array:
     """margins contraction: (n, d) @ (d,) -> (n,). Hybrid output is in
     STORED (permuted) row order, matching the permuted batch."""
+    if is_feature_sharded(x):
+        w2 = w.reshape(x.num_blocks, x.d_shard)
+        gathered = jax.vmap(  # per-block local gather; block axis = batch dim
+            lambda wf, idxf: wf.at[idxf].get(mode="fill", fill_value=0.0),
+            in_axes=(0, 1),
+            out_axes=1,
+        )(w2, x.indices)
+        return jnp.einsum("nfk,nfk->n", x.values, gathered)
     if is_hybrid(x):
         # dtype promotion mirrors the dense path (bf16 slab @ f32 w -> f32)
         cold = jnp.concatenate(
@@ -216,6 +300,15 @@ def matvec(x, w: jax.Array) -> jax.Array:
 def rmatvec(x, a: jax.Array) -> jax.Array:
     """gradient back-projection: (n, d)^T @ (n,) -> (d,). Hybrid `a` is
     in stored row order."""
+    if is_feature_sharded(x):
+        upd = x.values * a[:, None, None]
+        g2 = jax.vmap(  # per-block local scatter into the block's coefficients
+            lambda idxf, updf: jnp.zeros((x.d_shard,), updf.dtype)
+            .at[idxf.reshape(-1)]
+            .add(updf.reshape(-1), mode="drop"),
+            in_axes=(1, 1),
+        )(x.indices, upd)
+        return g2.reshape(-1)
     if is_hybrid(x):
         g = jnp.zeros((x.d,), a.dtype)
         for (lo, hi), seg in zip(x.segment_bounds(), x.cold_segments):
@@ -233,6 +326,9 @@ def rmatvec(x, a: jax.Array) -> jax.Array:
 
 def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
     """sum_i c_i * x_ij (or x_ij^2) -> (d,): the Hessian-diagonal sums."""
+    if is_feature_sharded(x):
+        v = x.values * x.values if square else x.values
+        return rmatvec(dataclasses.replace(x, values=v), c)
     if is_hybrid(x):
         v = x.dense * x.dense if square else x.dense
         hot = jnp.einsum("n,nh->h", c, v)
@@ -255,6 +351,14 @@ def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
 def pad_rows(x, pad: int):
     """Append `pad` all-padding rows (index d, value 0), preserving the
     padding invariant that plain zero-padding would break."""
+    if is_feature_sharded(x):
+        return dataclasses.replace(
+            x,
+            indices=jnp.pad(
+                x.indices, ((0, pad), (0, 0), (0, 0)), constant_values=x.d_shard
+            ),
+            values=jnp.pad(x.values, ((0, pad), (0, 0), (0, 0))),
+        )
     if is_hybrid(x):
         n = x.dense.shape[-2]
         segs = list(x.cold_segments)
@@ -319,6 +423,77 @@ def cold_as_single_ell(hf: HybridFeatures) -> SparseFeatures:
         indices=jnp.concatenate(ind),
         values=jnp.concatenate(val),
         d=hf.d,
+    )
+
+
+def feature_sharded_as_ell(fs: FeatureShardedSparse) -> SparseFeatures:
+    """View a blocked container as one flat ELL over the BLOCKED column
+    space (width F * d_shard): global id = block * d_shard + local. For
+    once-per-run consumers (feature statistics), not hot kernels."""
+    n, F, k = fs.indices.shape
+    base = (
+        jnp.arange(F, dtype=fs.indices.dtype) * fs.d_shard
+    )[None, :, None]
+    d_block = F * fs.d_shard
+    glob = jnp.where(fs.indices < fs.d_shard, fs.indices + base, d_block)
+    return SparseFeatures(
+        indices=glob.reshape(n, F * k),
+        values=fs.values.reshape(n, F * k),
+        d=d_block,
+    )
+
+
+def blocked_column_map(d: int, num_blocks: int) -> np.ndarray:
+    """(d,) original column -> blocked position, for the round-robin
+    blocking ``shard_columns`` applies: column c lives in block c % F at
+    local id c // F. Used to block/unblock coefficient, bound, and
+    normalization vectors."""
+    c = np.arange(d, dtype=np.int64)
+    d_shard = -(-d // num_blocks)
+    return (c % num_blocks) * d_shard + c // num_blocks
+
+
+def shard_columns(
+    sf: SparseFeatures, num_blocks: int, dtype=None
+) -> FeatureShardedSparse:
+    """Block an ELL matrix by column for feature-sharded solves
+    (host-side, once per dataset). Columns are assigned round-robin
+    (block = c % F) so frequency-sorted vocabularies — the common layout
+    after ``cli/build_index`` — spread their hot columns evenly across
+    blocks. ``blocked_column_map`` gives the induced coefficient layout.
+
+    The per-(row, block) width k is the max over the dataset; round-robin
+    keeps it near nnz/F for non-adversarial column distributions.
+    """
+    if num_blocks < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+    F = num_blocks
+    d_shard = -(-sf.d // F)
+    out_dtype = np.dtype(jnp.dtype(dtype or sf.values.dtype))
+    ind = np.asarray(sf.indices)
+    val = np.asarray(sf.values)
+    n, k = ind.shape
+    keep = ind < sf.d
+    rows = np.broadcast_to(np.arange(n)[:, None], ind.shape)[keep]
+    cols = ind[keep].astype(np.int64)
+    vals = val[keep]
+    blk = cols % F
+    loc = cols // F
+    key = rows * F + blk
+    counts = np.bincount(key, minlength=n * F)
+    k_new = int(counts.max()) if counts.size and counts.max() > 0 else 1
+    order = np.argsort(key, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    slot = np.arange(key.size) - starts[key[order]]
+    indices = np.full((n, F, k_new), d_shard, np.int32)
+    values = np.zeros((n, F, k_new), out_dtype)
+    indices[rows[order], blk[order], slot] = loc[order]
+    values[rows[order], blk[order], slot] = vals[order]
+    return FeatureShardedSparse(
+        indices=jnp.asarray(indices),
+        values=jnp.asarray(values),
+        d_shard=d_shard,
+        d_orig=sf.d,
     )
 
 
@@ -507,7 +682,10 @@ def from_dense(x: np.ndarray, nnz_per_row: int = 0, dtype=jnp.float32) -> Sparse
 
 def to_dense(sf) -> np.ndarray:
     """Densify (small problems / tests only). Hybrid matrices come back
-    in ORIGINAL row order (row_perm inverted)."""
+    in ORIGINAL row order (row_perm inverted); feature-sharded matrices
+    come back in BLOCKED column order (width F * d_shard)."""
+    if is_feature_sharded(sf):
+        return to_dense(feature_sharded_as_ell(sf))
     if is_hybrid(sf):
         stored = np.concatenate(
             [to_dense(seg) for seg in sf.cold_segments]

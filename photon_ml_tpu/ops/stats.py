@@ -54,6 +54,15 @@ def summarize_features(
     Sparse batches take the scatter-kernel path (implicit zeros included in
     every statistic, matching the dense semantics)."""
     x = batch.features
+    if sparse_ops.is_feature_sharded(x):
+        import dataclasses as _dc
+
+        # flatten to one ELL over the blocked column space; statistics come
+        # back in blocked layout, matching the solver's coefficient layout
+        flat = _dc.replace(
+            batch, features=sparse_ops.feature_sharded_as_ell(x)
+        )
+        return _summarize_sparse(flat, axis_name)
     if sparse_ops.is_hybrid(x):
         return _summarize_hybrid(batch, axis_name)
     if sparse_ops.is_sparse(x):

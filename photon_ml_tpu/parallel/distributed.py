@@ -80,63 +80,121 @@ def feature_sharded_train_glm(
     inherit w's sharding through the jitted solver, so the whole solve is
     SPMD with coefficient state split across devices.
 
-    Rows pad to the 'data' extent and columns to the 'feature' extent
-    (zero columns solve to exactly 0 and are dropped from the returned
-    coefficients). Dense features only; box constraints and feature-axis
-    normalization are currently unsupported here.
-    """
-    if hasattr(batch.features, "values"):
-        raise ValueError("feature sharding currently requires dense features")
-    if config.lower_bounds is not None or config.upper_bounds is not None:
-        raise ValueError("feature sharding does not support box constraints")
-    from photon_ml_tpu.core.normalization import NormalizationType
+    Dense designs shard by contiguous column pad; SPARSE (padded-ELL)
+    designs are column-BLOCKED into a ``FeatureShardedSparse`` container
+    (round-robin columns -> blocks, local ids per block) so the gradient /
+    CG scatter targets are each device's local coefficient block — the
+    sparse analog of the reference's per-block aggregation
+    (``function/ValueAndGradientAggregator.scala:204-220``) at the
+    >200k-feature scale of ``util/PalDBIndexMap.scala:43``.
 
-    if config.normalization != NormalizationType.NONE:
-        raise ValueError("feature sharding requires NormalizationType.NONE")
+    Normalization and box constraints are supported in both cases: the
+    (d,)-vectors they carry (factors, shifts, bounds, intercept position)
+    are re-laid-out into the blocked coefficient space, exactly as the
+    reference's normalization algebra rides its aggregators unchanged
+    (``normalization/NormalizationContext.scala:41-151``). Rows pad to
+    the 'data' extent; columns added by blocking/padding solve to 0 and
+    are dropped from the returned coefficients.
+    """
+    from photon_ml_tpu.ops import sparse as sparse_ops
+
+    if sparse_ops.is_hybrid(batch.features):
+        raise ValueError(
+            "feature sharding takes dense or ELL (SparseFeatures) designs; "
+            "hybrid containers are a single-chip layout — pass the ELL"
+        )
+    if sparse_ops.is_feature_sharded(batch.features):
+        raise ValueError(
+            "feature sharding takes dense or ELL (SparseFeatures) designs; "
+            "the batch is already column-blocked — pass the pre-blocking ELL "
+            "(blocking is internal to feature_sharded_train_glm)"
+        )
 
     n_rows_shards = mesh.shape[DATA_AXIS]
     n_col_shards = mesh.shape[FEATURE_AXIS]
     d = batch.num_features
-    d_pad = -(-d // n_col_shards) * n_col_shards
     n = batch.batch_size
     n_pad = -(-n // n_rows_shards) * n_rows_shards
-
-    padded = LabeledBatch.pad_to(batch, n_pad)
-    feats = jnp.pad(padded.features, ((0, 0), (0, d_pad - d)))
     row_spec = NamedSharding(mesh, P(DATA_AXIS))
+
+    if sparse_ops.is_sparse(batch.features):
+        blocked = sparse_ops.shard_columns(batch.features, n_col_shards)
+        col_map = sparse_ops.blocked_column_map(d, n_col_shards)
+        d_block = n_col_shards * blocked.d_shard
+        padded = LabeledBatch.pad_to(
+            dataclasses.replace(batch, features=blocked), n_pad
+        )
+        feat_spec = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS, None))
+    else:
+        d_block = -(-d // n_col_shards) * n_col_shards
+        col_map = np.arange(d, dtype=np.int64)
+        padded = LabeledBatch.pad_to(batch, n_pad)
+        padded = dataclasses.replace(
+            padded,
+            features=jnp.pad(padded.features, ((0, 0), (0, d_block - d))),
+        )
+        feat_spec = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
+
     padded = LabeledBatch(
-        features=jax.device_put(
-            feats, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
+        features=jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, feat_spec), padded.features
         ),
         labels=jax.device_put(padded.labels, row_spec),
         offsets=jax.device_put(padded.offsets, row_spec),
         weights=jax.device_put(padded.weights, row_spec),
         mask=jax.device_put(padded.mask, row_spec),
     )
+
+    def block_vector(v, fill):
+        if v is None:
+            return None
+        out = np.full((d_block,), fill, dtype=float)
+        out[col_map] = np.asarray(v, dtype=float)
+        return tuple(out.tolist())
+
+    blocked_config = dataclasses.replace(
+        config,
+        intercept_index=(
+            None
+            if config.intercept_index is None
+            else int(col_map[config.intercept_index])
+        ),
+        lower_bounds=block_vector(config.lower_bounds, -np.inf),
+        upper_bounds=block_vector(config.upper_bounds, np.inf),
+    )
+
+    dtype = np.dtype(jnp.promote_types(padded.features.dtype, jnp.float32))
     if initial_coefficients is not None:
-        w0_host = jnp.pad(
-            jnp.asarray(initial_coefficients.means, padded.features.dtype),
-            (0, d_pad - d),
+        w0_host = np.zeros((d_block,), dtype)
+        w0_host[col_map] = np.asarray(initial_coefficients.means, dtype)
+        init = Coefficients(
+            means=jax.device_put(
+                jnp.asarray(w0_host), NamedSharding(mesh, P(FEATURE_AXIS))
+            )
         )
     else:
-        w0_host = jnp.zeros((d_pad,), padded.features.dtype)
-    w0 = jax.device_put(w0_host, NamedSharding(mesh, P(FEATURE_AXIS)))
+        init = Coefficients(
+            means=jax.device_put(
+                jnp.zeros((d_block,), dtype),
+                NamedSharding(mesh, P(FEATURE_AXIS)),
+            )
+        )
     with jax.set_mesh(mesh):
         models = train_glm(
-            padded,
-            config,
-            initial_coefficients=Coefficients(means=w0),
-            **kwargs,
+            padded, blocked_config, initial_coefficients=init, **kwargs
         )
-    # strip the zero pad columns from every returned model
+    # map every returned model back to the original column order
+    unblock = jnp.asarray(col_map)
     out = []
     for tm in models:
         coef = tm.model.coefficients
         coef = dataclasses.replace(
             coef,
-            means=coef.means[:d],
+            means=coef.means[unblock],
             variances=(
-                None if coef.variances is None else coef.variances[:d]
+                None
+                if coef.variances is None
+                else coef.variances[unblock]
             ),
         )
         out.append(

@@ -262,19 +262,297 @@ class TestFeatureSharding:
             atol=1e-8,
         )
 
-    def test_constraints_rejected(self, rng, devices):
+    def test_constraints_match_local(self, rng, devices):
+        """Box constraints ride feature sharding: bound vectors are re-laid
+        out into the blocked coefficient space (pad columns unconstrained),
+        matching ``OptimizationUtils.projectCoefficientsToHypercube``."""
+        from photon_ml_tpu.models.training import OptimizerType
         from photon_ml_tpu.parallel import (
             feature_sharded_train_glm,
             make_feature_mesh,
         )
 
-        batch = self._data(rng, n=100, d=8)
+        d = 13
+        batch = self._data(rng, n=300, d=d)
         cfg = GLMTrainingConfig(
-            reg_weights=(1.0,),
-            lower_bounds=tuple([-1.0] * 8),
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(0.5,),
+            lower_bounds=tuple([-0.2] * d),
+            upper_bounds=tuple([0.2] * d),
+            max_iters=60,
+            tolerance=1e-12,
             track_states=False,
         )
-        with pytest.raises(ValueError, match="box constraints"):
+        mesh = make_feature_mesh(2, 4)
+        (dist,) = feature_sharded_train_glm(batch, cfg, mesh)
+        (local,) = train_glm(batch, cfg)
+        wd = np.asarray(dist.model.coefficients.means)
+        assert np.all(wd >= -0.2 - 1e-12) and np.all(wd <= 0.2 + 1e-12)
+        np.testing.assert_allclose(
+            wd, np.asarray(local.model.coefficients.means), atol=1e-8
+        )
+
+    def test_standardization_matches_local(self, rng, devices):
+        """Feature-sharded standardization == unsharded (VERDICT r3 #9,
+        ``normalization/NormalizationContext.scala:41-151``): factors and
+        shifts are computed in and applied to the blocked layout."""
+        from photon_ml_tpu.core.normalization import NormalizationType
+        from photon_ml_tpu.models.training import OptimizerType
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        d = 21
+        x = rng.normal(size=(400, d)) * rng.uniform(1, 9, size=d)
+        x[:, -1] = 1.0  # intercept
+        w = rng.normal(size=d)
+        y = (rng.uniform(size=400) < 1 / (1 + np.exp(-x @ w * 0.1))).astype(
+            float
+        )
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+        cfg = GLMTrainingConfig(
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            normalization=NormalizationType.STANDARDIZATION,
+            intercept_index=d - 1,
+            max_iters=60,
+            tolerance=1e-12,
+            track_states=False,
+            compute_variances=True,
+        )
+        mesh = make_feature_mesh(2, 4)
+        (dist,) = feature_sharded_train_glm(batch, cfg, mesh)
+        (local,) = train_glm(batch, cfg)
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.means),
+            np.asarray(local.model.coefficients.means),
+            atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.variances),
+            np.asarray(local.model.coefficients.variances),
+            rtol=1e-8,
+        )
+
+
+class TestFeatureShardedSparse:
+    """VERDICT r3 #2: the coefficient axis shards for SPARSE designs — the
+    only honest path to the reference's huge-d claim (``README.md:58``,
+    ``util/PalDBIndexMap.scala:43``). Entries are column-blocked
+    (``ops.sparse.shard_columns``) so gradient/CG scatters hit each
+    device's local coefficient block."""
+
+    def _sparse_batch(self, rng, n, d, nnz, intercept=False, densify=True):
+        from photon_ml_tpu.ops import sparse as sparse_ops
+
+        rows = np.repeat(np.arange(n), nnz)
+        cols = rng.integers(0, d - (2 if intercept else 1), size=n * nnz)
+        vals = rng.normal(size=n * nnz)
+        if intercept:
+            rows = np.concatenate([rows, np.arange(n)])
+            cols = np.concatenate([cols, np.full(n, d - 1)])
+            vals = np.concatenate([vals, np.ones(n)])
+        sf = sparse_ops.from_coo(rows, cols, vals, n, d, dtype=jnp.float64)
+        w = rng.normal(size=d) * (rng.uniform(size=d) < 0.5)
+        z = np.asarray(sparse_ops.matvec(sf, jnp.asarray(w))) * 0.5
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(float)
+        # densify only for small oracle problems (wide tests pass densify
+        # =False: a 2048 x 120k f64 throwaway would cost ~2 GB host RAM)
+        dense = sparse_ops.to_dense(sf) if densify else None
+        return sf, dense, y
+
+    def test_kernels_match_ell(self, rng, devices):
+        from photon_ml_tpu.ops import sparse as sparse_ops
+
+        sf, _, _ = self._sparse_batch(rng, n=64, d=37, nnz=5)
+        fs = sparse_ops.shard_columns(sf, 4)
+        cmap = sparse_ops.blocked_column_map(37, 4)
+        w = rng.normal(size=37)
+        wb = np.zeros(fs.num_blocks * fs.d_shard)
+        wb[cmap] = w
+        np.testing.assert_allclose(
+            np.asarray(sparse_ops.matvec(fs, jnp.asarray(wb))),
+            np.asarray(sparse_ops.matvec(sf, jnp.asarray(w))),
+            rtol=1e-12,
+        )
+        a = rng.normal(size=64)
+        np.testing.assert_allclose(
+            np.asarray(sparse_ops.rmatvec(fs, jnp.asarray(a)))[cmap],
+            np.asarray(sparse_ops.rmatvec(sf, jnp.asarray(a))),
+            rtol=1e-12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse_ops.colsum(fs, jnp.asarray(a), square=True))[
+                cmap
+            ],
+            np.asarray(sparse_ops.colsum(sf, jnp.asarray(a), square=True)),
+            rtol=1e-12,
+        )
+
+    @pytest.mark.parametrize("optimizer", ["TRON", "LBFGS"])
+    def test_sparse_matches_local_dense(self, rng, devices, optimizer):
+        from photon_ml_tpu.models.training import OptimizerType
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        sf, dense, y = self._sparse_batch(rng, n=500, d=83, nnz=6)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType[optimizer],
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=60,
+            tolerance=1e-12,
+            track_states=False,
+        )
+        mesh = make_feature_mesh(2, 4)
+        (dist,) = feature_sharded_train_glm(
+            LabeledBatch.create(sf, y, dtype=jnp.float64), cfg, mesh
+        )
+        (local,) = train_glm(
+            LabeledBatch.create(dense, y, dtype=jnp.float64), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.means),
+            np.asarray(local.model.coefficients.means),
+            atol=1e-8,
+        )
+
+    def test_owlqn_l1_sparse(self, rng, devices):
+        """OWL-QN under feature sharding: blocked pad columns have zero
+        gradient and a positive l1 weight, so they stay exactly 0."""
+        from photon_ml_tpu.models.training import OptimizerType
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        sf, dense, y = self._sparse_batch(rng, n=400, d=45, nnz=5)
+        cfg = GLMTrainingConfig(
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("ELASTIC_NET", alpha=0.5),
+            reg_weights=(0.3,),
+            max_iters=80,
+            tolerance=1e-12,
+            track_states=False,
+        )
+        mesh = make_feature_mesh(2, 4)
+        (dist,) = feature_sharded_train_glm(
+            LabeledBatch.create(sf, y, dtype=jnp.float64), cfg, mesh
+        )
+        (local,) = train_glm(
+            LabeledBatch.create(dense, y, dtype=jnp.float64), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.means),
+            np.asarray(local.model.coefficients.means),
+            atol=1e-7,
+        )
+
+    def test_sparse_standardization_matches_local(self, rng, devices):
+        """Sparse + STANDARDIZATION under feature sharding: exercises the
+        blocked statistics path (``feature_sharded_as_ell`` ->
+        ``_summarize_sparse``) and the blocked shift/factor algebra."""
+        from photon_ml_tpu.core.normalization import NormalizationType
+        from photon_ml_tpu.models.training import OptimizerType
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        d = 31
+        sf, dense, y = self._sparse_batch(rng, n=400, d=d, intercept=True, nnz=5)
+        cfg = GLMTrainingConfig(
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            normalization=NormalizationType.STANDARDIZATION,
+            intercept_index=d - 1,
+            max_iters=60,
+            tolerance=1e-12,
+            track_states=False,
+            compute_variances=True,
+        )
+        mesh = make_feature_mesh(2, 4)
+        (dist,) = feature_sharded_train_glm(
+            LabeledBatch.create(sf, y, dtype=jnp.float64), cfg, mesh
+        )
+        (local,) = train_glm(
+            LabeledBatch.create(dense, y, dtype=jnp.float64), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.means),
+            np.asarray(local.model.coefficients.means),
+            atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.variances),
+            np.asarray(local.model.coefficients.variances),
+            rtol=1e-8,
+        )
+
+    def test_preblocked_rejected(self, rng, devices):
+        from photon_ml_tpu.ops import sparse as sparse_ops
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        sf, _, y = self._sparse_batch(rng, n=64, d=20, nnz=4)
+        fs = sparse_ops.shard_columns(sf, 4)
+        batch = LabeledBatch.create(fs, y)
+        cfg = GLMTrainingConfig(reg_weights=(1.0,), track_states=False)
+        with pytest.raises(ValueError, match="already column-blocked"):
+            feature_sharded_train_glm(batch, cfg, make_feature_mesh(2, 4))
+
+    def test_wide_120k_matches_local_ell(self, rng, devices):
+        """The VERDICT acceptance shape: d=120k sparse solve on the
+        ('data', 'feature') mesh equals the single-shard ELL solve."""
+        from photon_ml_tpu.models.training import OptimizerType
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        sf, _, y = self._sparse_batch(
+            rng, n=2048, d=120_000, nnz=8, densify=False
+        )
+        cfg = GLMTrainingConfig(
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=15,
+            tolerance=1e-8,
+            track_states=False,
+        )
+        mesh = make_feature_mesh(2, 4)
+        batch = LabeledBatch.create(sf, y, dtype=jnp.float64)
+        (dist,) = feature_sharded_train_glm(batch, cfg, mesh)
+        (local,) = train_glm(batch, cfg)
+        assert dist.model.coefficients.means.shape == (120_000,)
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.means),
+            np.asarray(local.model.coefficients.means),
+            atol=1e-8,
+        )
+
+    def test_hybrid_rejected(self, rng, devices):
+        from photon_ml_tpu.ops import sparse as sparse_ops
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        sf, _, y = self._sparse_batch(rng, n=64, d=20, nnz=4)
+        hf = sparse_ops.to_hybrid(sf, hot_columns=2)
+        batch = LabeledBatch.create(hf, y[np.asarray(hf.row_perm)])
+        cfg = GLMTrainingConfig(reg_weights=(1.0,), track_states=False)
+        with pytest.raises(ValueError, match="hybrid"):
             feature_sharded_train_glm(batch, cfg, make_feature_mesh(2, 4))
 
 
