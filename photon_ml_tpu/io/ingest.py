@@ -468,9 +468,13 @@ class IngestSource:
         native = self._native()
         if native is not None:
             try:
-                keys = native.scan_feature_keys(
+                keys, n_scanned = native.scan_feature_keys(
                     self.files, label_field=self.label_field
                 )
+                # a valid-but-empty input must fail loudly here exactly as
+                # the Python fallback does (it raises via _check_nonempty)
+                # rather than silently yielding an intercept-only vocab
+                self._check_nonempty(n_scanned)
                 if selected_keys is not None:
                     keys = [k for k in keys if k in selected_keys]
                 return FeatureVocabulary(

@@ -352,6 +352,76 @@ class TestEmptyInput:
             _force_fallback(IngestSource([path])).labeled_batch(vocab)
 
 
+class TestEmptyVocabScan:
+    def test_empty_file_build_vocab_raises(self, tmp_path):
+        """A valid-but-empty input must fail build_vocab loudly on BOTH
+        toolchains — the native scan must not silently yield an
+        intercept-only vocabulary (advisor r3)."""
+        path = str(tmp_path / "empty.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, [])
+        with pytest.raises(ValueError, match="no records found"):
+            IngestSource([path]).build_vocab()
+        with pytest.raises(ValueError, match="no records found"):
+            _force_fallback(IngestSource([path])).build_vocab()
+
+
+class TestThreadedBlockDecode:
+    """Within-file block-parallel decode (the within-host analog of the
+    reference's executor-parallel Avro parse) must produce output
+    bit-identical to the sequential read."""
+
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_matches_sequential(self, tmp_path, codec):
+        recs = _records(900, seed=5)
+        path = str(tmp_path / "blocks.avro")
+        # small blocks so the file has ~15 of them to spread over threads
+        write_avro_file(
+            path, TRAINING_EXAMPLE_SCHEMA, recs, codec=codec, block_size=64
+        )
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        seq = native.read_columnar(
+            [path], [vocab], ["userId", "songId"], decode_threads=1
+        )
+        mt = native.read_columnar(
+            [path], [vocab], ["userId", "songId"], decode_threads=4
+        )
+        assert seq["n"] == mt["n"] == 900
+        for k in ("labels", "label_present", "offsets", "weights"):
+            np.testing.assert_array_equal(seq[k], mt[k])
+        np.testing.assert_array_equal(seq["uids"], mt["uids"])
+        for key in ("userId", "songId"):
+            np.testing.assert_array_equal(
+                seq["entities"][key], mt["entities"][key]
+            )
+        for a, b in zip(seq["coo"], mt["coo"]):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+
+    def test_threaded_scan_matches(self, tmp_path):
+        recs = _records(600, seed=9)
+        path = str(tmp_path / "blocks.avro")
+        write_avro_file(
+            path, TRAINING_EXAMPLE_SCHEMA, recs, block_size=50
+        )
+        k1, n1 = native.scan_feature_keys([path])
+        # _default_decode_threads drives the threaded path internally; force
+        # a reader-level check too
+        schema = native._read_header_schema(path)
+        prog, fd = native.compile_schema(schema)
+        vs = native.NativeVocabSet([], [])
+        try:
+            r = native.NativeAvroReader(prog, fd, vs, (), collect_keys=True)
+            r.feed_file(path, decode_threads=4)
+            k4 = r.distinct_keys()
+            assert r.num_records == n1 == 600
+            r.close()
+        finally:
+            vs.close()
+        assert sorted(k1) == sorted(k4)
+
+
 class TestParallelFiles:
     def test_multi_file_parallel_matches_fallback(self, tmp_path):
         """4 part files decode in parallel threads; row order must equal
@@ -547,6 +617,43 @@ class TestNativeWriter:
         assert recs[7]["label"] is None
         assert recs[8]["label"] == 8.0
         assert recs[9]["weight"] == 18.0
+
+    def test_float_fields_roundtrip(self, tmp_path):
+        """float / [null, float] fields take the 4-byte wire op — a
+        double-width encode silently corrupted these (advisor r3: 1.5
+        read back as 0.0)."""
+        from photon_ml_tpu.io.avro import read_avro_file
+        from photon_ml_tpu.io.native import write_columnar_avro
+
+        schema = {
+            "name": "F",
+            "type": "record",
+            "fields": [
+                {"name": "x", "type": "float"},
+                {"name": "y", "type": ["null", "float"], "default": None},
+                {"name": "z", "type": "double"},
+            ],
+        }
+        n = 100
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal(n)
+        y = rng.standard_normal(n)
+        present = np.arange(n) % 4 != 0
+        z = rng.standard_normal(n)
+        path = str(tmp_path / "floats.avro")
+        write_columnar_avro(
+            path, schema, {"x": x, "y": (y, present), "z": z}, n
+        )
+        _, recs = read_avro_file(path)
+        np.testing.assert_allclose(
+            [r["x"] for r in recs], x.astype(np.float32), rtol=1e-6
+        )
+        np.testing.assert_allclose([r["z"] for r in recs], z)
+        for i in (0, 1, 2, 3, 4, 99):
+            if present[i]:
+                assert abs(recs[i]["y"] - float(np.float32(y[i]))) < 1e-6
+            else:
+                assert recs[i]["y"] is None
 
     def test_writer_failure_falls_back_with_log(self, tmp_path, caplog):
         """A native-writer failure must fall back to the Python codec AND

@@ -423,6 +423,37 @@ class TestFeatureShardedSparse:
             atol=1e-8,
         )
 
+    def test_odd_row_count_pads(self, rng, devices):
+        """n not divisible by the data axis: rows pad through the blocked
+        container's pad_rows branch (all-padding slots, masked rows)."""
+        from photon_ml_tpu.models.training import OptimizerType
+        from photon_ml_tpu.parallel import (
+            feature_sharded_train_glm,
+            make_feature_mesh,
+        )
+
+        sf, dense, y = self._sparse_batch(rng, n=401, d=53, nnz=6)
+        cfg = GLMTrainingConfig(
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=60,
+            tolerance=1e-12,
+            track_states=False,
+        )
+        mesh = make_feature_mesh(2, 4)
+        (dist,) = feature_sharded_train_glm(
+            LabeledBatch.create(sf, y, dtype=jnp.float64), cfg, mesh
+        )
+        (local,) = train_glm(
+            LabeledBatch.create(dense, y, dtype=jnp.float64), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(dist.model.coefficients.means),
+            np.asarray(local.model.coefficients.means),
+            atol=1e-8,
+        )
+
     def test_owlqn_l1_sparse(self, rng, devices):
         """OWL-QN under feature sharding: blocked pad columns have zero
         gradient and a positive l1 weight, so they stay exactly 0."""
