@@ -195,6 +195,10 @@ class CoordinateSpec:
     # ceil(ratio * numSamples_e) features per entity
     # (``RandomEffectDataConfiguration.numFeaturesToSamplesRatioUpperBound``)
     feature_ratio: Optional[float] = None
+    # per-entity support filter: a feature survives iff stored in >= this
+    # many of the entity's active rows; applied BEFORE the Pearson ranking
+    # (``LocalDataSet.filterFeaturesBySupport``, LocalDataSet.scala:80-109)
+    min_support: int = 0
     # factored random effect (w_e = B gamma_e): set latent_dim to enable
     # (``MFOptimizationConfiguration`` "numInnerIter,latentDim" + the
     # latent-matrix sub-config of the reference's triple-config string)
@@ -259,11 +263,22 @@ class GameDriverParams:
                 or spec.latent_dim is not None
                 or spec.projector
             )
-            if uses_sparse and entityish:
+            # a WIDE random effect rides a sparse shard through INDEX_MAP
+            # projection (per-entity active unions are small even when d
+            # is huge — ``RandomEffectCoordinateInProjectedSpace.scala``);
+            # everything else per-entity still needs dense rows
+            sparse_re_ok = (
+                spec.random_effect is not None
+                and spec.latent_dim is None
+                and (spec.projector or "").strip().upper() == "INDEX_MAP"
+            )
+            if uses_sparse and entityish and not sparse_re_ok:
                 raise ValueError(
                     f"coordinate {name!r} uses sparse shard "
                     f"{spec.shard!r} but random/factored/projected "
-                    "effects need dense per-row features"
+                    "effects need dense per-row features (EXCEPT a "
+                    "random effect with projector INDEX_MAP, which "
+                    "solves in each entity's compact column space)"
                 )
             if spec.hot_columns and (entityish or not uses_sparse):
                 raise ValueError(

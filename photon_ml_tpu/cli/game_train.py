@@ -126,6 +126,31 @@ def build_coordinates(
                 hybrid_pack=hybrid_pack,
             )
         else:
+            from photon_ml_tpu.ops import sparse as sparse_ops
+
+            if sparse_ops.is_sparse(data.features[spec.shard]):
+                # wide-sparse random effect: INDEX_MAP projection straight
+                # from the ELL (config.validate() guarantees the projector)
+                cache_key = f"{name}\x00sparse_projected"
+                if design_cache is not None and cache_key in design_cache:
+                    coords[name] = design_cache[cache_key].with_config(cfg)
+                else:
+                    coord = ProjectedRandomEffectCoordinate.from_sparse_shard(
+                        data,
+                        spec.random_effect,
+                        spec.shard,
+                        entity_counts[spec.random_effect],
+                        cfg,
+                        num_buckets=spec.num_buckets,
+                        active_cap=spec.active_cap,
+                        dtype=dtype,
+                        feature_ratio=spec.feature_ratio,
+                        min_support=spec.min_support,
+                    )
+                    if design_cache is not None:
+                        design_cache[cache_key] = coord
+                    coords[name] = coord
+                continue
             if design_cache is not None and name in design_cache:
                 design = design_cache[name]
             else:
@@ -138,6 +163,7 @@ def build_coordinates(
                     active_cap=spec.active_cap,
                     dtype=dtype,
                     feature_ratio=spec.feature_ratio,
+                    min_support=spec.min_support,
                 )
                 if design_cache is not None:
                     design_cache[name] = design
@@ -595,6 +621,9 @@ def run_game_training(params) -> GameTrainingRun:
 
 
 def main(argv=None) -> None:
+    from photon_ml_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     p = argparse.ArgumentParser(
         prog="photon_ml_tpu.cli.game_train",
         description="Train GAME (fixed + random effects) models.",

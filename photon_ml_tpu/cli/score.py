@@ -343,6 +343,9 @@ def run_scoring(params) -> ScoringRun:
 
 
 def main(argv=None) -> None:
+    from photon_ml_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     p = argparse.ArgumentParser(
         prog="photon_ml_tpu.cli.score",
         description="Score data with a trained GLM or GAME model.",

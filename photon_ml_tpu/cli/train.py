@@ -500,6 +500,9 @@ def params_from_args(args, cls) -> dict:
 
 
 def main(argv=None) -> None:
+    from photon_ml_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     args = build_arg_parser().parse_args(argv)
     run_glm_training(params_from_args(args, GLMDriverParams))
 

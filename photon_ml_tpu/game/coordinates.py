@@ -342,6 +342,19 @@ class FixedEffectCoordinate:
         """update + full-batch rescore, fused into one dispatch (on
         remote/tunneled devices each dispatch is a round trip; the
         coordinate-descent loop uses this form)."""
+        return self.update_step(w, partial_scores, key)
+
+    def wrap_tracker(self, tracker):
+        """Fused-pass hook: raw tracker pytree -> history object (identity
+        here; SolverResult is already what materialize() reads)."""
+        return tracker
+
+    def update_step(
+        self, w: jax.Array, partial_scores: jax.Array, key=None
+    ) -> Tuple[jax.Array, object, jax.Array]:
+        """TRACE-SAFE update + rescore: pure function of device values
+        (jit-inlinable), returning only pytrees — the unit the fused
+        whole-pass CD dispatch composes (``descent.py``)."""
         weights = self.batch.weights
         if self._downsample is not None:
             if key is None:
@@ -579,7 +592,18 @@ class RandomEffectCoordinate:
         self, table: jax.Array, partial_scores: jax.Array, key=None
     ) -> Tuple[jax.Array, object, jax.Array]:
         """All bucket solves + the full-row rescore in ONE dispatch."""
-        table, trackers, scores = self._update_all(
+        table, trackers, scores = self.update_step(
+            table, partial_scores, key
+        )
+        return table, self.wrap_tracker(trackers), scores
+
+    def update_step(
+        self, table: jax.Array, partial_scores: jax.Array, key=None
+    ) -> Tuple[jax.Array, tuple, jax.Array]:
+        """Trace-safe form: returns the RAW per-bucket tracker tuple (a
+        pytree) instead of the lazy summary object, so the fused CD pass
+        can return it through jit."""
+        return self._update_all(
             table,
             self.reg_weights,
             self.full_offsets_base + partial_scores,
@@ -588,11 +612,14 @@ class RandomEffectCoordinate:
             self.row_features,
             self.row_entities,
         )
+
+    def wrap_tracker(self, trackers: tuple) -> "RandomEffectUpdateSummary":
+        """Raw (reason, iterations) bucket tuple -> lazy history summary."""
         pending = [
             (reason, iters, valid)
             for (reason, iters), valid in zip(trackers, self._valid_lanes)
         ]
-        return table, RandomEffectUpdateSummary(pending=pending), scores
+        return RandomEffectUpdateSummary(pending=pending)
 
     def score(self, table: jax.Array) -> jax.Array:
         return self._score(table, self.row_features, self.row_entities)

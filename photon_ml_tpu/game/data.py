@@ -240,6 +240,29 @@ def pearson_correlation_scores(
     return np.where(present, np.abs(score), -np.inf)
 
 
+def filter_features_by_support(
+    design: RandomEffectDesign, min_support: int
+) -> RandomEffectDesign:
+    """Per-entity support filter (``LocalDataSet.filterFeaturesBySupport``,
+    ``LocalDataSet.scala:80-109``): a feature survives for an entity iff
+    it is STORED (nonzero here — the dense analog of activeKeysIterator)
+    in at least ``min_support`` of that entity's active rows. Dropped
+    columns are zeroed so their coefficients solve to exactly 0. The
+    cheap pre-filter the reference offers ahead of the Pearson ranking."""
+    if min_support <= 0:
+        return design
+    feats = np.asarray(design.features)
+    mask = np.asarray(design.mask) > 0
+    support = ((feats != 0.0) & mask[:, :, None]).sum(axis=1)  # (E, d)
+    keep = support >= min_support
+    return dataclasses.replace(
+        design,
+        features=jnp.asarray(
+            np.where(keep[:, None, :], feats, 0.0), design.features.dtype
+        ),
+    )
+
+
 def select_features_by_pearson(
     design: RandomEffectDesign, ratio: float
 ) -> RandomEffectDesign:
@@ -277,6 +300,7 @@ def build_random_effect_design(
     seed: int = 0,
     dtype=jnp.float32,
     feature_ratio: Optional[float] = None,
+    min_support: int = 0,
 ) -> RandomEffectDesign:
     """Group rows by entity into padded tensors (host-side, once per run).
 
@@ -321,6 +345,9 @@ def build_random_effect_design(
         cap,
         dtype,
     )
+    # support filter first, Pearson ranking second (the reference's
+    # LocalDataSet order: the cheap count-based cut precedes the ranking)
+    design = filter_features_by_support(design, min_support)
     if feature_ratio is not None:
         design = select_features_by_pearson(design, feature_ratio)
     return design
@@ -413,6 +440,7 @@ def build_bucketed_random_effect_design(
     seed: int = 0,
     dtype=jnp.float32,
     feature_ratio: Optional[float] = None,
+    min_support: int = 0,
 ) -> BucketedRandomEffectDesign:
     """Like :func:`build_random_effect_design` but with per-size-class row
     caps. Entities (those with data) are sorted by row count and split into
@@ -501,6 +529,7 @@ def build_bucketed_random_effect_design(
             cap_b,
             dtype,
         )
+        bucket = filter_features_by_support(bucket, min_support)
         if feature_ratio is not None:
             bucket = select_features_by_pearson(bucket, feature_ratio)
         buckets.append(bucket)

@@ -112,6 +112,39 @@ class CoordinateDescent:
 
         self._full_objective = full_objective
 
+    def _fused_pass_fn(self):
+        """ONE jitted dispatch for a FULL coordinate-descent pass: every
+        coordinate's update_step + rescore + per-update training objective,
+        unrolled in sequence inside a single XLA program. On a tunneled /
+        remote device each dispatch is a network round trip, so the
+        unfused loop (2 updates + 2 objectives + score arithmetic) pays
+        ~6 latencies per pass; this pays ONE. Used by run() whenever no
+        validation_fn is supplied and every coordinate exposes the
+        trace-safe update_step (all in-tree coordinates do)."""
+        if getattr(self, "_fused_pass", None) is None:
+            names = list(self.coordinates)
+            coords = self.coordinates
+
+            @jax.jit
+            def one_pass(params, scores, key):
+                objs = []
+                trackers = []
+                for name in names:
+                    total = sum(scores.values())
+                    partial = total - scores[name]
+                    key, sub = jax.random.split(key)
+                    p, tr, s = coords[name].update_step(
+                        params[name], partial, sub
+                    )
+                    params = {**params, name: p}
+                    scores = {**scores, name: s}
+                    objs.append(self._full_objective(scores, params))
+                    trackers.append(tr)
+                return params, scores, key, tuple(objs), tuple(trackers)
+
+            self._fused_pass = one_pass
+        return self._fused_pass
+
     def _reg_term(self, name: str, params) -> jax.Array:
         """Delegates to the coordinate when it defines its own penalty
         (factored coordinates penalize gamma and B under different
@@ -227,48 +260,75 @@ class CoordinateDescent:
                 )
             pending.clear()
 
+        use_fused = validation_fn is None and all(
+            hasattr(c, "update_step") for c in self.coordinates.values()
+        )
         for it in range(start_it, num_iterations):
-            for name in names:
+            if use_fused:
                 t0 = time.perf_counter()
-                coord = self.coordinates[name]
-                total = sum(scores.values())
-                partial = total - scores[name]
-                key, sub = jax.random.split(key)
-                if hasattr(coord, "update_and_score"):
-                    params, result, new_scores = coord.update_and_score(
-                        model.params[name], partial, sub
-                    )
-                else:
-                    params, result = coord.update(
-                        model.params[name], partial, sub
-                    )
-                    new_scores = coord.score(params)
-                model.params[name] = params
-                scores[name] = new_scores
-
-                obj = self._full_objective(scores, model.params)
-                # seconds measures host dispatch+update wall time; with
-                # deferred stats the device may still be draining
+                fused = self._fused_pass_fn()
+                params_in = {n: model.params[n] for n in names}
+                params_out, scores, key, objs, trackers = fused(
+                    params_in, scores, key
+                )
+                model.params.update(params_out)
                 seconds = time.perf_counter() - t0
-                vmetric = (
-                    float(validation_fn(model))
-                    if validation_fn is not None
-                    else None
-                )
-                pending.append(
-                    {
-                        "iteration": it,
-                        "coordinate": name,
-                        "objective": obj,
-                        "seconds": seconds,
-                        "validation_metric": vmetric,
-                        # the result object is kept whole: reading
-                        # .reason/.iterations on a RandomEffectUpdateSummary
-                        # materializes device buffers, which must not happen
-                        # until materialize()
-                        "result": result,
-                    }
-                )
+                for name, obj, tr in zip(names, objs, trackers):
+                    pending.append(
+                        {
+                            "iteration": it,
+                            "coordinate": name,
+                            "objective": obj,
+                            "seconds": seconds / len(names),
+                            "validation_metric": None,
+                            "result": self.coordinates[name].wrap_tracker(
+                                tr
+                            ),
+                        }
+                    )
+            else:
+                for name in names:
+                    t0 = time.perf_counter()
+                    coord = self.coordinates[name]
+                    total = sum(scores.values())
+                    partial = total - scores[name]
+                    key, sub = jax.random.split(key)
+                    if hasattr(coord, "update_and_score"):
+                        params, result, new_scores = coord.update_and_score(
+                            model.params[name], partial, sub
+                        )
+                    else:
+                        params, result = coord.update(
+                            model.params[name], partial, sub
+                        )
+                        new_scores = coord.score(params)
+                    model.params[name] = params
+                    scores[name] = new_scores
+
+                    obj = self._full_objective(scores, model.params)
+                    # seconds measures host dispatch+update wall time; with
+                    # deferred stats the device may still be draining
+                    seconds = time.perf_counter() - t0
+                    vmetric = (
+                        float(validation_fn(model))
+                        if validation_fn is not None
+                        else None
+                    )
+                    pending.append(
+                        {
+                            "iteration": it,
+                            "coordinate": name,
+                            "objective": obj,
+                            "seconds": seconds,
+                            "validation_metric": vmetric,
+                            # the result object is kept whole: reading
+                            # .reason/.iterations on a
+                            # RandomEffectUpdateSummary materializes device
+                            # buffers, which must not happen until
+                            # materialize()
+                            "result": result,
+                        }
+                    )
             if (
                 checkpoint_dir is not None
                 and (it + 1 - start_it) % checkpoint_every == 0

@@ -273,6 +273,17 @@ class FactoredRandomEffectCoordinate:
     def score(self, params: FactoredParams) -> jax.Array:
         return self._score(params, self.row_features, self.row_entities)
 
+    def update_step(
+        self, params: FactoredParams, partial_scores: jax.Array, key=None
+    ) -> Tuple[FactoredParams, object, jax.Array]:
+        """Trace-safe update + rescore (the fused CD pass's unit): the
+        alternating gamma/B loop above is pure jnp, so it inlines."""
+        new_params, result = self.update(params, partial_scores, key)
+        return new_params, result, self.score(new_params)
+
+    def wrap_tracker(self, tracker):
+        return tracker
+
     def reg_term(self, params: FactoredParams) -> jax.Array:
         """gamma is penalized under the RE config, B under the latent-factor
         config — the exact quantities the two inner solves minimize."""

@@ -70,19 +70,79 @@ def build_index_map_columns(
 
     O(nnz) in time and memory: works on the nonzero coordinates directly
     (never a dense (E, d) presence matrix, which would defeat INDEX_MAP's
-    purpose in the wide-feature regime it exists for)."""
+    purpose in the wide-feature regime it exists for). Accepts dense OR
+    padded-ELL (``SparseFeatures``) shards — the sparse case is the whole
+    point of INDEX_MAP (wide shards whose per-entity active unions are
+    small, ``RandomEffectCoordinateInProjectedSpace.scala:26-120``)."""
     from photon_ml_tpu.game.projectors import columns_from_active_pairs
+    from photon_ml_tpu.ops import sparse as sparse_ops
 
-    x = np.asarray(data.features[shard])
-    d = x.shape[1]
+    x = data.features[shard]
     eids = np.asarray(data.entity_ids[random_effect])
-    rows, feat_cols = np.nonzero(x)
-    ent = eids[rows]
-    known = ent >= 0
-    cols = columns_from_active_pairs(
-        ent[known], feat_cols[known], d, num_entities
-    )
+    if sparse_ops.is_sparse(x):
+        ind = np.asarray(x.indices)
+        d = x.d
+        keep = (ind < d) & (eids[:, None] >= 0)
+        rows = np.broadcast_to(
+            np.arange(ind.shape[0])[:, None], ind.shape
+        )[keep]
+        ent = eids[rows]
+        feat_cols = ind[keep]
+    else:
+        x = np.asarray(x)
+        d = x.shape[1]
+        rows, feat_cols = np.nonzero(x)
+        ent = eids[rows]
+        known = ent >= 0
+        ent, feat_cols = ent[known], feat_cols[known]
+    cols = columns_from_active_pairs(ent, feat_cols, d, num_entities)
     return IndexMapProjection(columns=jnp.asarray(cols, jnp.int32))
+
+
+def project_sparse_rows(
+    sf,
+    entities: np.ndarray,
+    projection: IndexMapProjection,
+    dtype=np.float32,
+) -> np.ndarray:
+    """Project padded-ELL rows into each row's OWN entity's compact column
+    space: (n, nnz) ELL -> dense (n, k) where k = max per-entity active
+    columns. The sparse analog of
+    ``IndexMapProjection.project_row_features`` — entries whose (entity,
+    column) pair is outside the entity's active union are dropped (score
+    0), exactly the reference's projected-space scoring semantics.
+    Host-side, O(nnz log nnz), once per run."""
+    from photon_ml_tpu.ops import sparse as sparse_ops
+
+    if not sparse_ops.is_sparse(sf):
+        raise ValueError("project_sparse_rows takes a SparseFeatures shard")
+    cols_np = np.asarray(projection.columns)
+    e_count, k = cols_np.shape
+    d = sf.d
+    valid = cols_np >= 0
+    ent_of = np.broadcast_to(
+        np.arange(e_count)[:, None], cols_np.shape
+    )[valid]
+    slot_of = np.broadcast_to(np.arange(k)[None, :], cols_np.shape)[valid]
+    pair = ent_of.astype(np.int64) * d + cols_np[valid]
+    order = np.argsort(pair, kind="stable")
+    pair = pair[order]
+    slot_sorted = slot_of[order]
+
+    ind = np.asarray(sf.indices)
+    val = np.asarray(sf.values)
+    n = ind.shape[0]
+    ents = np.asarray(entities).astype(np.int64)
+    entry_ok = (ind < d) & (ents[:, None] >= 0)
+    rows_e = np.broadcast_to(np.arange(n)[:, None], ind.shape)[entry_ok]
+    epair = ents[rows_e] * d + ind[entry_ok].astype(np.int64)
+    evals = val[entry_ok]
+    loc = np.searchsorted(pair, epair)
+    loc = np.clip(loc, 0, max(pair.size - 1, 0))
+    hit = pair[loc] == epair if pair.size else np.zeros(epair.shape, bool)
+    out = np.zeros((n, k), dtype)
+    np.add.at(out, (rows_e[hit], slot_sorted[loc[hit]]), evals[hit])
+    return out
 
 
 def _project_design_bucket(
@@ -183,6 +243,101 @@ class ProjectedRandomEffectCoordinate:
             reg_weights=reg_weights,
         )
 
+    @classmethod
+    def from_sparse_shard(
+        cls,
+        data,  # GameData with a SparseFeatures shard
+        random_effect: str,
+        shard: str,
+        num_entities: int,
+        config: CoordinateConfig,
+        num_buckets: int = 4,
+        active_cap: Optional[int] = None,
+        entity_multiple: int = 1,
+        seed: int = 0,
+        dtype=None,
+        reg_weights: Optional[jax.Array] = None,
+        feature_ratio: Optional[float] = None,
+        min_support: int = 0,
+    ) -> "ProjectedRandomEffectCoordinate":
+        """Wide-sparse random effects: build an INDEX_MAP-projected
+        coordinate STRAIGHT from a padded-ELL shard, never materializing
+        the (E, rows, d) original-space design — the regime of
+        ``RandomEffectCoordinateInProjectedSpace.scala:26-120`` +
+        ``IndexMapProjectorRDD.scala:113-120``, where d is huge but each
+        entity touches few columns.
+
+        Pipeline (host-side, once per run): per-entity active-column
+        union -> project every row into its own entity's compact space
+        (dense (n, k), k = max union size) -> reuse the standard bucketed
+        builder/capping/scoring machinery on that dense view. Training,
+        scoring, reservoir caps, Pearson filters and checkpointing all
+        work unchanged; ``back_project`` scatters the (E, k) table to
+        original d-space for persistence."""
+        import dataclasses as _dc
+        import jax.numpy as jnp_
+
+        from photon_ml_tpu.game.data import (
+            build_bucketed_random_effect_design,
+        )
+
+        dtype = dtype or jnp_.float32
+        projector = build_index_map_columns(
+            data, random_effect, shard, num_entities
+        )
+        proj_rows_np = project_sparse_rows(
+            data.features[shard],
+            np.asarray(data.entity_ids[random_effect]),
+            projector,
+            dtype=np.dtype(jnp.dtype(dtype)),
+        )
+        proj_data = _dc.replace(
+            data, features={**data.features, shard: proj_rows_np}
+        )
+        design = build_bucketed_random_effect_design(
+            proj_data,
+            random_effect,
+            shard,
+            num_entities,
+            num_buckets=num_buckets,
+            active_cap=active_cap,
+            entity_multiple=entity_multiple,
+            seed=seed,
+            dtype=dtype,
+            feature_ratio=feature_ratio,
+            min_support=min_support,
+        )
+        proj_rows = jnp_.asarray(proj_rows_np, dtype)
+        row_entities = jnp_.asarray(
+            np.asarray(data.entity_ids[random_effect]), jnp_.int32
+        )
+        return cls(
+            design=design,
+            row_features=proj_rows,
+            row_entities=row_entities,
+            full_offsets_base=jnp_.asarray(data.offsets, dtype),
+            config=config,
+            projector=projector,
+            original_dim=data.features[shard].d,
+            reg_weights=reg_weights,
+            prebuilt=(design, proj_rows),
+        )
+
+    def with_config(self, config: CoordinateConfig) -> "ProjectedRandomEffectCoordinate":
+        """Same projected design/rows under a different optimization
+        config — the grid-sweep reuse hook (designs and projections are
+        combo-invariant; only the solver knobs change per combo)."""
+        return ProjectedRandomEffectCoordinate(
+            design=self.inner.design,
+            row_features=self.inner.row_features,
+            row_entities=self.inner.row_entities,
+            full_offsets_base=self.inner.full_offsets_base,
+            config=config,
+            projector=self.projector,
+            original_dim=self.original_dim,
+            prebuilt=(self.inner.design, self.inner.row_features),
+        )
+
     @property
     def config(self) -> CoordinateConfig:
         """CoordinateDescent reads this for the objective's reg term — the
@@ -207,6 +362,12 @@ class ProjectedRandomEffectCoordinate:
 
     def update_and_score(self, table, partial_scores, key=None):
         return self.inner.update_and_score(table, partial_scores, key=key)
+
+    def update_step(self, table, partial_scores, key=None):
+        return self.inner.update_step(table, partial_scores, key=key)
+
+    def wrap_tracker(self, trackers):
+        return self.inner.wrap_tracker(trackers)
 
     def reg_term(self, table: jax.Array) -> jax.Array:
         return self.inner.reg_term(table)

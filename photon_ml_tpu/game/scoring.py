@@ -35,6 +35,23 @@ def _random_scores(table, feats, ents):
 
 
 @jax.jit
+def _random_scores_sparse(table, feats, ents):
+    """Wide random effect over a padded-ELL shard: per-slot gather of the
+    row's OWN entity's coefficient — x_i . w_{e_i} without densifying
+    (the d-space twin of projected-space scoring; back-projected tables
+    carry zeros outside each entity's active union, so this matches the
+    projected coordinate's training-time scores exactly)."""
+    safe_e = jnp.maximum(ents, 0)
+    idx_ok = feats.indices < feats.d
+    safe_c = jnp.where(idx_ok, feats.indices, 0)
+    coefs = table[safe_e[:, None], safe_c]  # (n, k)
+    per_row = jnp.sum(
+        jnp.where(idx_ok, feats.values * coefs, 0.0), axis=-1
+    )
+    return jnp.where(ents >= 0, per_row, 0.0)
+
+
+@jax.jit
 def _factored_scores(gamma, projection, feats, ents):
     """score = (x B) . gamma_e without materializing B gamma^T
     (``FactoredRandomEffectCoordinate`` scoring contraction)."""
@@ -73,10 +90,10 @@ def score_game_data(
             )
         feats = cast_values(raw, dtype)
         re_key = random_effects.get(name)
-        if re_key is not None and is_structured(raw):
+        if re_key is not None and is_structured(raw) and hasattr(p, "gamma"):
             raise ValueError(
-                f"coordinate {name!r}: random/factored effects need the "
-                f"dense per-row gather; shard {shard!r} is sparse"
+                f"coordinate {name!r}: factored effects need the dense "
+                f"per-row latent projection; shard {shard!r} is sparse"
             )
         if re_key is None:
             total = total + _fixed_scores(jnp.asarray(p, dtype), feats)
@@ -87,6 +104,11 @@ def score_game_data(
                 jnp.asarray(p.projection, dtype),
                 feats,
                 ents,
+            )
+        elif is_structured(raw):
+            ents = jnp.asarray(data.entity_ids[re_key])
+            total = total + _random_scores_sparse(
+                jnp.asarray(p, dtype), feats, ents
             )
         else:
             ents = jnp.asarray(data.entity_ids[re_key])

@@ -27,6 +27,7 @@ from photon_ml_tpu.parallel.mesh import (
 )
 from photon_ml_tpu.parallel.multihost import (
     initialize_multihost,
+    make_global_batch,
     process_local_paths,
     process_local_rows,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "feature_sharded_train_glm",
     "shard_map_value_and_grad",
     "initialize_multihost",
+    "make_global_batch",
     "process_local_paths",
     "process_local_rows",
 ]

@@ -133,6 +133,34 @@ def process_local_paths(paths):
     return paths[jax.process_index()::n]
 
 
+def make_global_batch(local_batch, mesh):
+    """Assemble a GLOBAL row-sharded batch from THIS process's local rows
+    (the multi-host generalization of ``mesh.shard_batch``): every leaf
+    becomes a ``jax.Array`` spanning the whole mesh via
+    ``jax.make_array_from_process_local_data``, with this process's rows
+    living on its addressable devices. All processes must hold the SAME
+    number of rows (use file- or row-splits that divide evenly; pad the
+    local batch first otherwise). Single-process: equivalent to
+    ``shard_batch`` without the padding."""
+    import jax.tree_util as jtu
+
+    from photon_ml_tpu.parallel.mesh import batch_sharding
+
+    nproc = jax.process_count()
+
+    def mk(x):
+        import numpy as np
+
+        x = np.asarray(x)
+        sharding = batch_sharding(mesh, x.ndim)
+        global_shape = (x.shape[0] * nproc,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape
+        )
+
+    return jtu.tree_map(mk, local_batch)
+
+
 def process_local_rows(total_rows: int) -> range:
     """The contiguous row range THIS process should ingest — the even
     split of a global row space over processes (the analog of the

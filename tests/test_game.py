@@ -579,6 +579,68 @@ class TestPearsonFeatureSelection:
             assert len(kept) == 2
             assert set(kept) == {0, 1}
 
+    def test_support_filter_numpy_oracle(self, rng):
+        """``filterFeaturesBySupport`` (``LocalDataSet.scala:80-109``):
+        per entity, a feature survives iff nonzero in >= min_support of
+        its active rows — checked against a direct numpy count."""
+        from photon_ml_tpu.game.data import filter_features_by_support
+
+        n_users, rows, d = 4, 30, 10
+        user = np.repeat(np.arange(n_users), rows)
+        # sparse-ish design: most entries zero, some columns rare
+        x = rng.normal(size=(n_users * rows, d)) * (
+            rng.uniform(size=(n_users * rows, d)) < 0.25
+        )
+        y = (rng.uniform(size=user.size) < 0.5).astype(float)
+        data = GameData.create(
+            features={"per_user": x}, labels=y, entity_ids={"userId": user}
+        )
+        design = build_random_effect_design(
+            data, "userId", "per_user", n_users, dtype=jnp.float64
+        )
+        min_support = 5
+        filtered = filter_features_by_support(design, min_support)
+        feats_in = np.asarray(design.features)
+        feats_out = np.asarray(filtered.features)
+        mask = np.asarray(design.mask) > 0
+        for e in range(n_users):
+            counts = ((feats_in[e] != 0) & mask[e][:, None]).sum(axis=0)
+            keep = counts >= min_support
+            np.testing.assert_array_equal(
+                feats_out[e][:, keep], feats_in[e][:, keep]
+            )
+            assert np.all(feats_out[e][:, ~keep] == 0.0)
+        # labels/weights/mask untouched; threshold 0 is the identity
+        np.testing.assert_array_equal(
+            np.asarray(filtered.mask), np.asarray(design.mask)
+        )
+        ident = filter_features_by_support(design, 0)
+        np.testing.assert_array_equal(
+            np.asarray(ident.features), feats_in
+        )
+
+    def test_support_filter_through_builder(self, rng):
+        """min_support threads through both design builders."""
+        from photon_ml_tpu.game.data import (
+            build_bucketed_random_effect_design,
+        )
+
+        user = np.asarray([0] * 20 + [1] * 20)
+        x = np.zeros((40, 4))
+        x[:, 0] = 1.0  # support 20 everywhere
+        x[::7, 1] = rng.normal(size=x[::7, 1].shape)  # rare column
+        y = (rng.uniform(size=40) < 0.5).astype(float)
+        data = GameData.create(
+            features={"per_user": x}, labels=y, entity_ids={"userId": user}
+        )
+        design = build_bucketed_random_effect_design(
+            data, "userId", "per_user", 2, num_buckets=1,
+            min_support=5, dtype=jnp.float64,
+        )
+        feats = np.asarray(design.buckets[0].features)
+        assert np.all(feats[:, :, 1] == 0.0)  # rare column dropped
+        assert np.any(feats[:, :, 0] != 0.0)  # common column kept
+
     def test_ratio_cap_scales_with_entity_rows(self, rng):
         from photon_ml_tpu.game.data import select_features_by_pearson
 
