@@ -621,9 +621,6 @@ def run_game_training(params) -> GameTrainingRun:
 
 
 def main(argv=None) -> None:
-    from photon_ml_tpu.utils import enable_compilation_cache
-
-    enable_compilation_cache()
     p = argparse.ArgumentParser(
         prog="photon_ml_tpu.cli.game_train",
         description="Train GAME (fixed + random effects) models.",
@@ -631,6 +628,11 @@ def main(argv=None) -> None:
     p.add_argument("--config", required=True, help="JSON GameDriverParams")
     p.add_argument("--overwrite", action="store_true", default=None)
     args = p.parse_args(argv)
+    # after parse_args: --help / bad flags must not initialize
+    # the accelerator backend or touch the cache directory
+    from photon_ml_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     with open(args.config) as f:
         base = json.load(f)
     if args.overwrite is not None:

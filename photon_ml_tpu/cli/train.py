@@ -500,10 +500,12 @@ def params_from_args(args, cls) -> dict:
 
 
 def main(argv=None) -> None:
+    args = build_arg_parser().parse_args(argv)
+    # after parse_args: --help / bad flags must not initialize the
+    # accelerator backend or touch the cache directory
     from photon_ml_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
-    args = build_arg_parser().parse_args(argv)
     run_glm_training(params_from_args(args, GLMDriverParams))
 
 

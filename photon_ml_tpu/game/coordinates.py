@@ -117,7 +117,11 @@ def _make_solve_cached(config: CoordinateConfig, batched: bool):
             return minimize_owlqn(vg, w0, l1, scfg)
         if use_tron:
             hvp = lambda w, v: obj.hessian_vector(w, v, batch)
-            return minimize_tron(vg, hvp, w0, scfg)
+            return minimize_tron(
+                vg, hvp, w0, scfg,
+                hvp_setup_fn=lambda w: obj.hessian_coefficients(w, batch),
+                hvp_at_fn=lambda c, v: obj.hessian_vector_at(c, v, batch),
+            )
         if use_newton:
             hess = lambda w: obj.hessian_full(w, batch)
             return minimize_newton(vg, hess, w0, scfg)
@@ -268,6 +272,7 @@ class FixedEffectCoordinate:
         if config.random_effect is not None:
             raise ValueError("config names a random effect; wrong coordinate")
         self._row_perm = None
+        self._inv_perm = None
         if hybrid_pack is not None:
             batch, self._row_perm, self._inv_perm = hybrid_pack
         elif hot_columns:
@@ -348,6 +353,22 @@ class FixedEffectCoordinate:
         """Fused-pass hook: raw tracker pytree -> history object (identity
         here; SolverResult is already what materialize() reads)."""
         return tracker
+
+    def fused_state(self):
+        """Device-resident arrays update_step reads, as an explicit
+        pytree. The fused whole-pass jit threads these as ARGUMENTS:
+        closed-over concrete arrays are not hoisted by tracing (they are
+        not tracers) and lower to HLO literals — the serialized program
+        would carry the whole dataset (observed: remote-compile requests
+        rejected with HTTP 413)."""
+        return (self.batch, self._row_perm, self._inv_perm)
+
+    def with_fused_state(self, state):
+        import copy
+
+        c = copy.copy(self)
+        c.batch, c._row_perm, c._inv_perm = state
+        return c
 
     def update_step(
         self, w: jax.Array, partial_scores: jax.Array, key=None
@@ -620,6 +641,32 @@ class RandomEffectCoordinate:
             for (reason, iters), valid in zip(trackers, self._valid_lanes)
         ]
         return RandomEffectUpdateSummary(pending=pending)
+
+    def fused_state(self):
+        """See ``FixedEffectCoordinate.fused_state``."""
+        return (
+            self.reg_weights,
+            self.full_offsets_base,
+            self._entity_indices,
+            tuple(self.design.buckets),
+            self.row_features,
+            self.row_entities,
+        )
+
+    def with_fused_state(self, state):
+        import copy
+
+        c = copy.copy(self)
+        (
+            c.reg_weights,
+            c.full_offsets_base,
+            c._entity_indices,
+            buckets,
+            c.row_features,
+            c.row_entities,
+        ) = state
+        c.design = dataclasses.replace(self.design, buckets=list(buckets))
+        return c
 
     def score(self, table: jax.Array) -> jax.Array:
         return self._score(table, self.row_features, self.row_entities)

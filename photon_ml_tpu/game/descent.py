@@ -89,12 +89,19 @@ class CoordinateDescent:
         base_offsets: jax.Array,
         weights: jax.Array,
         task: TaskType,
+        fuse_passes: bool = True,
     ):
+        """``fuse_passes``: compile each full CD pass as ONE dispatch
+        (default; see :meth:`_fused_pass_fn`). Disable when the combined
+        program is too large for the toolchain (e.g. remote-compile
+        helpers with request limits) — the unfused loop is identical
+        math at ~6 dispatches per pass."""
         self.coordinates = dict(coordinates)
         self.labels = labels
         self.base_offsets = base_offsets
         self.weights = weights
         self.task = task
+        self.fuse_passes = fuse_passes
         loss_fn = _loss_fn_for_task(task)
         names = list(self.coordinates)
 
@@ -120,30 +127,65 @@ class CoordinateDescent:
         unfused loop (2 updates + 2 objectives + score arithmetic) pays
         ~6 latencies per pass; this pays ONE. Used by run() whenever no
         validation_fn is supplied and every coordinate exposes the
-        trace-safe update_step (all in-tree coordinates do)."""
+        trace-safe update_step (all in-tree coordinates do).
+
+        The pass must NOT close over the coordinates' device-resident
+        design/batch arrays: concrete closed-over arrays are not tracers,
+        so tracing inlines them as HLO LITERALS and the serialized
+        program carries the whole dataset (observed: multi-hundred-MB
+        remote-compile requests failing with HTTP 413 / broken pipes,
+        and jax.closure_convert does NOT help — it only hoists captured
+        tracers). Instead every coordinate exposes its arrays as an
+        explicit ``fused_state()`` pytree, threaded through the jit as
+        arguments; the per-update objective is likewise computed from
+        argument-passed labels/offsets/weights."""
         if getattr(self, "_fused_pass", None) is None:
             names = list(self.coordinates)
             coords = self.coordinates
+            loss_fn = _loss_fn_for_task(self.task)
 
-            @jax.jit
-            def one_pass(params, scores, key):
+            def one_pass(states, labels, base_offsets, weights, params,
+                         scores, key):
+                live = {
+                    n: coords[n].with_fused_state(states[n]) for n in names
+                }
+
+                def reg_term(name, p):
+                    c = live[name]
+                    if hasattr(c, "reg_term"):
+                        return c.reg_term(p)
+                    return _config_reg_term(c.config, p)
+
                 objs = []
                 trackers = []
                 for name in names:
                     total = sum(scores.values())
                     partial = total - scores[name]
                     key, sub = jax.random.split(key)
-                    p, tr, s = coords[name].update_step(
+                    p, tr, s = live[name].update_step(
                         params[name], partial, sub
                     )
                     params = {**params, name: p}
                     scores = {**scores, name: s}
-                    objs.append(self._full_objective(scores, params))
+                    reg = sum(reg_term(n, params[n]) for n in names)
+                    tot = sum(scores[n] for n in names)
+                    objs.append(
+                        loss_fn(labels, base_offsets + tot, weights) + reg
+                    )
                     trackers.append(tr)
                 return params, scores, key, tuple(objs), tuple(trackers)
 
-            self._fused_pass = one_pass
-        return self._fused_pass
+            states = {n: coords[n].fused_state() for n in names}
+            self._fused_pass = (jax.jit(one_pass), states)
+        f, states = self._fused_pass
+
+        def call(p, s, k):
+            return f(
+                states, self.labels, self.base_offsets, self.weights, p, s,
+                k,
+            )
+
+        return call
 
     def _reg_term(self, name: str, params) -> jax.Array:
         """Delegates to the coordinate when it defines its own penalty
@@ -260,14 +302,25 @@ class CoordinateDescent:
                 )
             pending.clear()
 
-        use_fused = validation_fn is None and all(
-            hasattr(c, "update_step") for c in self.coordinates.values()
+        # the fused path needs the FULL trace-safe surface, not just
+        # update_step — a custom coordinate providing only update/score
+        # must keep working through the plain loop
+        _fused_surface = (
+            "update_step", "fused_state", "with_fused_state", "wrap_tracker"
+        )
+        use_fused = (
+            self.fuse_passes
+            and validation_fn is None
+            and all(
+                all(hasattr(c, m) for m in _fused_surface)
+                for c in self.coordinates.values()
+            )
         )
         for it in range(start_it, num_iterations):
             if use_fused:
                 t0 = time.perf_counter()
-                fused = self._fused_pass_fn()
                 params_in = {n: model.params[n] for n in names}
+                fused = self._fused_pass_fn()
                 params_out, scores, key, objs, trackers = fused(
                     params_in, scores, key
                 )

@@ -284,6 +284,30 @@ class FactoredRandomEffectCoordinate:
     def wrap_tracker(self, tracker):
         return tracker
 
+    def fused_state(self):
+        """See ``FixedEffectCoordinate.fused_state``. The (E,)-int
+        entity_index lists stay trace-time constants (small next to the
+        designs)."""
+        return (
+            tuple(self.design.buckets),
+            self.row_features,
+            self.row_entities,
+            self.full_offsets_base,
+        )
+
+    def with_fused_state(self, state):
+        import copy
+
+        c = copy.copy(self)
+        (
+            buckets,
+            c.row_features,
+            c.row_entities,
+            c.full_offsets_base,
+        ) = state
+        c.design = dataclasses.replace(self.design, buckets=list(buckets))
+        return c
+
     def reg_term(self, params: FactoredParams) -> jax.Array:
         """gamma is penalized under the RE config, B under the latent-factor
         config — the exact quantities the two inner solves minimize."""

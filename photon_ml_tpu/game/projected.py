@@ -326,7 +326,15 @@ class ProjectedRandomEffectCoordinate:
     def with_config(self, config: CoordinateConfig) -> "ProjectedRandomEffectCoordinate":
         """Same projected design/rows under a different optimization
         config — the grid-sweep reuse hook (designs and projections are
-        combo-invariant; only the solver knobs change per combo)."""
+        combo-invariant; only the solver knobs change per combo).
+
+        Per-entity reg weights: a UNIFORM vector (the default fill from
+        the old config) is rebuilt from the new config's reg_weight; a
+        CUSTOM per-entity vector is carried through unchanged — silently
+        replacing it with the new uniform weight would discard the
+        per-entity objectives the caller configured."""
+        old = np.asarray(self.inner.reg_weights)
+        uniform = np.allclose(old, self.inner.config.reg_weight)
         return ProjectedRandomEffectCoordinate(
             design=self.inner.design,
             row_features=self.inner.row_features,
@@ -335,6 +343,7 @@ class ProjectedRandomEffectCoordinate:
             config=config,
             projector=self.projector,
             original_dim=self.original_dim,
+            reg_weights=None if uniform else self.inner.reg_weights,
             prebuilt=(self.inner.design, self.inner.row_features),
         )
 
@@ -368,6 +377,16 @@ class ProjectedRandomEffectCoordinate:
 
     def wrap_tracker(self, trackers):
         return self.inner.wrap_tracker(trackers)
+
+    def fused_state(self):
+        return self.inner.fused_state()
+
+    def with_fused_state(self, state):
+        import copy
+
+        c = copy.copy(self)
+        c.inner = self.inner.with_fused_state(state)
+        return c
 
     def reg_term(self, table: jax.Array) -> jax.Array:
         return self.inner.reg_term(table)

@@ -34,20 +34,48 @@ def _random_scores(table, feats, ents):
     return jnp.where(ents >= 0, per_row, 0.0)
 
 
+def _compact_table(table: np.ndarray):
+    """Host-side (E, d) -> padded (E, k) (columns, values) with k = max
+    nonzeros per entity; column pad = d (sorts after every real id),
+    value pad = 0. Per-entity columns come out ASCENDING (np.nonzero row
+    order), which the searchsorted join below requires."""
+    t = np.asarray(table)
+    e, d = t.shape
+    ent, col = np.nonzero(t)
+    counts = np.bincount(ent, minlength=e)
+    k = max(int(counts.max()) if counts.size else 1, 1)
+    cols = np.full((e, k), d, np.int32)
+    vals = np.zeros((e, k), t.dtype)
+    starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    slot = np.arange(ent.size) - starts[ent]
+    cols[ent, slot] = col
+    vals[ent, slot] = t[ent, col]
+    return cols, vals
+
+
 @jax.jit
-def _random_scores_sparse(table, feats, ents):
-    """Wide random effect over a padded-ELL shard: per-slot gather of the
-    row's OWN entity's coefficient — x_i . w_{e_i} without densifying
-    (the d-space twin of projected-space scoring; back-projected tables
-    carry zeros outside each entity's active union, so this matches the
-    projected coordinate's training-time scores exactly)."""
+def _random_scores_sparse(cols_tab, vals_tab, feats, ents):
+    """Wide random effect over a padded-ELL shard: x_i . w_{e_i} through
+    the COMPACT per-entity coefficient tables ((E, k) columns + values —
+    back-projected tables are zero outside each entity's active union,
+    so k is small even when d is huge). A dense (E, d) device table at
+    this regime (e.g. 30k x 60k f32 = 7.2 GB) would defeat the very
+    memory ceiling the sparse path exists for; this gathers O(n * k)
+    and joins by per-row searchsorted against the entity's sorted
+    columns."""
     safe_e = jnp.maximum(ents, 0)
-    idx_ok = feats.indices < feats.d
-    safe_c = jnp.where(idx_ok, feats.indices, 0)
-    coefs = table[safe_e[:, None], safe_c]  # (n, k)
-    per_row = jnp.sum(
-        jnp.where(idx_ok, feats.values * coefs, 0.0), axis=-1
+    ec = cols_tab[safe_e]  # (n, kt) the row's entity's active columns
+    ev = vals_tab[safe_e]
+    idx = feats.indices  # (n, ke); padding slots hold d
+    loc = jax.vmap(jnp.searchsorted)(ec, idx)
+    loc = jnp.clip(loc, 0, ec.shape[1] - 1)
+    hit = jnp.take_along_axis(ec, loc, axis=1) == idx
+    # entry padding (idx == d) can only hit a column pad (value 0) — 0
+    # contribution either way
+    coef = jnp.where(
+        hit, jnp.take_along_axis(ev, loc, axis=1), 0.0
     )
+    per_row = jnp.sum(feats.values * coef, axis=-1)
     return jnp.where(ents >= 0, per_row, 0.0)
 
 
@@ -107,8 +135,12 @@ def score_game_data(
             )
         elif is_structured(raw):
             ents = jnp.asarray(data.entity_ids[re_key])
+            cols_tab, vals_tab = _compact_table(np.asarray(p))
             total = total + _random_scores_sparse(
-                jnp.asarray(p, dtype), feats, ents
+                jnp.asarray(cols_tab),
+                jnp.asarray(vals_tab, dtype),
+                feats,
+                ents,
             )
         else:
             ents = jnp.asarray(data.entity_ids[re_key])

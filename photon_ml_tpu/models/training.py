@@ -205,7 +205,11 @@ def _build_solver_cached(config: GLMTrainingConfig):
             return minimize_owlqn(vg, w0, l1, scfg)
         if use_tron:
             hvp = lambda w, v: obj.hessian_vector(w, v, batch)
-            return minimize_tron(vg, hvp, w0, scfg)
+            return minimize_tron(
+                vg, hvp, w0, scfg,
+                hvp_setup_fn=lambda w: obj.hessian_coefficients(w, batch),
+                hvp_at_fn=lambda c, v: obj.hessian_vector_at(c, v, batch),
+            )
         if use_newton:
             hess = lambda w: obj.hessian_full(w, batch)
             return minimize_newton(vg, hess, w0, scfg)

@@ -152,8 +152,18 @@ def minimize_tron(
     hvp_fn: Hvp,
     w0: jax.Array,
     config: SolverConfig = TRON_DEFAULT_CONFIG,
+    hvp_setup_fn=None,
+    hvp_at_fn=None,
 ) -> SolverResult:
-    """Minimize a twice-differentiable objective via trust-region Newton-CG."""
+    """Minimize a twice-differentiable objective via trust-region Newton-CG.
+
+    ``hvp_setup_fn(w) -> carry`` / ``hvp_at_fn(carry, v) -> Hv`` split the
+    Hessian-vector product into its w-only part (computed ONCE per outer
+    iteration — for GLMs the (n,) curvature weights, one design pass) and
+    the per-CG-step part (two design passes). Without them every CG step
+    recomputes the w-only part through ``hvp_fn`` (three passes) — the
+    reference pays the same structure per CG step as a broadcast +
+    treeAggregate (``TRON.scala:272-285``)."""
     dtype = w0.dtype
     v0, g0 = value_and_grad_fn(w0)
     gnorm0 = jnp.linalg.norm(g0)
@@ -182,8 +192,13 @@ def minimize_tron(
     )
 
     def body(s: _TronState) -> _TronState:
+        if hvp_setup_fn is not None and hvp_at_fn is not None:
+            carry = hvp_setup_fn(s.w)  # loop-invariant across the CG
+            hvp_local = lambda v: hvp_at_fn(carry, v)
+        else:
+            hvp_local = lambda v: hvp_fn(s.w, v)
         step, r, cg_iters = _truncated_cg(
-            lambda v: hvp_fn(s.w, v),
+            hvp_local,
             s.grad,
             s.delta,
             config.tron_max_cg,

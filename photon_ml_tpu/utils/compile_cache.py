@@ -38,6 +38,17 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
         return path
     import jax
 
+    if cache_dir is None:
+        # namespace by backend + host: entries are keyed by backend but
+        # NOT by the compiling machine's CPU features, and this stack can
+        # compile CPU programs on a remote helper — a shared dir then
+        # serves AOT results with unsupported ISA features ("could lead
+        # to SIGILL" warnings, observed with +prefer-no-gather entries)
+        import platform
+
+        path = os.path.join(
+            path, f"{jax.default_backend()}-{platform.node()}"
+        )
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache everything: the default min-compile-time threshold skips the
