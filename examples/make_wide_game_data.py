@@ -1,0 +1,58 @@
+"""Synthesize a GAME dataset with a WIDE sparse per-user shard for the
+run_wide_game.sh example: a small global shard plus a 20k-column user
+shard where each user only ever touches a private pool of ~25 columns —
+the regime the reference serves with per-entity INDEX_MAP projection
+(``projector/IndexMapProjectorRDD.scala``)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.ingest import make_training_example
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+N, D_WIDE, N_USERS, POOL, PER_ROW = 3000, 20_000, 40, 25, 5
+
+
+def main():
+    rng = np.random.default_rng(0)
+    pools = rng.choice(D_WIDE, size=(N_USERS, POOL))
+    w_wide = rng.normal(size=D_WIDE) * 0.8
+    w_g = np.asarray([1.5, -1.0])
+    records = []
+    for i in range(N):
+        u = int(rng.integers(0, N_USERS))
+        cols = np.unique(pools[u][rng.integers(0, POOL, PER_ROW)])
+        vals = rng.normal(size=cols.size)
+        xg = rng.normal(size=2)
+        margin = float(vals @ w_wide[cols] + xg @ w_g)
+        y = float(rng.uniform() < 1.0 / (1.0 + np.exp(-margin)))
+        feats = {(f"g{j}", ""): float(xg[j]) for j in range(2)}
+        feats.update({(f"w{c}", ""): float(v) for c, v in zip(cols, vals)})
+        rec = make_training_example(label=y, features=feats, uid=f"r{i}")
+        rec["metadataMap"] = {"userId": f"user{u}"}
+        records.append(rec)
+    out = os.path.join(HERE, "data", "wide_game")
+    os.makedirs(out, exist_ok=True)
+    write_avro_file(
+        os.path.join(out, "part-0.avro"), TRAINING_EXAMPLE_SCHEMA, records
+    )
+    vocab_dir = os.path.join(HERE, "data", "wide_game_vocab")
+    os.makedirs(vocab_dir, exist_ok=True)
+    with open(os.path.join(vocab_dir, "global.txt"), "w") as f:
+        f.write("".join(f"g{j}\x01\n" for j in range(2)))
+        f.write("(INTERCEPT)\x01\n")
+    with open(os.path.join(vocab_dir, "user.txt"), "w") as f:
+        f.write("".join(f"w{c}\x01\n" for c in range(D_WIDE)))
+    print(f"wrote {len(records)} records to {out}")
+
+
+if __name__ == "__main__":
+    main()

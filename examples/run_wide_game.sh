@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# GAME with a WIDE SPARSE random effect: the 20k-column per-user shard
+# ingests as padded-ELL (sparse_shards) and trains through per-entity
+# INDEX_MAP projection — each user solves in its own active-column space;
+# the (users, rows, 20k) dense design is never materialized
+# (RandomEffectCoordinateInProjectedSpace.scala's regime).
+set -euo pipefail
+cd "$(dirname "$0")"
+export PYTHONPATH="..${PYTHONPATH:+:$PYTHONPATH}"
+
+python make_wide_game_data.py
+
+mkdir -p output
+cat > output/wide_game_params.json <<'JSON'
+{
+  "train_input": ["data/wide_game"],
+  "validate_input": ["data/wide_game"],
+  "output_dir": "output/wide_game",
+  "task": "LOGISTIC_REGRESSION",
+  "num_iterations": 2,
+  "updating_sequence": ["global", "per-user"],
+  "feature_shards": {
+    "globalShard": "data/wide_game_vocab/global.txt",
+    "wideShard": "data/wide_game_vocab/user.txt"
+  },
+  "sparse_shards": ["wideShard"],
+  "coordinates": {
+    "global": {
+      "shard": "globalShard",
+      "optimizer": "TRON",
+      "reg_weights": [1.0],
+      "max_iters": 30,
+      "tolerance": 1e-8
+    },
+    "per-user": {
+      "shard": "wideShard",
+      "optimizer": "TRON",
+      "reg_weights": [1.0],
+      "random_effect": "userId",
+      "projector": "INDEX_MAP",
+      "min_support": 1,
+      "max_iters": 30,
+      "tolerance": 1e-8
+    }
+  },
+  "overwrite": true
+}
+JSON
+
+python -m photon_ml_tpu.cli.game_train --config output/wide_game_params.json
+
+echo "wide-GAME outputs:" && find output/wide_game -name '*.avro' | head

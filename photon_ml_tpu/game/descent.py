@@ -53,6 +53,16 @@ class CoordinateUpdateRecord:
     validation_metric: Optional[float] = None
 
 
+def _coordinate_reg_term(coord, params) -> jax.Array:
+    """Penalty dispatch shared by the fused and unfused paths: the
+    coordinate's own reg_term when it defines one (factored coordinates
+    penalize gamma and B under different configs), else the config
+    applied to the params."""
+    if hasattr(coord, "reg_term"):
+        return coord.reg_term(params)
+    return _config_reg_term(coord.config, params)
+
+
 def _config_reg_term(cfg, params) -> jax.Array:
     """loss-side penalty of one coordinate's params under its config —
     matches exactly what the coordinate's solver minimizes."""
@@ -108,16 +118,27 @@ class CoordinateDescent:
         # training objective from per-coordinate scores + params in ONE
         # dispatch: the reg-term composition would otherwise issue several
         # eager ops per coordinate per update — pure latency on a
-        # remote/tunneled device
+        # remote/tunneled device. labels/offsets/weights ride as jit
+        # ARGUMENTS: closed-over concrete arrays lower to HLO literals
+        # and bloat remote-compile requests (see _fused_pass_fn)
+        coords_ref = self.coordinates
+
         @jax.jit
-        def full_objective(scores_dict, params_dict):
+        def full_objective(labels_, base_offsets_, weights_, scores_dict,
+                           params_dict):
             reg = sum(
-                self._reg_term(n, params_dict[n]) for n in names
+                _coordinate_reg_term(coords_ref[n], params_dict[n])
+                for n in names
             )
             total = sum(scores_dict[n] for n in names)
-            return loss_fn(labels, base_offsets + total, weights) + reg
+            return loss_fn(labels_, base_offsets_ + total, weights_) + reg
 
-        self._full_objective = full_objective
+        self._full_objective = lambda scores_dict, params_dict: (
+            full_objective(
+                self.labels, self.base_offsets, self.weights,
+                scores_dict, params_dict,
+            )
+        )
 
     def _fused_pass_fn(self):
         """ONE jitted dispatch for a FULL coordinate-descent pass: every
@@ -151,10 +172,7 @@ class CoordinateDescent:
                 }
 
                 def reg_term(name, p):
-                    c = live[name]
-                    if hasattr(c, "reg_term"):
-                        return c.reg_term(p)
-                    return _config_reg_term(c.config, p)
+                    return _coordinate_reg_term(live[name], p)
 
                 objs = []
                 trackers = []
@@ -188,13 +206,7 @@ class CoordinateDescent:
         return call
 
     def _reg_term(self, name: str, params) -> jax.Array:
-        """Delegates to the coordinate when it defines its own penalty
-        (factored coordinates penalize gamma and B under different
-        configs); otherwise applies the coordinate config to the params."""
-        coord = self.coordinates[name]
-        if hasattr(coord, "reg_term"):
-            return coord.reg_term(params)
-        return _config_reg_term(coord.config, params)
+        return _coordinate_reg_term(self.coordinates[name], params)
 
     def run(
         self,
