@@ -87,29 +87,32 @@ def _dense_click_data(n, n_test, d, seed=42):
 
 
 def measure_tunnel_rtt(samples: int = 12):
-    """Round-trip latency of a tiny chained dispatch (VERDICT r3 #10):
-    the comparability pin for cross-round wall-clocks — the same compiled
-    program swings 2-10x with tunnel load, so every BENCH records the
-    link it ran over. Chained (each input depends on the previous
-    output) so the runtime's identical-dispatch cache cannot serve it."""
+    """Device->host VALUE-FETCH latency of a tiny chained computation
+    (VERDICT r3 #10): the comparability pin for cross-round wall-clocks.
+    Measured carefully on this runtime: enqueue and even
+    ``block_until_ready`` complete in ~0.05 ms (completion is tracked
+    without a synchronous round trip), but materializing a VALUE on the
+    host — what every solve wall-clock in this file ends with — costs a
+    full tunnel round trip (~100-150 ms, load-dependent). The chain
+    (each input depends on the previous output, with a drift that
+    survives f32 rounding and has no fixed point) defeats the runtime's
+    identical-dispatch cache."""
     import jax
     import jax.numpy as jnp
 
-    x = jnp.ones((8,))
+    x = jnp.full((8,), 0.5)
 
     @jax.jit
     def step(v):
-        # the relative change must SURVIVE f32 rounding or the runtime's
-        # identical-dispatch cache serves the call (docs/PERF.md): 1e-7
-        # underflows, 1e-3 does not; the subtraction keeps values bounded
-        return v * 1.001 - 0.001
+        return v * 1.001 + 0.0005
 
-    x = step(x).block_until_ready()  # compile
+    x = step(x)
+    float(x[0])  # compile + first fetch
     times = []
     for _ in range(samples):
         t0 = time.perf_counter()
         x = step(x)
-        x.block_until_ready()
+        float(x[0])  # host materialization = the round trip
         times.append(time.perf_counter() - t0)
     times.sort()
     med = times[len(times) // 2]
@@ -179,7 +182,7 @@ def bench_glm_dense():
     np.asarray(warm.result.w)
     log(f"first solve (compile+run): {time.perf_counter() - t0:.2f}s")
 
-    times, aucs, flops = [], [], []
+    times, aucs = [], []
     for rep in range(3):
         t0 = time.perf_counter()
         (tm,) = train_glm(batch, config(lam + 0.01 * rep))
@@ -206,7 +209,6 @@ def bench_glm_dense():
         )
         times.append(dt)
         aucs.append(auc)
-        flops.append(fl)
     tpu_wall_s = float(np.median(times))
     med = times.index(sorted(times)[1])
     auc_dev = aucs[med]
@@ -227,6 +229,10 @@ def bench_glm_dense():
     ]
     for tm_ in pipe:
         _jax.block_until_ready(tm_.model.coefficients.means)
+    # end with a VALUE materialization: that is the round trip the probe
+    # measures (block_until_ready alone completes without one here), so
+    # the subtraction below removes exactly what this wall paid once
+    np.asarray(pipe[-1].model.coefficients.means)
     pipe_total = time.perf_counter() - t0
     tpu_s = max(pipe_total - rtt_probe["rtt_ms"] / 1e3, 1e-9) / k_pipe
     # FLOP numerator from the SAME solves the time denominator measures

@@ -44,13 +44,16 @@ def main():
     write_avro_file(
         os.path.join(out, "part-0.avro"), TRAINING_EXAMPLE_SCHEMA, records
     )
+    from photon_ml_tpu.io.vocab import FeatureVocabulary
+
     vocab_dir = os.path.join(HERE, "data", "wide_game_vocab")
     os.makedirs(vocab_dir, exist_ok=True)
-    with open(os.path.join(vocab_dir, "global.txt"), "w") as f:
-        f.write("".join(f"g{j}\x01\n" for j in range(2)))
-        f.write("(INTERCEPT)\x01\n")
-    with open(os.path.join(vocab_dir, "user.txt"), "w") as f:
-        f.write("".join(f"w{c}\x01\n" for c in range(D_WIDE)))
+    FeatureVocabulary(
+        [f"g{j}\x01" for j in range(2)], add_intercept=True
+    ).save(os.path.join(vocab_dir, "global.txt"))
+    FeatureVocabulary([f"w{c}\x01" for c in range(D_WIDE)]).save(
+        os.path.join(vocab_dir, "user.txt")
+    )
     print(f"wrote {len(records)} records to {out}")
 
 

@@ -205,8 +205,6 @@ class CoordinateDescent:
 
         return call
 
-    def _reg_term(self, name: str, params) -> jax.Array:
-        return _coordinate_reg_term(self.coordinates[name], params)
 
     def run(
         self,
