@@ -191,10 +191,10 @@ def bench_glm_dense():
         iters = int(tm.result.iterations)
         cg = int(tm.result.cg_iterations)
         # fused value/grad = 2 matmuls (margins + backproject) = 4nd
-        # FLOPs; each CG Hessian-vector product is 2 matmuls (the margins
-        # pass is hoisted ONCE per outer iteration as the curvature-weight
-        # setup: +1 design read each). +1 initial value/grad.
-        passes = iters + 1 + cg + 0.5 * iters  # in 2-matmul units
+        # FLOPs; each CG Hessian-vector product is 2 matmuls (the CG's
+        # curvature weights ride the acceptance evaluation — the vgc path
+        # in solvers/tron.py — so no extra setup pass). +1 initial vgc.
+        passes = iters + 1 + cg  # in 2-matmul (one-design-pass) units
         fl = passes * 4.0 * n * d
         auc = float(
             area_under_roc_curve(
@@ -238,10 +238,7 @@ def bench_glm_dense():
     # FLOP numerator from the SAME solves the time denominator measures
     # (different lambdas can take different iteration/CG counts)
     pipe_passes = [
-        int(tm_.result.iterations)
-        + 1
-        + int(tm_.result.cg_iterations)
-        + 0.5 * int(tm_.result.iterations)
+        int(tm_.result.iterations) + 1 + int(tm_.result.cg_iterations)
         for tm_ in pipe
     ]
     pipe_fl = float(np.mean(pipe_passes)) * 4.0 * n * d

@@ -119,8 +119,8 @@ def _make_solve_cached(config: CoordinateConfig, batched: bool):
             hvp = lambda w, v: obj.hessian_vector(w, v, batch)
             return minimize_tron(
                 vg, hvp, w0, scfg,
-                hvp_setup_fn=lambda w: obj.hessian_coefficients(w, batch),
                 hvp_at_fn=lambda c, v: obj.hessian_vector_at(c, v, batch),
+                vgc_fn=lambda w: obj.value_grad_curvature(w, batch),
             )
         if use_newton:
             hess = lambda w: obj.hessian_full(w, batch)

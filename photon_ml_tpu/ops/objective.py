@@ -145,21 +145,33 @@ class GLMObjective:
 
     def value_and_grad(self, w: jax.Array, batch: LabeledBatch):
         """Fused loss+gradient — the reference's hot aggregator
-        (``ValueAndGradientAggregator.scala:204-235``) as two matmuls."""
+        (``ValueAndGradientAggregator.scala:204-235``) as two matmuls.
+        (The unused curvature output is dead-code-eliminated under jit.)"""
+        val, grad, _ = self.value_grad_curvature(w, batch)
+        return val, grad
+
+    def grad(self, w: jax.Array, batch: LabeledBatch) -> jax.Array:
+        return self.value_and_grad(w, batch)[1]
+
+    def value_grad_curvature(self, w: jax.Array, batch: LabeledBatch):
+        """(value, gradient, curvature weights) from ONE margins pass.
+        The curvature weights c = w_i * l''(z_i) are what
+        :meth:`hessian_vector_at` needs — TRON's acceptance evaluation
+        already computes z at the trial point, so on acceptance the next
+        iteration's CG starts with c for free (no separate
+        :meth:`hessian_coefficients` pass)."""
         z = self.margins(w, batch)
         ew = batch.effective_weights()
         val = jnp.sum(ew * self.loss.value(z, batch.labels))
         a = ew * self.loss.d1(z, batch.labels)
         grad = self._backproject(a, batch)
+        c = ew * self.loss.d2(z, batch.labels)
         val = _maybe_psum(val, self.axis_name)
         grad = _maybe_psum(grad, self.axis_name)
         if self._has_l2:
             val = val + 0.5 * self.l2_weight * jnp.vdot(w, w)
             grad = grad + self.l2_weight * w
-        return val, grad
-
-    def grad(self, w: jax.Array, batch: LabeledBatch) -> jax.Array:
-        return self.value_and_grad(w, batch)[1]
+        return val, grad, c
 
     # -- second-order ----------------------------------------------------
 

@@ -135,6 +135,7 @@ class _TronState(NamedTuple):
     w: jax.Array
     value: jax.Array
     grad: jax.Array
+    curv: jax.Array  # curvature carry for the CG (vgc mode; scalar 0 else)
     delta: jax.Array  # trust-region radius
     failures: jax.Array
     iteration: jax.Array
@@ -154,6 +155,7 @@ def minimize_tron(
     config: SolverConfig = TRON_DEFAULT_CONFIG,
     hvp_setup_fn=None,
     hvp_at_fn=None,
+    vgc_fn=None,
 ) -> SolverResult:
     """Minimize a twice-differentiable objective via trust-region Newton-CG.
 
@@ -163,9 +165,19 @@ def minimize_tron(
     the per-CG-step part (two design passes). Without them every CG step
     recomputes the w-only part through ``hvp_fn`` (three passes) — the
     reference pays the same structure per CG step as a broadcast +
-    treeAggregate (``TRON.scala:272-285``)."""
+    treeAggregate (``TRON.scala:272-285``).
+
+    ``vgc_fn(w) -> (value, grad, carry)`` goes further: the acceptance
+    evaluation at the trial point already computes the margins, so on
+    acceptance the NEXT iteration's CG carry is free — no setup pass at
+    all. Requires ``hvp_at_fn``; takes precedence over ``hvp_setup_fn``."""
     dtype = w0.dtype
-    v0, g0 = value_and_grad_fn(w0)
+    use_vgc = vgc_fn is not None and hvp_at_fn is not None
+    if use_vgc:
+        v0, g0, c0 = vgc_fn(w0)
+    else:
+        v0, g0 = value_and_grad_fn(w0)
+        c0 = jnp.zeros((), dtype)
     gnorm0 = jnp.linalg.norm(g0)
     values, grad_norms = tracker_buffers(config.max_iters, dtype, config.track_states)
     values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
@@ -175,6 +187,7 @@ def minimize_tron(
         w=w0,
         value=v0,
         grad=g0,
+        curv=c0,
         delta=gnorm0,  # initial radius = ||g0|| per LIBLINEAR/TRON.scala:117
         failures=jnp.int32(0),
         iteration=jnp.int32(0),
@@ -192,7 +205,9 @@ def minimize_tron(
     )
 
     def body(s: _TronState) -> _TronState:
-        if hvp_setup_fn is not None and hvp_at_fn is not None:
+        if use_vgc:
+            hvp_local = lambda v: hvp_at_fn(s.curv, v)
+        elif hvp_setup_fn is not None and hvp_at_fn is not None:
             carry = hvp_setup_fn(s.w)  # loop-invariant across the CG
             hvp_local = lambda v: hvp_at_fn(carry, v)
         else:
@@ -209,7 +224,11 @@ def minimize_tron(
         prered = -0.5 * (gs - jnp.vdot(step, r))
 
         w_try = s.w + step
-        v_try, g_try = value_and_grad_fn(w_try)
+        if use_vgc:
+            v_try, g_try, c_try = vgc_fn(w_try)
+        else:
+            v_try, g_try = value_and_grad_fn(w_try)
+            c_try = s.curv
         actred = s.value - v_try
 
         # Radius update (``TRON.scala:136-224``, LIBLINEAR's alpha logic).
@@ -240,6 +259,7 @@ def minimize_tron(
         w_new = jnp.where(accept, w_try, s.w)
         v_new = jnp.where(accept, v_try, s.value)
         g_new = jnp.where(accept, g_try, s.grad)
+        c_new = jnp.where(accept, c_try, s.curv) if use_vgc else s.curv
         failures = jnp.where(accept, 0, s.failures + 1)
 
         it = s.iteration + 1
@@ -275,6 +295,7 @@ def minimize_tron(
             w=w_new,
             value=v_new,
             grad=g_new,
+            curv=c_new,
             delta=delta,
             failures=failures,
             iteration=it,
