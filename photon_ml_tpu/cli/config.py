@@ -131,20 +131,11 @@ class GLMDriverParams:
                     f"mesh_shape sizes must be integers >= 1: "
                     f"{self.mesh_shape}"
                 )
-            # fail feature-sharding incompatibilities BEFORE data ingest
-            if self.mesh_shape.get("feature", 1) > 1:
-                if self.sparse:
-                    raise ValueError(
-                        "feature sharding currently requires dense features"
-                    )
-                if self.normalization != "NONE":
-                    raise ValueError(
-                        "feature sharding requires NONE normalization"
-                    )
-                if self.constraint_file:
-                    raise ValueError(
-                        "feature sharding does not support box constraints"
-                    )
+            # feature sharding composes with sparse (column-blocked ELL),
+            # normalization, and box constraints since r4 — the blocked
+            # layout re-threads their (d,)-vectors
+            # (parallel/distributed.feature_sharded_train_glm); only the
+            # hybrid container stays single-device (checked above)
         if self.diagnostics and not self.validate_input:
             raise ValueError(
                 "diagnostics requires validate_input (the model diagnostics "

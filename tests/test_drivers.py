@@ -1145,6 +1145,36 @@ class TestMeshShardedDriver:
             atol=1e-8,
         )
 
+    def test_sparse_feature_mesh_with_normalization(self, rng, glm_fixture):
+        """Driver-reachable r4 composition: SPARSE ingest + ('data',
+        'feature') mesh + scale normalization reproduces the local dense
+        run (the huge-d Criteo-regime configuration end to end)."""
+        train, valid, tmp = glm_fixture
+        common = {
+            "train_input": [train],
+            "optimizer": "TRON",
+            "reg_weights": [1.0],
+            "max_iters": 60,
+            "tolerance": 1e-12,
+            "normalization": "SCALE_WITH_STANDARD_DEVIATION",
+        }
+        local = run_glm_training(
+            {**common, "output_dir": str(tmp / "nlocal")}
+        )
+        sparse_feat = run_glm_training(
+            {
+                **common,
+                "output_dir": str(tmp / "nsparsefeat"),
+                "sparse": True,
+                "mesh_shape": {"data": 2, "feature": 4},
+            }
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse_feat.models[0].model.coefficients.means),
+            np.asarray(local.models[0].model.coefficients.means),
+            atol=1e-8,
+        )
+
     def test_mesh_shape_validation(self, rng, glm_fixture):
         train, _, tmp = glm_fixture
         with pytest.raises(ValueError, match="axes must be"):
