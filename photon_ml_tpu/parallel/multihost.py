@@ -140,7 +140,10 @@ def make_global_batch(local_batch, mesh):
     ``jax.make_array_from_process_local_data``, with this process's rows
     living on its addressable devices. All processes must hold the SAME
     number of rows (use file- or row-splits that divide evenly; pad the
-    local batch first otherwise). Single-process: equivalent to
+    local batch first otherwise) and, for structured features, the same
+    static widths — pin the padded-ELL width with
+    ``labeled_batch(..., nnz_per_row=...)`` so every host's local decode
+    produces identical shapes. Single-process: equivalent to
     ``shard_batch`` without the padding."""
     import jax.tree_util as jtu
 

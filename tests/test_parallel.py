@@ -649,6 +649,19 @@ with jax.set_mesh(mesh):
     (tm,) = train_glm(global_batch, cfg)
 w = np.asarray(tm.model.coefficients.means)
 np.save(out_path, w)
+
+# SPARSE leg: the same split ingested as padded-ELL; make_global_batch
+# maps over pytree leaves, so the (n, k) indices/values row-shard the
+# same way the dense design did. nnz_per_row PINS the ELL width: each
+# process's local decode must produce the same static shapes.
+local_sp, _, _ = IngestSource(mine).labeled_batch(
+    vocab, dtype="float64", sparse=True, nnz_per_row=12
+)
+global_sp = make_global_batch(local_sp, mesh)
+with jax.set_mesh(mesh):
+    (tm_sp,) = train_glm(global_sp, cfg)
+np.save(out_path.replace(".npy", "_sparse.npy"),
+        np.asarray(tm_sp.model.coefficients.means))
 print("child", proc_id, "ok", w.shape)
 '''
 
@@ -758,6 +771,13 @@ class TestTwoProcessDistributed:
         np.testing.assert_allclose(
             w0, np.asarray(local.model.coefficients.means), atol=1e-8
         )
+
+        # sparse leg: both children solved the padded-ELL ingest of the
+        # same split; must agree with each other and the dense solution
+        w0_sp = np.load(tmp_path / "w0_sparse.npy")
+        w1_sp = np.load(tmp_path / "w1_sparse.npy")
+        np.testing.assert_allclose(w0_sp, w1_sp, atol=1e-12)
+        np.testing.assert_allclose(w0_sp, w0, atol=1e-8)
 
 
 class TestMultihost:
