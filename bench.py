@@ -242,15 +242,16 @@ def bench_glm_dense():
         int(tm_.result.iterations) + 1 + int(tm_.result.cg_iterations)
         for tm_ in pipe
     ]
-    pipe_fl = float(np.mean(pipe_passes)) * 4.0 * n * d
+    passes_per_solve = float(np.mean(pipe_passes))
+    pipe_fl = passes_per_solve * 4.0 * n * d
     log(
         f"pipelined {k_pipe} solves: {pipe_total:.3f}s total "
         f"(rtt {rtt_probe['rtt_ms']:.0f} ms) -> {tpu_s:.4f}s/solve device "
-        f"({float(np.mean(pipe_passes)):.1f} passes/solve)"
+        f"({passes_per_solve:.1f} passes/solve)"
     )
     mfu = pipe_fl / tpu_s / PEAK_FLOPS
     # each pass reads the bf16 design twice (margins + backprojection)
-    hbm_bytes = (pipe_fl / (4.0 * n * d)) * 2.0 * x_bf16.nbytes
+    hbm_bytes = passes_per_solve * 2.0 * x_bf16.nbytes
     hbm_util = hbm_bytes / tpu_s / PEAK_HBM_BPS
 
     from sklearn.linear_model import LogisticRegression
@@ -274,6 +275,7 @@ def bench_glm_dense():
     return {
         "tpu_s": tpu_s,
         "tpu_wall_incl_rtt_s": tpu_wall_s,
+        "passes_per_solve": passes_per_solve,
         "cpu_s": cpu_s,
         "transfer_s": transfer_s,
         "transfer_gb": gb,
@@ -1162,6 +1164,9 @@ def main():
         **rtt,
         "transfer_s": round(glm["transfer_s"], 2),
         "dense_wall_incl_rtt_s": round(glm["tpu_wall_incl_rtt_s"], 4),
+        # counted work: design passes per dense solve (each = 2 design
+        # reads) — the tunnel-invariant comparator across rounds
+        "dense_passes_per_solve": round(glm["passes_per_solve"], 1),
         "transfer_gb": round(glm["transfer_gb"], 3),
         "mfu": round(glm["mfu"], 5),
         "hbm_util": round(glm["hbm_util"], 4),
