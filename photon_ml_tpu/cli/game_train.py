@@ -511,7 +511,11 @@ def run_game_training(params) -> GameTrainingRun:
                         if h.validation_metric is not None
                         else ""
                     )
-                    + f" ({h.seconds:.2f}s)"
+                    + (
+                        f" ({h.seconds:.2f}s/pass)"
+                        if h.seconds is not None
+                        else ""
+                    )
                 )
             model = materialize_original_space(model, coords)
             if vfn is not None:

@@ -248,7 +248,14 @@ def filter_features_by_support(
     it is STORED (nonzero here — the dense analog of activeKeysIterator)
     in at least ``min_support`` of that entity's active rows. Dropped
     columns are zeroed so their coefficients solve to exactly 0. The
-    cheap pre-filter the reference offers ahead of the Pearson ranking."""
+    cheap pre-filter the reference offers ahead of the Pearson ranking.
+
+    Known divergence, by design: the reference counts EXPLICITLY-STORED
+    entries (a stored 0.0 adds support there); the dense projected design
+    cannot distinguish a stored zero from an absent one, so value != 0 is
+    the storedness proxy. Entities whose features carry explicit zeros in
+    the source Avro may keep fewer columns here. Exact parity would
+    require threading the ELL padding mask through the projection."""
     if min_support <= 0:
         return design
     feats = np.asarray(design.features)

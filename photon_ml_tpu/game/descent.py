@@ -45,7 +45,12 @@ class CoordinateUpdateRecord:
     iteration: int
     coordinate: str
     objective: float
-    seconds: float
+    # host wall time for THIS coordinate's update. In fused mode the whole
+    # pass is one dispatch, so per-coordinate splits are unknowable: the
+    # pass wall time is recorded on the FIRST coordinate's record and the
+    # rest carry None (an even split would mislead anyone comparing
+    # coordinate costs across fused/unfused runs).
+    seconds: Optional[float]
     solver_iterations: float  # mean over entities for random effects
     convergence_histogram: Dict[str, int]
     # validation metric after this update, when a validation_fn is supplied
@@ -160,8 +165,8 @@ class CoordinateDescent:
         explicit ``fused_state()`` pytree, threaded through the jit as
         arguments; the per-update objective is likewise computed from
         argument-passed labels/offsets/weights."""
+        names = list(self.coordinates)
         if getattr(self, "_fused_pass", None) is None:
-            names = list(self.coordinates)
             coords = self.coordinates
             loss_fn = _loss_fn_for_task(self.task)
 
@@ -193,9 +198,16 @@ class CoordinateDescent:
                     trackers.append(tr)
                 return params, scores, key, tuple(objs), tuple(trackers)
 
-            states = {n: coords[n].fused_state() for n in names}
-            self._fused_pass = (jax.jit(one_pass), states)
-        f, states = self._fused_pass
+            self._fused_pass = jax.jit(one_pass)
+        f = self._fused_pass
+        # states are re-snapshotted on EVERY call: a caller that mutates a
+        # coordinate between run() calls (reg_weights, design) must train
+        # on the fresh state, same as the unfused loop would. fused_state()
+        # returns already-resident device arrays, so the rebuild is a dict
+        # construction; the jit cache still hits on identical shapes.
+        states = {
+            n: self.coordinates[n].fused_state() for n in names
+        }
 
         def call(p, s, k):
             return f(
@@ -336,13 +348,17 @@ class CoordinateDescent:
                 )
                 model.params.update(params_out)
                 seconds = time.perf_counter() - t0
-                for name, obj, tr in zip(names, objs, trackers):
+                for i, (name, obj, tr) in enumerate(
+                    zip(names, objs, trackers)
+                ):
                     pending.append(
                         {
                             "iteration": it,
                             "coordinate": name,
                             "objective": obj,
-                            "seconds": seconds / len(names),
+                            # full fused-pass wall time on the first record
+                            # only; the dispatch is indivisible
+                            "seconds": seconds if i == 0 else None,
                             "validation_metric": None,
                             "result": self.coordinates[name].wrap_tracker(
                                 tr

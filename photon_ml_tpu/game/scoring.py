@@ -11,13 +11,26 @@ own device-resident designs; this path works from a plain parameter dict.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from collections import OrderedDict
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.game.data import GameData
+
+
+class CompactReTable(NamedTuple):
+    """Pre-compacted wide random-effect coefficient table: per-entity
+    ASCENDING column ids padded with d, matching values padded with 0 —
+    exactly what ``_compact_table`` produces. Pass one of these as a
+    coordinate's params to skip the host-side (E, d) densify+nonzero
+    entirely (the back-projected tables GAME training emits can be
+    compacted once and scored many times)."""
+
+    columns: np.ndarray  # (E, k) int32
+    values: np.ndarray  # (E, k)
 
 
 @jax.jit
@@ -51,6 +64,32 @@ def _compact_table(table: np.ndarray):
     cols[ent, slot] = col
     vals[ent, slot] = t[ent, col]
     return cols, vals
+
+
+# compaction results keyed by id(table), holding a STRONG reference to the
+# table so the id cannot be recycled while the entry lives. Bounded: a
+# scoring loop reuses the same few coordinate tables per call, and the
+# compacted (E, k) arrays are small next to the (E, d) originals.
+_COMPACT_CACHE: "OrderedDict[int, tuple]" = OrderedDict()
+_COMPACT_CACHE_SIZE = 8
+
+
+def _compact_table_cached(p) -> CompactReTable:
+    """Per-coordinate cache around ``_compact_table``: without it every
+    ``score_game_data`` call re-densifies the full (E, d) table on host
+    and re-runs np.nonzero — at the wide regime this path exists for
+    (e.g. 30k x 60k) that is a multi-GB host pass paid per call."""
+    key = id(p)
+    hit = _COMPACT_CACHE.get(key)
+    if hit is not None and hit[0] is p:
+        _COMPACT_CACHE.move_to_end(key)
+        return hit[1]
+    cols, vals = _compact_table(np.asarray(p))
+    compact = CompactReTable(cols, vals)
+    _COMPACT_CACHE[key] = (p, compact)
+    while len(_COMPACT_CACHE) > _COMPACT_CACHE_SIZE:
+        _COMPACT_CACHE.popitem(last=False)
+    return compact
 
 
 @jax.jit
@@ -133,12 +172,21 @@ def score_game_data(
                 feats,
                 ents,
             )
-        elif is_structured(raw):
+        elif isinstance(p, CompactReTable) or is_structured(raw):
+            if not is_structured(raw):
+                raise ValueError(
+                    f"coordinate {name!r}: CompactReTable params score "
+                    f"against sparse shards; shard {shard!r} is dense"
+                )
             ents = jnp.asarray(data.entity_ids[re_key])
-            cols_tab, vals_tab = _compact_table(np.asarray(p))
+            compact = (
+                p
+                if isinstance(p, CompactReTable)
+                else _compact_table_cached(p)
+            )
             total = total + _random_scores_sparse(
-                jnp.asarray(cols_tab),
-                jnp.asarray(vals_tab, dtype),
+                jnp.asarray(np.asarray(compact.columns, np.int32)),
+                jnp.asarray(compact.values, dtype),
                 feats,
                 ents,
             )

@@ -53,6 +53,61 @@ from photon_ml_tpu.solvers import (
 _VARIANCE_EPSILON = 1e-12
 
 
+class HashableBounds:
+    """Immutable per-coefficient bound vector with O(1) hashing.
+
+    Configs key the lru_cache'd solver builder, so bounds must be
+    hashable; a plain float tuple would make every cache lookup
+    hash/compare d boxed floats — O(d) Python work per solve, which is
+    pathological at the feature-sharded huge-d regime where
+    ``parallel/distributed.py`` blocks the bounds out to d_block slots.
+    The hash is a content digest computed once at construction; equality
+    is a C-speed memcmp."""
+
+    __slots__ = ("values", "_hash")
+
+    def __init__(self, values):
+        import numpy as np
+
+        arr = np.ascontiguousarray(np.asarray(values, dtype=float))
+        arr.setflags(write=False)
+        self.values = arr
+        self._hash = hash((arr.shape, arr.tobytes()))
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        import numpy as np
+
+        if isinstance(other, HashableBounds):
+            return self._hash == other._hash and np.array_equal(
+                self.values, other.values
+            )
+        if other is None:
+            return False
+        try:
+            return np.array_equal(
+                self.values, np.asarray(other, dtype=float)
+            )
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __array__(self, dtype=None, copy=None):
+        import numpy as np
+
+        return np.asarray(self.values, dtype)
+
+    def __len__(self):
+        return len(self.values)
+
+    def __iter__(self):
+        return iter(self.values.tolist())
+
+    def __repr__(self):
+        return f"HashableBounds(d={self.values.size})"
+
+
 class OptimizerType(enum.Enum):
     """``optimization/OptimizerType.scala`` + NEWTON, a TPU-native
     addition: exact Newton/IRLS with an explicit (d, d) Hessian and
@@ -79,10 +134,10 @@ class GLMTrainingConfig:
     tolerance: float = 1e-7
     num_corrections: int = 10
     intercept_index: Optional[int] = None
-    # box constraints as (hashable) tuples so configs key the solver cache;
-    # arrays are accepted and converted
-    lower_bounds: Optional[Tuple[float, ...]] = None
-    upper_bounds: Optional[Tuple[float, ...]] = None
+    # box constraints as content-hashed HashableBounds so configs key the
+    # solver cache in O(1); tuples/arrays are accepted and wrapped
+    lower_bounds: Optional[HashableBounds] = None
+    upper_bounds: Optional[HashableBounds] = None
     compute_variances: bool = False
     track_states: bool = True
     # per-iteration coefficient snapshots (ModelTracker,
@@ -92,14 +147,19 @@ class GLMTrainingConfig:
     def __post_init__(self):
         import numpy as np
 
-        for name in ("reg_weights", "lower_bounds", "upper_bounds"):
+        v = self.reg_weights
+        if v is not None:
+            # normalize ANY sequence (incl. device arrays: one transfer,
+            # not one sync per element) to a hashable float tuple
+            object.__setattr__(
+                self,
+                "reg_weights",
+                tuple(np.asarray(v, dtype=float).tolist()),
+            )
+        for name in ("lower_bounds", "upper_bounds"):
             v = getattr(self, name)
-            if v is not None:
-                # normalize ANY sequence (incl. device arrays: one transfer,
-                # not one sync per element) to a hashable float tuple
-                object.__setattr__(
-                    self, name, tuple(np.asarray(v, dtype=float).tolist())
-                )
+            if v is not None and not isinstance(v, HashableBounds):
+                object.__setattr__(self, name, HashableBounds(v))
 
     def validate(self) -> None:
         """The reference's cross-flag validation matrix
@@ -156,8 +216,8 @@ class GLMTrainingConfig:
             max_iters=self.max_iters,
             tolerance=self.tolerance,
             num_corrections=self.num_corrections,
-            lower_bounds=None if lb is None else jnp.asarray(lb),
-            upper_bounds=None if ub is None else jnp.asarray(ub),
+            lower_bounds=None if lb is None else jnp.asarray(lb.values),
+            upper_bounds=None if ub is None else jnp.asarray(ub.values),
             track_states=self.track_states,
             track_models=self.track_models,
         )

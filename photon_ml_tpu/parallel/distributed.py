@@ -146,11 +146,14 @@ def feature_sharded_train_glm(
     )
 
     def block_vector(v, fill):
+        # returned as a plain array: GLMTrainingConfig.__post_init__ wraps
+        # it in a content-hashed HashableBounds, so the d_block-length
+        # blocked bounds never hash/compare elementwise in the solver cache
         if v is None:
             return None
         out = np.full((d_block,), fill, dtype=float)
         out[col_map] = np.asarray(v, dtype=float)
-        return tuple(out.tolist())
+        return out
 
     blocked_config = dataclasses.replace(
         config,
