@@ -1,0 +1,165 @@
+"""Measure lane-packed vs vmapped per-entity Newton-step kernels (r5).
+
+VERDICT r4 #2: the random-effect solve floor is XLA's tiny-batched-GEMM
+rate (~8 GFLOP/s on (E, r, d, d) einsums at d=16). The candidate fix
+packs G entities per group into block-diagonal (G*r, G*d) designs so the
+Hessian cross-product, margins, and (optionally) the Cholesky run on
+128-wide MXU tiles. This lab races one full Newton step per layout on
+the REAL chip, with data-dependent chaining inside one jit (the runtime
+short-circuits repeated identical dispatches — docs/PERF.md methodology).
+
+Variants per (E, r, d) shape:
+  base      vmapped per-entity: einsum('erd,er,erc->edc') + cho (E,d,d)
+  packGc    packed block-diag design: bmm Hessian (g,GD,GD), extract the
+            diagonal (d,d) blocks, small cho (E,d,d)
+  packGC    same Hessian, Cholesky directly on the (g,GD,GD) block-diag
+
+Run: python benchmarks/grouped_lab.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import log, measure_tunnel_rtt  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+LAM = 50.0
+STEPS = 8
+
+
+def pack_block_diag(x, G):
+    """(E, r, d) -> (g, G*r, G*d) block-diagonal, E padded to G."""
+    e, r, d = x.shape
+    e_pad = -(-e // G) * G
+    xp = np.zeros((e_pad, r, d), x.dtype)
+    xp[:e] = x
+    g = e_pad // G
+    x4 = xp.reshape(g, G, r, d)
+    out = np.zeros((g, G * r, G * d), x.dtype)
+    for i in range(G):
+        out[:, i * r : (i + 1) * r, i * d : (i + 1) * d] = x4[:, i]
+    return out
+
+
+def time_stepper(fn, *args, steps=STEPS):
+    """fn(carry, *args) -> carry, chained inside ONE jit via fori_loop;
+    returns ms/step with the fetch RTT amortized over all steps."""
+
+    @jax.jit
+    def run(c, *a):
+        return lax.fori_loop(0, steps, lambda i, cc: fn(cc, *a), c)
+
+    c0 = jnp.asarray(0.001, jnp.float32)
+    out = run(c0, *args)
+    out.block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = run(out, *args)
+    float(out)
+    wall = time.perf_counter() - t0
+    return wall / steps * 1e3
+
+
+def race(e, r, d, groups):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((e, r, d)).astype(np.float32)
+    w = rng.standard_normal((e * d,)).astype(np.float32) * 0.01
+    xd = jnp.asarray(x)
+    wd = jnp.asarray(w.reshape(e, d))
+
+    # --- baseline step: batched einsum Hessian + batched small cho -----
+    def base_step(c, X, W):
+        Wc = W + c * 1e-6  # chain
+        z = jnp.einsum("erd,ed->er", X, Wc)
+        cw = jax.nn.sigmoid(z) * (1 - jax.nn.sigmoid(z)) + 0.05
+        h = jnp.einsum("erd,er,erc->edc", X, cw, X)
+        h = h + LAM * jnp.eye(d, dtype=h.dtype)
+        g = jnp.einsum("erd,er->ed", X, cw)
+        p = jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(h), -g[..., None]
+        )[..., 0]
+        return jnp.sum(p) * 1e-9 + c * 0.5
+
+    ms = time_stepper(base_step, xd, wd)
+    flop = 2 * e * r * d * d * STEPS
+    log(
+        f"  base        E={e} r={r} d={d}: {ms:8.2f} ms/step "
+        f"(hess {2*e*r*d*d/1e9:.2f} GFLOP -> {2*e*r*d*d/ms/1e6:.1f} GFLOP/s)"
+    )
+    results = {"base": ms}
+
+    for G in groups:
+        xb = jnp.asarray(pack_block_diag(x, G))
+        g_cnt, rp, gd = xb.shape
+        wp = jnp.asarray(
+            np.pad(w.reshape(e, d), ((0, g_cnt * G - e), (0, 0)))
+            .reshape(g_cnt, G * d)
+        )
+        lam_eye = LAM * jnp.eye(gd, dtype=jnp.float32)
+
+        # --- packed Hessian + extract blocks + small cho ----------------
+        def pack_c_step(c, Xb, Wp):
+            Wc = Wp + c * 1e-6
+            z = jnp.einsum("gri,gi->gr", Xb, Wc)
+            cw = jax.nn.sigmoid(z) * (1 - jax.nn.sigmoid(z)) + 0.05
+            h = jnp.einsum("gri,gr,grj->gij", Xb, cw, Xb)
+            grad = jnp.einsum("gri,gr->gi", Xb, cw)
+            h4 = h.reshape(g_cnt, G, d, G, d)
+            ii = jnp.arange(G)
+            hb = h4[:, ii, :, ii, :]  # (G, g, d, d)
+            hb = hb + LAM * jnp.eye(d, dtype=h.dtype)
+            gb = grad.reshape(g_cnt, G, d).transpose(1, 0, 2)
+            p = jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(hb), -gb[..., None]
+            )[..., 0]
+            return jnp.sum(p) * 1e-9 + c * 0.5
+
+        ms = time_stepper(pack_c_step, xb, wp)
+        pf = 2 * g_cnt * rp * gd * gd
+        log(
+            f"  pack{G:<2d}+cho_d E={e} r={r} d={d}: {ms:8.2f} ms/step "
+            f"(hess {pf/1e9:.2f} GFLOP -> {pf/ms/1e6:.1f} GFLOP/s)"
+        )
+        results[f"pack{G}_chod"] = ms
+
+        # --- packed Hessian + packed (GD, GD) cho -----------------------
+        def pack_C_step(c, Xb, Wp):
+            Wc = Wp + c * 1e-6
+            z = jnp.einsum("gri,gi->gr", Xb, Wc)
+            cw = jax.nn.sigmoid(z) * (1 - jax.nn.sigmoid(z)) + 0.05
+            h = jnp.einsum("gri,gr,grj->gij", Xb, cw, Xb)
+            h = h + lam_eye
+            grad = jnp.einsum("gri,gr->gi", Xb, cw)
+            p = jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(h), -grad[..., None]
+            )[..., 0]
+            return jnp.sum(p) * 1e-9 + c * 0.5
+
+        ms = time_stepper(pack_C_step, xb, wp)
+        log(
+            f"  pack{G:<2d}+cho_G E={e} r={r} d={d}: {ms:8.2f} ms/step "
+            f"(hess {pf/1e9:.2f} GFLOP -> {pf/ms/1e6:.1f} GFLOP/s)"
+        )
+        results[f"pack{G}_choG"] = ms
+    return results
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    rtt = measure_tunnel_rtt(6)
+    log(f"rtt: {rtt}")
+    log("== bench RE shape (plain GAME, 30k entities) ==")
+    race(30000, 40, 16, groups=[4, 8])
+    log("== multi-RE shape (10k users) ==")
+    race(10000, 60, 16, groups=[4, 8])
+    log("== MF latent shape (d=4) ==")
+    race(10000, 60, 4, groups=[8, 16, 32])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Component isolation for the RE Newton step (follow-up to grouped_lab).
+
+grouped_lab showed the packed Hessian einsum runs ~600 GFLOP/s yet the
+full step time barely moves — so the einsum is NOT the floor. This lab
+times each component alone: margins, Hessian einsum (both layouts),
+batched small Cholesky factor+solve, packed Cholesky, triangular solves.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import log, measure_tunnel_rtt  # noqa: E402
+from benchmarks.grouped_lab import pack_block_diag, time_stepper  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+LAM = 50.0
+
+
+def comp(e, r, d, G):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((e, r, d)).astype(np.float32)
+    xd = jnp.asarray(x)
+    xb = jnp.asarray(pack_block_diag(x, G))
+    g_cnt, rp, gd = xb.shape
+    cw = jnp.asarray(rng.uniform(0.1, 0.3, (e, r)).astype(np.float32))
+    cwb = jnp.asarray(rng.uniform(0.1, 0.3, (g_cnt, rp)).astype(np.float32))
+    h_small = jnp.einsum("erd,er,erc->edc", xd, cw, xd) + LAM * jnp.eye(d)
+    h_pack = jnp.einsum("gri,gr,grj->gij", xb, cwb, xb) + LAM * jnp.eye(gd)
+    gvec = jnp.asarray(rng.standard_normal((e, d)).astype(np.float32))
+    gpack = jnp.asarray(
+        rng.standard_normal((g_cnt, gd)).astype(np.float32)
+    )
+
+    def t(name, fn, *args):
+        ms = time_stepper(fn, *args)
+        log(f"    {name:<28s} {ms:8.2f} ms")
+        return ms
+
+    log(f"  E={e} r={r} d={d} G={G} (g={g_cnt}, R'={rp}, GD={gd})")
+    t(
+        "margins batched (erd,ed)",
+        lambda c, X: jnp.sum(
+            jnp.einsum("erd,ed->er", X, gvec + c * 1e-6)
+        )
+        * 1e-9
+        + c * 0.5,
+        xd,
+    )
+    t(
+        "margins packed bmm",
+        lambda c, Xb: jnp.sum(
+            jnp.einsum("gri,gi->gr", Xb, gpack + c * 1e-6)
+        )
+        * 1e-9
+        + c * 0.5,
+        xb,
+    )
+    t(
+        "hessian einsum batched",
+        lambda c, X: jnp.sum(
+            jnp.einsum("erd,er,erc->edc", X, cw + c * 1e-6, X)
+        )
+        * 1e-9
+        + c * 0.5,
+        xd,
+    )
+    t(
+        "hessian einsum packed",
+        lambda c, Xb: jnp.sum(
+            jnp.einsum("gri,gr,grj->gij", Xb, cwb + c * 1e-6, Xb)
+        )
+        * 1e-9
+        + c * 0.5,
+        xb,
+    )
+    t(
+        "cho_factor+solve (E,d,d)",
+        lambda c, H: jnp.sum(
+            jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(
+                    H + c * 1e-6 * jnp.eye(d)
+                ),
+                -(gvec)[..., None],
+            )
+        )
+        * 1e-9
+        + c * 0.5,
+        h_small,
+    )
+    t(
+        "cho_factor only (E,d,d)",
+        lambda c, H: jnp.sum(
+            jax.scipy.linalg.cho_factor(
+                H + c * 1e-6 * jnp.eye(d)
+            )[0]
+        )
+        * 1e-9
+        + c * 0.5,
+        h_small,
+    )
+    t(
+        "cholesky only (E,d,d)",
+        lambda c, H: jnp.sum(
+            jnp.linalg.cholesky(H + c * 1e-6 * jnp.eye(d))
+        )
+        * 1e-9
+        + c * 0.5,
+        h_small,
+    )
+    t(
+        "lu solve (E,d,d)",
+        lambda c, H: jnp.sum(
+            jnp.linalg.solve(
+                H + c * 1e-6 * jnp.eye(d), -(gvec)[..., None]
+            )
+        )
+        * 1e-9
+        + c * 0.5,
+        h_small,
+    )
+    t(
+        "cho_factor+solve (g,GD,GD)",
+        lambda c, H: jnp.sum(
+            jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(
+                    H + c * 1e-6 * jnp.eye(gd)
+                ),
+                -(gpack)[..., None],
+            )
+        )
+        * 1e-9
+        + c * 0.5,
+        h_pack,
+    )
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    log(f"rtt: {measure_tunnel_rtt(6)}")
+    comp(30000, 40, 16, 8)
+    comp(10000, 60, 4, 16)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Unrolled small-d Cholesky solve vs lax batched cholesky (r5, V2).
+
+grouped_lab2 isolated the RE Newton-step floor: XLA's batched Cholesky
+on (30000, 16, 16) costs ~47 ms real (61 ms minus the amortized fetch
+RTT) while every einsum in the step is ~1-4 ms. Candidate fix: a
+Python-unrolled Cholesky + substitution over the STATIC small d — all
+elementwise/matvec ops, vmaps to (E,)-batched kernels, no lax.linalg.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import log, measure_tunnel_rtt  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+STEPS = 16
+
+
+def small_cho_solve(h, b):
+    """h (d, d) SPD, b (d,) -> h^-1 b. Unrolled over static d."""
+    d = h.shape[-1]
+    L = jnp.zeros_like(h)
+    for j in range(d):
+        col = h[j:, j] - L[j:, :j] @ L[j, :j]
+        L = L.at[j:, j].set(col * lax.rsqrt(col[0]))
+    y = jnp.zeros_like(b)
+    for i in range(d):
+        y = y.at[i].set((b[i] - L[i, :i] @ y[:i]) / L[i, i])
+    x = jnp.zeros_like(b)
+    for i in reversed(range(d)):
+        x = x.at[i].set((y[i] - L[i + 1 :, i] @ x[i + 1 :]) / L[i, i])
+    return x
+
+
+def time_stepper(fn, *args, steps=STEPS, rtt_s=0.0):
+    @jax.jit
+    def run(c, *a):
+        return lax.fori_loop(0, steps, lambda i, cc: fn(cc, *a), c)
+
+    c0 = jnp.asarray(0.001, jnp.float32)
+    out = run(c0, *args)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    out = run(out, *args)
+    float(out)
+    wall = time.perf_counter() - t0 - rtt_s
+    return wall / steps * 1e3
+
+
+def race(e, d, rtt_s):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((e, d, d)).astype(np.float32)
+    h = jnp.asarray(np.einsum("eij,ekj->eik", a, a)) + 50.0 * jnp.eye(
+        d, dtype=jnp.float32
+    )
+    b = jnp.asarray(rng.standard_normal((e, d)).astype(np.float32))
+
+    # correctness first
+    ref = jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(h), b)
+    got = jax.vmap(small_cho_solve)(h, b)
+    err = float(jnp.max(jnp.abs(ref - got) / (jnp.abs(ref) + 1e-6)))
+    log(f"  E={e} d={d}: max rel err unrolled vs lax = {err:.2e}")
+
+    ms_lax = time_stepper(
+        lambda c, H: jnp.sum(
+            jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(H + c * 1e-6 * jnp.eye(d)), b
+            )
+        )
+        * 1e-9
+        + c * 0.5,
+        h,
+        rtt_s=rtt_s,
+    )
+    ms_unr = time_stepper(
+        lambda c, H: jnp.sum(
+            jax.vmap(small_cho_solve)(H + c * 1e-6 * jnp.eye(d), b)
+        )
+        * 1e-9
+        + c * 0.5,
+        h,
+        rtt_s=rtt_s,
+    )
+    log(
+        f"    lax cho_factor+solve {ms_lax:8.2f} ms | unrolled "
+        f"{ms_unr:8.2f} ms | speedup {ms_lax / max(ms_unr, 1e-9):.1f}x"
+    )
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    rtt = measure_tunnel_rtt(6)
+    log(f"rtt: {rtt}")
+    rtt_s = rtt["rtt_ms"] / 1e3
+    race(30000, 16, rtt_s)
+    race(10000, 16, rtt_s)
+    race(10000, 4, rtt_s)
+    race(5000, 32, rtt_s)
+    race(2000, 64, rtt_s)
+
+
+if __name__ == "__main__":
+    main()

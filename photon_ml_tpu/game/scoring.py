@@ -11,7 +11,7 @@ own device-resident designs; this path works from a plain parameter dict.
 
 from __future__ import annotations
 
-from collections import OrderedDict
+import weakref
 from typing import Dict, NamedTuple, Optional
 
 import jax
@@ -66,29 +66,41 @@ def _compact_table(table: np.ndarray):
     return cols, vals
 
 
-# compaction results keyed by id(table), holding a STRONG reference to the
-# table so the id cannot be recycled while the entry lives. Bounded: a
-# scoring loop reuses the same few coordinate tables per call, and the
-# compacted (E, k) arrays are small next to the (E, d) originals.
-_COMPACT_CACHE: "OrderedDict[int, tuple]" = OrderedDict()
-_COMPACT_CACHE_SIZE = 8
+# compaction results keyed by id(table) with WEAK references: entries die
+# with their table (no pinning of multi-GB originals), and the weakref
+# identity check guards against id recycling. Live entries track live
+# tables, so the cache is bounded by what the caller itself keeps alive.
+_COMPACT_CACHE: Dict[int, tuple] = {}
 
 
 def _compact_table_cached(p) -> CompactReTable:
     """Per-coordinate cache around ``_compact_table``: without it every
     ``score_game_data`` call re-densifies the full (E, d) table on host
     and re-runs np.nonzero — at the wide regime this path exists for
-    (e.g. 30k x 60k) that is a multi-GB host pass paid per call."""
+    (e.g. 30k x 60k) that is a multi-GB host pass paid per call.
+
+    Only IMMUTABLE inputs are cached (jax.Array, or a numpy array marked
+    non-writeable): a writeable numpy table mutated in place between
+    calls must be re-compacted, as it always was. Callers who score the
+    same wide table repeatedly can pre-compact once into a
+    :class:`CompactReTable`."""
+    cacheable = isinstance(p, jax.Array) or (
+        isinstance(p, np.ndarray) and not p.flags.writeable
+    )
+    if not cacheable:
+        cols, vals = _compact_table(np.asarray(p))
+        return CompactReTable(cols, vals)
     key = id(p)
     hit = _COMPACT_CACHE.get(key)
-    if hit is not None and hit[0] is p:
-        _COMPACT_CACHE.move_to_end(key)
+    if hit is not None and hit[0]() is p:
         return hit[1]
     cols, vals = _compact_table(np.asarray(p))
     compact = CompactReTable(cols, vals)
-    _COMPACT_CACHE[key] = (p, compact)
-    while len(_COMPACT_CACHE) > _COMPACT_CACHE_SIZE:
-        _COMPACT_CACHE.popitem(last=False)
+    try:
+        ref = weakref.ref(p, lambda _, k=key: _COMPACT_CACHE.pop(k, None))
+    except TypeError:  # referent type without weakref support
+        return compact
+    _COMPACT_CACHE[key] = (ref, compact)
     return compact
 
 

@@ -295,6 +295,50 @@ class TestNewton:
         )
         return tm
 
+    def test_small_cho_solve_matches_scipy(self, rng):
+        """The unrolled static-d Cholesky path (the batched lax Cholesky
+        replacement measured ~50 ms/step at (30000,16,16) on TPU) must
+        agree with scipy on SPD systems, alone and under vmap."""
+        import scipy.linalg
+
+        from photon_ml_tpu.solvers.newton import _small_cho_solve
+
+        for d in (1, 2, 4, 16, 32):
+            a = rng.normal(size=(d, d))
+            h = a @ a.T + 5.0 * np.eye(d)
+            b = rng.normal(size=d)
+            got = np.asarray(
+                _small_cho_solve(jnp.asarray(h), jnp.asarray(b))
+            )
+            ref = scipy.linalg.cho_solve(scipy.linalg.cho_factor(h), b)
+            np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-10)
+        # batched under vmap
+        e, d = 64, 16
+        a = rng.normal(size=(e, d, d))
+        h = np.einsum("eij,ekj->eik", a, a) + 5.0 * np.eye(d)
+        b = rng.normal(size=(e, d))
+        got = np.asarray(
+            jax.vmap(_small_cho_solve)(jnp.asarray(h), jnp.asarray(b))
+        )
+        ref = np.stack(
+            [
+                scipy.linalg.cho_solve(scipy.linalg.cho_factor(h[i]), b[i])
+                for i in range(e)
+            ]
+        )
+        np.testing.assert_allclose(got, ref, rtol=1e-8, atol=1e-9)
+
+    def test_small_cho_solve_nan_on_indefinite(self):
+        """Non-PD input must produce NaNs (the jitter-retry detection in
+        _newton_direction keys on them, like the lax factorization)."""
+        from photon_ml_tpu.solvers.newton import _small_cho_solve
+
+        h = jnp.asarray(
+            [[1.0, 2.0], [2.0, 1.0]]
+        )  # eigenvalues 3, -1: indefinite
+        out = np.asarray(_small_cho_solve(h, jnp.ones(2)))
+        assert not np.all(np.isfinite(out))
+
     def test_matches_tron_solution(self, rng):
         batch = self._logistic(rng)
         newton = self._solve(batch, "NEWTON")

@@ -467,6 +467,70 @@ class TestWideSparseRandomEffect:
         )
         np.testing.assert_allclose(s_sparse, s_dense, rtol=1e-9)
 
+    def test_precompacted_table_and_cache(self, rng):
+        """CompactReTable params skip the host densify entirely; the
+        implicit compaction cache serves only IMMUTABLE tables (jax
+        arrays / non-writeable numpy) and evicts with its referent."""
+        from photon_ml_tpu.game.scoring import (
+            CompactReTable,
+            _COMPACT_CACHE,
+            _compact_table,
+            _compact_table_cached,
+            score_game_data,
+        )
+
+        d_wide = 500
+        data, sf, user, y = self._wide_data(rng, 100, 6, d_wide)
+        table = rng.normal(size=(6, d_wide)) * (
+            rng.uniform(size=(6, d_wide)) < 0.05
+        )
+        base = np.asarray(
+            score_game_data(
+                {"re": table}, {"re": "wide"}, {"re": "userId"}, data
+            )
+        )
+        compact = CompactReTable(*_compact_table(table))
+        got = np.asarray(
+            score_game_data(
+                {"re": compact}, {"re": "wide"}, {"re": "userId"}, data
+            )
+        )
+        np.testing.assert_allclose(got, base, rtol=1e-9)
+
+        # CompactReTable against a dense shard is a usage error
+        dense_data = __import__("dataclasses").replace(
+            data, features={"wide": to_dense(sf)}
+        )
+        with pytest.raises(ValueError, match="CompactReTable"):
+            score_game_data(
+                {"re": compact}, {"re": "wide"}, {"re": "userId"},
+                dense_data,
+            )
+
+        # writeable numpy: never cached (in-place mutation must be seen)
+        t_np = np.array(table)
+        c1 = _compact_table_cached(t_np)
+        t_np[0, :] = 0.0
+        c2 = _compact_table_cached(t_np)
+        assert not np.array_equal(
+            np.asarray(c1.values[0]), np.asarray(c2.values[0])
+        )
+
+        # jax array (immutable): cached by identity, evicted on death
+        import jax.numpy as jnp
+
+        t_dev = jnp.asarray(table)
+        c1 = _compact_table_cached(t_dev)
+        c2 = _compact_table_cached(t_dev)
+        assert c1 is c2
+        key = id(t_dev)
+        assert key in _COMPACT_CACHE
+        del t_dev, c1, c2
+        import gc
+
+        gc.collect()
+        assert key not in _COMPACT_CACHE
+
 
 class TestSparseShardGuards:
     def test_random_effect_on_sparse_shard_rejected_without_projector(

@@ -285,6 +285,45 @@ class TestValidationMatrix:
             ).validate()
 
 
+class TestHashableBounds:
+    """Box bounds are stored as content-hashed HashableBounds so configs
+    key the lru_cache'd solver builder in O(1) instead of hashing a
+    d_block-length float tuple per solve (advisor r4)."""
+
+    def test_wrap_equality_and_hash(self):
+        from photon_ml_tpu.models.training import HashableBounds
+
+        a = HashableBounds([0.0, 1.0, 2.0])
+        b = HashableBounds(np.array([0.0, 1.0, 2.0]))
+        c = HashableBounds([0.0, 1.0, 2.5])
+        assert a == b and hash(a) == hash(b)
+        assert a != c
+        assert a == (0.0, 1.0, 2.0)  # sequence equality for tests/callers
+        assert a != None  # noqa: E711 — exercises __eq__(None)
+        assert len(a) == 3 and list(a) == [0.0, 1.0, 2.0]
+        np.testing.assert_array_equal(np.asarray(a), [0.0, 1.0, 2.0])
+
+    def test_config_wraps_and_rewraps_idempotently(self):
+        import dataclasses
+
+        from photon_ml_tpu.models.training import HashableBounds
+
+        cfg = GLMTrainingConfig(
+            lower_bounds=np.zeros(4), upper_bounds=(1.0, 1.0, 1.0, 1.0)
+        )
+        assert isinstance(cfg.lower_bounds, HashableBounds)
+        assert isinstance(cfg.upper_bounds, HashableBounds)
+        lb = cfg.lower_bounds
+        cfg2 = dataclasses.replace(cfg, reg_weights=(2.0,))
+        assert cfg2.lower_bounds is lb  # no rewrap churn
+        assert cfg == dataclasses.replace(cfg)  # hashable + stable
+        assert hash(cfg) == hash(dataclasses.replace(cfg))
+        scfg = cfg.solver_config()
+        np.testing.assert_array_equal(
+            np.asarray(scfg.lower_bounds), np.zeros(4)
+        )
+
+
 class TestValidators:
     def test_clean_data_passes(self, rng):
         x, y = make_logistic_data(rng, n=100, d=4, intercept=False)
