@@ -47,9 +47,10 @@ def pack_block_diag(x, G):
     return out
 
 
-def time_stepper(fn, *args, steps=STEPS):
+def time_stepper(fn, *args, steps=STEPS, rtt_s=0.0):
     """fn(carry, *args) -> carry, chained inside ONE jit via fori_loop;
-    returns ms/step with the fetch RTT amortized over all steps."""
+    returns ms/step. Pass the measured fetch RTT as ``rtt_s`` to remove
+    it from the wall; otherwise it is amortized over all steps."""
 
     @jax.jit
     def run(c, *a):
@@ -61,7 +62,7 @@ def time_stepper(fn, *args, steps=STEPS):
     t0 = time.perf_counter()
     out = run(out, *args)
     float(out)
-    wall = time.perf_counter() - t0
+    wall = time.perf_counter() - t0 - rtt_s
     return wall / steps * 1e3
 
 

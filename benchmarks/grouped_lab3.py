@@ -8,49 +8,23 @@ elementwise/matvec ops, vmaps to (E,)-batched kernels, no lax.linalg.
 """
 
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, ".")
 from bench import log, measure_tunnel_rtt  # noqa: E402
+from benchmarks.grouped_lab import time_stepper  # noqa: E402
+
+# The PRODUCTION implementation is what this lab justifies — race it, not
+# a copy that could drift
+from photon_ml_tpu.solvers.newton import (  # noqa: E402
+    _small_cho_solve as small_cho_solve,
+)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-from jax import lax  # noqa: E402
 
 STEPS = 16
-
-
-def small_cho_solve(h, b):
-    """h (d, d) SPD, b (d,) -> h^-1 b. Unrolled over static d."""
-    d = h.shape[-1]
-    L = jnp.zeros_like(h)
-    for j in range(d):
-        col = h[j:, j] - L[j:, :j] @ L[j, :j]
-        L = L.at[j:, j].set(col * lax.rsqrt(col[0]))
-    y = jnp.zeros_like(b)
-    for i in range(d):
-        y = y.at[i].set((b[i] - L[i, :i] @ y[:i]) / L[i, i])
-    x = jnp.zeros_like(b)
-    for i in reversed(range(d)):
-        x = x.at[i].set((y[i] - L[i + 1 :, i] @ x[i + 1 :]) / L[i, i])
-    return x
-
-
-def time_stepper(fn, *args, steps=STEPS, rtt_s=0.0):
-    @jax.jit
-    def run(c, *a):
-        return lax.fori_loop(0, steps, lambda i, cc: fn(cc, *a), c)
-
-    c0 = jnp.asarray(0.001, jnp.float32)
-    out = run(c0, *args)
-    out.block_until_ready()
-    t0 = time.perf_counter()
-    out = run(out, *args)
-    float(out)
-    wall = time.perf_counter() - t0 - rtt_s
-    return wall / steps * 1e3
 
 
 def race(e, d, rtt_s):
@@ -77,6 +51,7 @@ def race(e, d, rtt_s):
         + c * 0.5,
         h,
         rtt_s=rtt_s,
+        steps=STEPS,
     )
     ms_unr = time_stepper(
         lambda c, H: jnp.sum(
@@ -86,6 +61,7 @@ def race(e, d, rtt_s):
         + c * 0.5,
         h,
         rtt_s=rtt_s,
+        steps=STEPS,
     )
     log(
         f"    lax cho_factor+solve {ms_lax:8.2f} ms | unrolled "

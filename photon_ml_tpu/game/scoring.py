@@ -84,8 +84,16 @@ def _compact_table_cached(p) -> CompactReTable:
     calls must be re-compacted, as it always was. Callers who score the
     same wide table repeatedly can pre-compact once into a
     :class:`CompactReTable`."""
+    # numpy is cacheable only when neither the array NOR any base it
+    # views is writeable (a read-only view over a writeable base still
+    # changes under the caller's feet)
     cacheable = isinstance(p, jax.Array) or (
-        isinstance(p, np.ndarray) and not p.flags.writeable
+        isinstance(p, np.ndarray)
+        and not p.flags.writeable
+        and (
+            p.base is None
+            or not getattr(p.base, "flags", np.ones(1).flags).writeable
+        )
     )
     if not cacheable:
         cols, vals = _compact_table(np.asarray(p))

@@ -91,28 +91,31 @@ def build_game(data, n_users, re_reg=1.0, fe_reg=0.1, dtype=jnp.float64):
 
 
 class TestCoordinateDescent:
-    def test_fused_equals_unfused(self, rng):
-        """The one-dispatch fused pass and the plain loop are the same
+    def test_fused_equals_chunked_equals_unfused(self, rng):
+        """The one-dispatch fused pass, the per-coordinate chunked pass
+        (``fuse_passes="coordinate"``), and the plain loop are the same
         algorithm: identical params, objectives, and PRNG stream
         (``fuse_passes`` only changes dispatch granularity)."""
         data, user, n_users = make_mixed_effects_data(rng)
         cd_f = build_game(data, n_users)
-        cd_u = build_game(data, n_users)
-        cd_u.fuse_passes = False
         m_f, h_f = cd_f.run(num_iterations=2, seed=3)
-        m_u, h_u = cd_u.run(num_iterations=2, seed=3)
-        for k in m_f.params:
-            np.testing.assert_allclose(
-                np.asarray(m_f.params[k]),
-                np.asarray(m_u.params[k]),
-                atol=1e-12,
-            )
-        for rf, ru in zip(h_f, h_u):
-            assert rf.coordinate == ru.coordinate
-            np.testing.assert_allclose(
-                rf.objective, ru.objective, rtol=1e-12
-            )
-            assert rf.convergence_histogram == ru.convergence_histogram
+        for mode in ("coordinate", False):
+            cd_u = build_game(data, n_users)
+            cd_u.fuse_passes = mode
+            m_u, h_u = cd_u.run(num_iterations=2, seed=3)
+            for k in m_f.params:
+                np.testing.assert_allclose(
+                    np.asarray(m_f.params[k]),
+                    np.asarray(m_u.params[k]),
+                    atol=1e-12,
+                    err_msg=f"mode={mode}",
+                )
+            for rf, ru in zip(h_f, h_u):
+                assert rf.coordinate == ru.coordinate
+                np.testing.assert_allclose(
+                    rf.objective, ru.objective, rtol=1e-12
+                )
+                assert rf.convergence_histogram == ru.convergence_histogram
 
     def test_custom_coordinate_without_fused_surface_uses_plain_loop(
         self, rng
