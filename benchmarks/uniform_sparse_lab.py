@@ -1,0 +1,87 @@
+"""Uniform-column sparse pass experiments (r5, VERDICT #1).
+
+The uniform 200k x 120k x 32 logistic solve loses ~6x to sklearn on one
+chip; docs/PERF.md attributes the wall to XLA's irregular gather/scatter
+rate. This lab measures the actual value+grad pass under the layouts the
+VERDICT asked about:
+
+  base        bench layout: rows and in-row columns unsorted
+  rowsort     rows reordered by their minimum column id (gather locality)
+  colsort     in-row column ids ascending (ELL lanes hit ascending cols)
+  both        rowsort + colsort
+  bf16        values in bfloat16 (indices unchanged)
+
+Each timing is a fori_loop-chained sequence of value_and_grad passes
+(w <- w - 1e-6 g) so no dispatch repeats; fetch RTT subtracted.
+"""
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import chained_vg_pass_ms, log, measure_tunnel_rtt  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from photon_ml_tpu.core.types import LabeledBatch  # noqa: E402
+from photon_ml_tpu.ops.losses import loss_for_task  # noqa: E402
+from photon_ml_tpu.ops.objective import GLMObjective  # noqa: E402
+from photon_ml_tpu.ops.sparse import SparseFeatures  # noqa: E402
+from photon_ml_tpu.models.glm import TaskType  # noqa: E402
+
+N, D, NNZ = 200_000, 120_000, 32
+STEPS = 10
+
+
+def time_vg(idx, vals, y, rtt_s, label, dtype=jnp.float32):
+    sf = SparseFeatures(
+        indices=jnp.asarray(idx), values=jnp.asarray(vals, dtype), d=D
+    )
+    batch = LabeledBatch.create(sf, y, dtype=dtype)
+    obj = GLMObjective(
+        loss=loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0
+    )
+    ms = chained_vg_pass_ms(
+        obj, batch, jnp.zeros((D,), jnp.float32), steps=STEPS, rtt_s=rtt_s
+    )
+    slots = idx.size
+    log(
+        f"  {label:<10s} {ms:8.2f} ms/pass "
+        f"({slots / ms / 1e3:.0f} M slot-ops/s counting gather+scatter "
+        f"as one)"
+    )
+    return ms
+
+
+def main():
+    log(f"devices: {jax.devices()}")
+    rtt = measure_tunnel_rtt(6)
+    log(f"rtt: {rtt}")
+    rtt_s = rtt["rtt_ms"] / 1e3
+
+    rng = np.random.default_rng(11)
+    idx = rng.integers(0, D, size=(N, NNZ)).astype(np.int32)
+    vals = rng.standard_normal((N, NNZ)).astype(np.float32)
+    y = (rng.uniform(size=N) < 0.5).astype(np.float32)
+
+    time_vg(idx, vals, y, rtt_s, "base")
+
+    order = np.argsort(idx.min(axis=1), kind="stable")
+    time_vg(idx[order], vals[order], y[order], rtt_s, "rowsort")
+
+    s = np.argsort(idx, axis=1, kind="stable")
+    idx_c = np.take_along_axis(idx, s, axis=1)
+    vals_c = np.take_along_axis(vals, s, axis=1)
+    time_vg(idx_c, vals_c, y, rtt_s, "colsort")
+
+    time_vg(
+        idx_c[order], vals_c[order], y[order], rtt_s, "both"
+    )
+
+    time_vg(idx, vals, y, rtt_s, "bf16", dtype=jnp.bfloat16)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,27 @@ def _config_reg_term(cfg, params) -> jax.Array:
     return 0.5 * l2 * sq + l1 * ab
 
 
+def _normalize_fuse_passes(fp):
+    """True | False | 'coordinate', strictly. Bool-likes (np.bool_, 0/1)
+    normalize to bool; anything else raises — an unrecognized value
+    would otherwise silently select the slow plain loop. Applied at
+    construction AND at run() (the attribute is assignable)."""
+    if isinstance(fp, str):
+        if fp != "coordinate":
+            raise ValueError(
+                f"fuse_passes must be True, False, or 'coordinate'; got "
+                f"{fp!r}"
+            )
+        return fp
+    if fp is True or fp is False:
+        return fp
+    if isinstance(fp, (int, np.bool_)) and fp in (0, 1):
+        return bool(fp)
+    raise ValueError(
+        f"fuse_passes must be True, False, or 'coordinate'; got {fp!r}"
+    )
+
+
 def _loss_fn_for_task(task: TaskType):
     if task == TaskType.LOGISTIC_REGRESSION:
         return metrics_mod.total_logistic_loss
@@ -119,18 +140,12 @@ class CoordinateDescent:
           but per-coordinate programs compile fine.
         - ``False``: plain loop (~3 dispatches per update: update+rescore,
           objective, eager score arithmetic)."""
-        if fuse_passes not in (True, False, "coordinate"):
-            raise ValueError(
-                f"fuse_passes must be True, False, or 'coordinate'; got "
-                f"{fuse_passes!r} (an unrecognized value would silently "
-                "run the slow plain loop)"
-            )
         self.coordinates = dict(coordinates)
         self.labels = labels
         self.base_offsets = base_offsets
         self.weights = weights
         self.task = task
-        self.fuse_passes = fuse_passes
+        self.fuse_passes = _normalize_fuse_passes(fuse_passes)
         loss_fn = _loss_fn_for_task(task)
         names = list(self.coordinates)
 
@@ -429,14 +444,11 @@ class CoordinateDescent:
             all(hasattr(c, m) for m in _fused_surface)
             for c in self.coordinates.values()
         )
+        mode = _normalize_fuse_passes(self.fuse_passes)
         use_fused = (
-            self.fuse_passes is True
-            and validation_fn is None
-            and has_surface
+            mode is True and validation_fn is None and has_surface
         )
-        use_chunked = (
-            self.fuse_passes == "coordinate" and has_surface
-        )
+        use_chunked = mode == "coordinate" and has_surface
         for it in range(start_it, num_iterations):
             if use_fused:
                 t0 = time.perf_counter()

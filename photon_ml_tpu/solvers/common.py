@@ -90,6 +90,11 @@ class SolverResult:
     # total inner CG iterations == Hessian-vector products (TRON only;
     # None for first-order solvers). Feeds FLOP/MFU accounting.
     cg_iterations: Optional[jax.Array] = None
+    # total value_and_grad evaluations == full design passes (LBFGS /
+    # OWL-QN / NEWTON; None for TRON, whose pass count is
+    # iterations + 1 + cg_iterations under the vgc carry). The
+    # counted-work basis for pass-cost ceiling decompositions.
+    evals: Optional[jax.Array] = None
     # (max_iters+1, d) per-iteration coefficients when track_models
     # (ModelTracker); entries at index > iterations are unwritten zeros
     # and must be masked by callers like the values buffer

@@ -127,6 +127,7 @@ class _LbfgsState(NamedTuple):
     values: jax.Array
     grad_norms: jax.Array
     w_history: jax.Array
+    evals: jax.Array  # total value_and_grad calls (full design passes)
 
 
 def minimize_lbfgs(
@@ -164,6 +165,7 @@ def minimize_lbfgs(
         values=values,
         grad_norms=grad_norms,
         w_history=w_hist0,
+        evals=jnp.int32(1),
     )
 
     def body(s: _LbfgsState) -> _LbfgsState:
@@ -177,7 +179,7 @@ def minimize_lbfgs(
 
         def phi(alpha):
             val, grad = value_and_grad_fn(s.w + alpha * direction)
-            return val, jnp.vdot(grad, direction)
+            return val, jnp.vdot(grad, direction), grad
 
         # First step: scale to unit-ish length like breeze's init heuristic.
         alpha_init = jnp.where(
@@ -185,21 +187,35 @@ def minimize_lbfgs(
             jnp.minimum(1.0, 1.0 / jnp.maximum(jnp.linalg.norm(direction), 1e-30)),
             jnp.asarray(1.0, dtype),
         )
-        alpha, _, ls_ok = strong_wolfe(
+        alpha, v_ls, g_ls, ls_ok, ls_evals = strong_wolfe(
             phi,
             s.value,
             dphi0,
             alpha_init,
+            g0=s.grad,
             c1=config.ls_c1,
             c2=config.ls_c2,
             max_evals=config.ls_max_evals,
         )
 
         w_new = s.w + alpha * direction
-        w_new = project_to_hypercube(
-            w_new, config.lower_bounds, config.upper_bounds
+        has_bounds = (
+            config.lower_bounds is not None
+            or config.upper_bounds is not None
         )
-        v_new, g_new = value_and_grad_fn(w_new)
+        if has_bounds:
+            # projection moves the point off the search ray, so the
+            # line-search gradient no longer applies — re-evaluate
+            w_new = project_to_hypercube(
+                w_new, config.lower_bounds, config.upper_bounds
+            )
+            v_new, g_new = value_and_grad_fn(w_new)
+            iter_evals = ls_evals + 1
+        else:
+            # the accepted point IS the last line-search point: reuse its
+            # value and gradient instead of paying one more design pass
+            v_new, g_new = v_ls, g_ls
+            iter_evals = ls_evals
         hist = _push_history(s.hist, w_new - s.w, g_new - s.grad)
 
         it = s.iteration + 1
@@ -242,6 +258,7 @@ def minimize_lbfgs(
             values=values,
             grad_norms=grad_norms,
             w_history=record_model(s.w_history, it, w_new),
+            evals=s.evals + iter_evals,
         )
 
     final = lax.while_loop(
@@ -256,6 +273,7 @@ def minimize_lbfgs(
         values=final.values,
         grad_norms=final.grad_norms,
         w_history=final.w_history if config.track_models else None,
+        evals=final.evals,
     )
 
 
@@ -285,6 +303,7 @@ class _OwlqnState(NamedTuple):
     values: jax.Array
     grad_norms: jax.Array
     w_history: jax.Array
+    evals: jax.Array  # total value_and_grad calls (full design passes)
 
 
 def minimize_owlqn(
@@ -331,6 +350,7 @@ def minimize_owlqn(
         values=values,
         grad_norms=grad_norms,
         w_history=w_hist0,
+        evals=jnp.int32(1),
     )
 
     def body(s: _OwlqnState) -> _OwlqnState:
@@ -373,7 +393,7 @@ def minimize_owlqn(
 
         wt0, vt0, ft0, gt0 = trial(alpha0)
         acc0 = ft0 <= s.full_value + config.ls_c1 * jnp.vdot(pg, wt0 - s.w)
-        alpha, w_new, v_new, f_new, g_new, _, ls_ok = lax.while_loop(
+        alpha, w_new, v_new, f_new, g_new, ls_evals, ls_ok = lax.while_loop(
             ls_cond,
             ls_body,
             (jnp.where(acc0, alpha0, alpha0 * 0.5), wt0, vt0, ft0, gt0,
@@ -423,6 +443,7 @@ def minimize_owlqn(
             values=values,
             grad_norms=grad_norms,
             w_history=record_model(s.w_history, it, w_new),
+            evals=s.evals + ls_evals,
         )
 
     final = lax.while_loop(
@@ -437,4 +458,5 @@ def minimize_owlqn(
         values=final.values,
         grad_norms=final.grad_norms,
         w_history=final.w_history if config.track_models else None,
+        evals=final.evals,
     )

@@ -45,6 +45,12 @@ class _LSState(NamedTuple):
     # accepted point
     a_star: jax.Array
     phi_star: jax.Array
+    # GRADIENT VECTORS at prev / lo / star: carried so the caller can
+    # reuse the accepted point's gradient instead of paying one extra
+    # full design pass per iteration re-evaluating the same point
+    g_prev: jax.Array
+    g_lo: jax.Array
+    g_star: jax.Array
 
 
 def _cubic_min(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi):
@@ -65,20 +71,26 @@ def _cubic_min(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi):
 
 
 def strong_wolfe(
-    phi_fn: Callable[[jax.Array], Tuple[jax.Array, jax.Array]],
+    phi_fn: Callable[[jax.Array], Tuple[jax.Array, jax.Array, jax.Array]],
     phi0: jax.Array,
     dphi0: jax.Array,
     alpha_init: jax.Array,
+    g0: jax.Array,
     c1: float = 1e-4,
     c2: float = 0.9,
     max_evals: int = 20,
     alpha_max: float = 1e10,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Find alpha with  phi(a) <= phi0 + c1*a*dphi0  and  |phi'(a)| <= c2*|dphi0|.
 
-    phi_fn(alpha) -> (phi, dphi) along the fixed search direction.
-    Returns (alpha, phi(alpha), ok). On failure ok=False and alpha is the best
-    Armijo-satisfying point seen (possibly 0.0 = no progress).
+    phi_fn(alpha) -> (phi, dphi, grad) along the fixed search direction,
+    where ``grad`` is the FULL gradient vector at the trial point; ``g0``
+    is the gradient at alpha=0. Returns (alpha, phi(alpha), grad(alpha),
+    ok, evals) — the returned gradient lets the caller skip the
+    re-evaluation of the accepted point (one full design pass per
+    iteration, the distributed cost unit that ``evals`` counts). On
+    failure ok=False and alpha is the best Armijo-satisfying point seen
+    (possibly 0.0 = no progress, with grad = g0).
     """
     dtype = phi0.dtype
     zero = jnp.zeros((), dtype)
@@ -98,6 +110,9 @@ def strong_wolfe(
         dphi_hi=dphi0,
         a_star=zero,
         phi_star=phi0,
+        g_prev=g0,
+        g_lo=g0,
+        g_star=g0,
     )
 
     def armijo_ok(a, phi):
@@ -107,7 +122,7 @@ def strong_wolfe(
         return jnp.abs(dphi) <= -c2 * dphi0
 
     def body(s: _LSState) -> _LSState:
-        phi_a, dphi_a = phi_fn(s.a)
+        phi_a, dphi_a, g_a = phi_fn(s.a)
 
         def bracket_step(s: _LSState) -> _LSState:
             hit_armijo_fail = (~armijo_ok(s.a, phi_a)) | (
@@ -151,6 +166,9 @@ def strong_wolfe(
                 _cubic_min(a_lo, phi_lo, dphi_lo, a_hi, phi_hi, dphi_hi),
                 jnp.minimum(2.0 * s.a, alpha_max),
             )
+            g_lo = jnp.where(
+                to_zoom_pf, s.g_prev, jnp.where(to_zoom_ap, g_a, s.g_lo)
+            )
             return s._replace(
                 stage=stage,
                 a=jnp.where(extend, jnp.minimum(2.0 * s.a, alpha_max), next_a),
@@ -165,6 +183,9 @@ def strong_wolfe(
                 dphi_hi=dphi_hi,
                 a_star=jnp.where(accept, s.a, s.a_star),
                 phi_star=jnp.where(accept, phi_a, s.phi_star),
+                g_prev=jnp.where(extend, g_a, s.g_prev),
+                g_lo=g_lo,
+                g_star=jnp.where(accept, g_a, s.g_star),
             )
 
         def zoom_step(s: _LSState) -> _LSState:
@@ -201,6 +222,8 @@ def strong_wolfe(
                 dphi_hi=dphi_hi,
                 a_star=jnp.where(accept, aj, s.a_star),
                 phi_star=jnp.where(accept, phi_j, s.phi_star),
+                g_lo=jnp.where(shrink_hi, s.g_lo, g_a),
+                g_star=jnp.where(accept, g_a, s.g_star),
             )
 
         s2 = lax.cond(s.stage == _BRACKET, bracket_step, zoom_step, s)
@@ -221,5 +244,8 @@ def strong_wolfe(
     phi = jnp.where(
         accepted, final.phi_star, jnp.where(fallback_ok, final.phi_lo, phi0)
     )
+    grad = jnp.where(
+        accepted, final.g_star, jnp.where(fallback_ok, final.g_lo, g0)
+    )
     ok = accepted | fallback_ok
-    return alpha, phi, ok
+    return alpha, phi, grad, ok, final.i

@@ -53,6 +53,7 @@ class _NewtonState(NamedTuple):
     values: jax.Array
     grad_norms: jax.Array
     w_history: jax.Array
+    evals: jax.Array  # total value_and_grad calls (full design passes)
 
 
 # Dimension bound for the unrolled Cholesky path. Measured on the real
@@ -136,6 +137,7 @@ def minimize_newton(
         values=values,
         grad_norms=grad_norms,
         w_history=w_hist0,
+        evals=jnp.int32(1),
     )
 
     def body(s: _NewtonState) -> _NewtonState:
@@ -173,7 +175,7 @@ def minimize_newton(
         w_full = s.w + direction
         v_full, g_full = value_and_grad_fn(w_full)
         acc0 = v_full <= s.value + config.ls_c1 * dphi0
-        alpha, v_new, g_new, _, ls_ok = lax.while_loop(
+        alpha, v_new, g_new, ls_evals, ls_ok = lax.while_loop(
             ls_cond,
             ls_body,
             (
@@ -222,6 +224,7 @@ def minimize_newton(
             values=values,
             grad_norms=grad_norms,
             w_history=record_model(s.w_history, it, w_new),
+            evals=s.evals + ls_evals,
         )
 
     final = lax.while_loop(
@@ -236,4 +239,5 @@ def minimize_newton(
         values=final.values,
         grad_norms=final.grad_norms,
         w_history=final.w_history if config.track_models else None,
+        evals=final.evals,
     )
