@@ -1,0 +1,149 @@
+"""Dense TRON per-pass decomposition (r5, VERDICT #7).
+
+The 1M x 256 bf16 headline records hbm_util 0.19-0.33 (tunnel-load
+band). This lab decomposes the per-pass cost so the band is either
+pushed up or shown to be the machine's floor for this arithmetic
+intensity: chained timings (fori_loop inside one jit, RTT subtracted)
+of each component the solve is made of, then the full solve wall per
+counted pass next to the sum.
+
+  margins   one design read:  z = X @ w (+reduce)
+  vgc       the fused value/grad/curvature pass: two design reads
+            (margins + back-projection) + elementwise loss
+  hvp       Hessian-vector with precomputed curvature: two reads
+  solve     minimize_tron via train_glm, passes = iters + 1 + cg
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import (  # noqa: E402
+    PEAK_HBM_BPS,
+    log,
+    measure_tunnel_rtt,
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from photon_ml_tpu.core.types import LabeledBatch  # noqa: E402
+from photon_ml_tpu.models import (  # noqa: E402
+    GLMTrainingConfig,
+    OptimizerType,
+    TaskType,
+    train_glm,
+)
+from photon_ml_tpu.ops import RegularizationContext  # noqa: E402
+from photon_ml_tpu.ops.losses import loss_for_task  # noqa: E402
+from photon_ml_tpu.ops.objective import GLMObjective  # noqa: E402
+
+N, D = 1_000_000, 256
+STEPS = 10
+
+
+def chained(fn, w0, batch, rtt_s, steps=STEPS):
+    @jax.jit
+    def run(w, b):
+        return lax.fori_loop(0, steps, lambda i, w: fn(w, b), w)
+
+    out = run(w0, batch)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    out = run(out, batch)
+    float(out[0])
+    return (time.perf_counter() - t0 - rtt_s) / steps * 1e3
+
+
+def main():
+    import ml_dtypes  # noqa: F401
+
+    log(f"devices: {jax.devices()}")
+    rtt = measure_tunnel_rtt(6)
+    log(f"rtt: {rtt}")
+    rtt_s = rtt["rtt_ms"] / 1e3
+
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal((N, D), dtype=np.float32)
+    w_true = rng.standard_normal(D).astype(np.float32) * 0.3
+    y = (
+        rng.uniform(size=N) < 1.0 / (1.0 + np.exp(-(x @ w_true)))
+    ).astype(np.float32)
+    batch = LabeledBatch.create(x, y, dtype=jnp.bfloat16)
+    obj = GLMObjective(
+        loss=loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=1.0
+    )
+    w0 = jnp.zeros((D,), jnp.float32)
+    read_gb = N * D * 2 / 1e9  # one bf16 design read
+
+    ms_margin = chained(
+        lambda w, b: w + 1e-12 * jnp.sum(obj.margins(w, b)),
+        w0, batch, rtt_s,
+    )
+    log(
+        f"margins (1 read):  {ms_margin:7.2f} ms  "
+        f"-> {read_gb / ms_margin * 1e3:.0f} GB/s "
+        f"({read_gb / ms_margin * 1e3 / (PEAK_HBM_BPS / 1e9):.0%} of HBM)"
+    )
+
+    def vgc(w, b):
+        v, g, c = obj.value_grad_curvature(w, b)
+        return w - 1e-7 * g
+
+    ms_vgc = chained(vgc, w0, batch, rtt_s)
+    log(
+        f"vgc     (2 reads): {ms_vgc:7.2f} ms  "
+        f"-> {2 * read_gb / ms_vgc * 1e3:.0f} GB/s "
+        f"({2 * read_gb / ms_vgc * 1e3 / (PEAK_HBM_BPS / 1e9):.0%} of HBM)"
+    )
+
+    c_fixed = jnp.full((N,), 0.25, jnp.float32)
+
+    def hvp(w, b):
+        return w - 1e-7 * obj.hessian_vector_at(c_fixed, w, b)
+
+    ms_hvp = chained(hvp, w0, batch, rtt_s)
+    log(
+        f"hvp     (2 reads): {ms_hvp:7.2f} ms  "
+        f"-> {2 * read_gb / ms_hvp * 1e3:.0f} GB/s"
+    )
+
+    # full solve, counted passes
+    cfg = lambda lam: GLMTrainingConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.TRON,
+        regularization=RegularizationContext("L2"),
+        reg_weights=(lam,),
+        tolerance=1e-5,
+        max_iters=20,
+        track_states=False,
+    )
+    (warm,) = train_glm(batch, cfg(10.0))
+    np.asarray(warm.result.w)
+    t0 = time.perf_counter()
+    (tm,) = train_glm(batch, cfg(1.0))
+    np.asarray(tm.result.w)
+    wall = time.perf_counter() - t0 - rtt_s
+    iters = int(np.asarray(tm.result.iterations))
+    cg = int(np.asarray(tm.result.cg_iterations))
+    passes = iters + 1 + cg
+    per_pass = wall / passes * 1e3
+    # decomposition: cg passes are HVPs, iters+1 are vgc passes
+    predicted = (cg * ms_hvp + (iters + 1) * ms_vgc) / 1e3
+    log(
+        f"solve: {wall:.3f} s / {passes} passes ({iters} it + {cg} cg) "
+        f"= {per_pass:.2f} ms/pass"
+    )
+    log(
+        f"decomposition: {cg} hvp x {ms_hvp:.1f} + {iters + 1} vgc x "
+        f"{ms_vgc:.1f} = {predicted:.3f} s -> "
+        f"{predicted / wall:.0%} of observed (rest = while-step "
+        f"overhead + line-search scalars + radius logic)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ import json
 import os
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -84,6 +85,64 @@ def _coordinate_config(
     )
 
 
+def _validate_multiprocess_params(params: GameDriverParams) -> None:
+    """Constraints of the multi-process GAME driver path. The supported
+    surface is dense fixed effects + IDENTITY/factored random effects
+    with num_buckets=1 — the entity-partitioned contract of
+    ``make_global_re_design`` (the reference's RandomEffectIdPartitioner
+    placement); everything else fails loudly instead of silently
+    diverging across processes."""
+    problems = []
+    if params.validate_input:
+        problems.append(
+            "validate_input (validation rows would need the same entity "
+            "partitioning; score offline with cli.score)"
+        )
+    if params.sparse_shards:
+        problems.append("sparse_shards (the projected-sparse RE path is "
+                        "per-process host work)")
+    if params.checkpoint_every > 0:
+        problems.append("checkpoint_every > 0")
+    for name, spec in params.coordinates.items():
+        if spec.hot_columns:
+            problems.append(f"coordinate {name!r}: hot_columns (the "
+                            "hybrid row permutation is process-local)")
+        if spec.random_effect is not None and spec.num_buckets != 1:
+            problems.append(
+                f"coordinate {name!r}: num_buckets != 1 (bucket shapes "
+                "must agree across processes)"
+            )
+        if spec.projector:
+            problems.append(f"coordinate {name!r}: projector")
+    if problems:
+        raise ValueError(
+            "multi-process GAME training does not support: "
+            + "; ".join(problems)
+        )
+
+
+def _pad_game_data(data: GameData, n_target: int) -> GameData:
+    """Pad to n_target rows with weight-0 / entity -1 filler rows so
+    every process contributes identical shapes to the global arrays."""
+    n = data.num_rows
+    if n == n_target:
+        return data
+    pad = n_target - n
+    return GameData(
+        features={
+            k: np.pad(np.asarray(v), ((0, pad), (0, 0)))
+            for k, v in data.features.items()
+        },
+        labels=np.pad(data.labels, (0, pad)),
+        offsets=np.pad(data.offsets, (0, pad)),
+        weights=np.pad(data.weights, (0, pad)),  # pad rows weigh 0
+        entity_ids={
+            k: np.pad(v, (0, pad), constant_values=-1)
+            for k, v in data.entity_ids.items()
+        },
+    )
+
+
 def build_coordinates(
     params: GameDriverParams,
     data: GameData,
@@ -93,12 +152,18 @@ def build_coordinates(
     dtype=jnp.float64,
     shard_vocabs: Optional[Dict[str, FeatureVocabulary]] = None,
     design_cache: Optional[Dict[str, object]] = None,
+    multiproc: Optional[dict] = None,
 ):
     """One training coordinate per updating-sequence entry.
 
     ``design_cache`` (coordinate name -> built design) carries the
     combo-invariant bucketing/feature-selection work across a reg-weight
-    grid — designs depend on data + data knobs, never on lambda."""
+    grid — designs depend on data + data knobs, never on lambda.
+
+    ``multiproc`` (multi-process runs): {"mesh", "row_base",
+    "entity_spaces": re -> (E_global, entity_base),
+    "local_entity_counts"} — local builds are globalized into
+    mesh-spanning arrays (``parallel.multihost``)."""
     coords = {}
     for name in params.updating_sequence:
         spec = params.coordinates[name]
@@ -118,12 +183,17 @@ def build_coordinates(
                     )
                     if design_cache is not None:
                         design_cache[cache_key] = hybrid_pack
-            coords[name] = FixedEffectCoordinate(
+            fe_batch = (
                 data.fixed_effect_batch(spec.shard, dtype)
                 if hybrid_pack is None
-                else hybrid_pack[0],
-                cfg,
-                hybrid_pack=hybrid_pack,
+                else hybrid_pack[0]
+            )
+            if multiproc is not None:
+                from photon_ml_tpu.parallel import make_global_batch
+
+                fe_batch = make_global_batch(fe_batch, multiproc["mesh"])
+            coords[name] = FixedEffectCoordinate(
+                fe_batch, cfg, hybrid_pack=hybrid_pack
             )
         else:
             from photon_ml_tpu.ops import sparse as sparse_ops
@@ -158,18 +228,58 @@ def build_coordinates(
                     data,
                     spec.random_effect,
                     spec.shard,
-                    entity_counts[spec.random_effect],
+                    (
+                        multiproc["local_entity_counts"][spec.random_effect]
+                        if multiproc is not None
+                        else entity_counts[spec.random_effect]
+                    ),
                     num_buckets=spec.num_buckets,
                     active_cap=spec.active_cap,
                     dtype=dtype,
                     feature_ratio=spec.feature_ratio,
                     min_support=spec.min_support,
                 )
+                if multiproc is not None:
+                    from photon_ml_tpu.parallel import (
+                        make_global_re_design,
+                    )
+
+                    e_glob, e_base = multiproc["entity_spaces"][
+                        spec.random_effect
+                    ]
+                    design = make_global_re_design(
+                        design,
+                        multiproc["mesh"],
+                        e_glob,
+                        e_base,
+                        multiproc["row_base"],
+                    )
                 if design_cache is not None:
                     design_cache[name] = design
-            row_features = jnp.asarray(data.features[spec.shard], dtype)
-            row_entities = jnp.asarray(data.entity_ids[spec.random_effect])
-            offsets_base = jnp.asarray(data.offsets, dtype)
+            if multiproc is None:
+                row_features = jnp.asarray(data.features[spec.shard], dtype)
+                row_entities = jnp.asarray(
+                    data.entity_ids[spec.random_effect]
+                )
+                offsets_base = jnp.asarray(data.offsets, dtype)
+            else:
+                from photon_ml_tpu.parallel import make_global_array
+
+                mesh = multiproc["mesh"]
+                _, e_base = multiproc["entity_spaces"][spec.random_effect]
+                ents = np.asarray(data.entity_ids[spec.random_effect])
+                row_features = make_global_array(
+                    np.asarray(data.features[spec.shard], dtype), mesh
+                )
+                row_entities = make_global_array(
+                    np.where(ents >= 0, ents + e_base, -1).astype(
+                        np.int32
+                    ),
+                    mesh,
+                )
+                offsets_base = make_global_array(
+                    np.asarray(data.offsets, dtype), mesh
+                )
             if spec.latent_dim is not None:
                 if spec.projector:
                     raise ValueError(
@@ -307,15 +417,31 @@ def run_game_training(params) -> GameTrainingRun:
         f"sequence={params.updating_sequence} iters={params.num_iterations}"
     )
 
+    # ---- multi-process runtime (the reference's fake-cluster / YARN
+    # regimes, ``DriverGameIntegTest.scala:343-400``): join when
+    # configured; each process ingests its file split, designs assemble
+    # into mesh-global arrays -------------------------------------------
+    from photon_ml_tpu.parallel import initialize_multihost
+
+    initialize_multihost()  # no-op when unconfigured / already joined
+    # gate on process_count alone: a launcher may have initialized the
+    # distributed runtime itself, and a False here while process_count>1
+    # would make every process silently ingest the FULL input
+    multi = jax.process_count() > 1
+    if multi:
+        _validate_multiprocess_params(params)
+
     # ---- prepare feature maps + dataset ---------------------------------
     with timed(logger, "prepare data"):
         from photon_ml_tpu.io.ingest import IngestSource
 
         date_range = resolve_date_range(params)
-        source = IngestSource(
-            expand_date_paths(params.train_input, date_range),
-            params.field_names,
-        )
+        train_paths = expand_date_paths(params.train_input, date_range)
+        if multi:
+            from photon_ml_tpu.parallel import process_local_paths
+
+            train_paths = process_local_paths(train_paths)
+        source = IngestSource(train_paths, params.field_names)
 
         shard_ids = {
             spec.shard for spec in params.coordinates.values()
@@ -334,6 +460,13 @@ def run_game_training(params) -> GameTrainingRun:
                         add_intercept=params.add_intercept
                     )
                 shard_vocabs[shard] = fallback_vocab
+        if multi and fallback_shards:
+            raise ValueError(
+                f"multi-process GAME requires a feature_shards file for "
+                f"every shard (got none for {sorted(fallback_shards)}): "
+                "the from-records fallback vocabulary is built from each "
+                "process's local rows and would diverge across processes"
+            )
         if len(fallback_shards) > 1:
             # The from-records fallback is the FULL feature space, so these
             # shards collapse into identical bags — unlike the reference's
@@ -360,6 +493,68 @@ def run_game_training(params) -> GameTrainingRun:
             f"shards: { {s: len(v) for s, v in shard_vocabs.items()} } "
             f"entities: {entity_counts}"
         )
+
+        multiproc = None
+        if multi:
+            from photon_ml_tpu.parallel import (
+                allgather_host,
+                allgather_strings,
+                global_entity_space,
+                make_mesh,
+            )
+
+            mesh = make_mesh()  # every device across every process
+            n_local = data.num_rows
+            n_all = allgather_host(np.asarray([n_local], np.int64))
+            n_target = (
+                -(-int(n_all.max()) // jax.local_device_count())
+                * jax.local_device_count()
+            )
+            data = _pad_game_data(data, n_target)
+            row_base = n_target * jax.process_index()
+            local_entity_counts = dict(entity_counts)
+            entity_spaces = {
+                k: global_entity_space(c)
+                for k, c in sorted(entity_counts.items())
+            }
+            entity_counts = {k: es[0] for k, es in entity_spaces.items()}
+            # globalize entity vocabularies: each process indexed ITS
+            # entities 0..E_p-1; the global table row for raw id r on
+            # process p is entity_base_p + local index
+            for k in sorted(entity_vocabs):
+                vocab = entity_vocabs[k]
+                ordered = [None] * len(vocab)
+                for raw, i in vocab.items():
+                    ordered[i] = str(raw)
+                all_raw = allgather_strings(ordered)
+                if len(set(all_raw)) != len(all_raw):
+                    from collections import Counter
+
+                    dups = [
+                        r for r, c in Counter(all_raw).items() if c > 1
+                    ]
+                    raise ValueError(
+                        f"random effect {k!r}: entity ids "
+                        f"{sorted(dups)[:5]}{'...' if len(dups) > 5 else ''}"
+                        f" appear on more than one process — multi-process"
+                        " GAME requires ENTITY-PARTITIONED input splits "
+                        "(every entity's rows in exactly one process's "
+                        "files), like the reference's "
+                        "RandomEffectIdPartitioner placement"
+                    )
+                entity_vocabs[k] = {r: i for i, r in enumerate(all_raw)}
+            multiproc = {
+                "mesh": mesh,
+                "row_base": row_base,
+                "entity_spaces": entity_spaces,
+                "local_entity_counts": local_entity_counts,
+            }
+            logger.info(
+                f"multi-process GAME: {jax.process_count()} processes x "
+                f"{jax.local_device_count()} local devices; "
+                f"rows/process {n_target} (padded from {n_local}), "
+                f"global entities {entity_counts}"
+            )
 
         vdata = None
         if params.validate_input:
@@ -433,6 +628,7 @@ def run_game_training(params) -> GameTrainingRun:
             coords = build_coordinates(
                 params, data, task, combo, entity_counts, dtype=dtype,
                 shard_vocabs=shard_vocabs, design_cache=design_cache,
+                multiproc=multiproc,
             )
             initial_model = None
             if warm_params:
@@ -467,11 +663,24 @@ def run_game_training(params) -> GameTrainingRun:
                         )
                     init[n] = coord.initial_params()
                 initial_model = GameModel(params=init)
+            if multiproc is not None:
+                from photon_ml_tpu.parallel import make_global_array
+
+                _mk = lambda x: make_global_array(
+                    np.asarray(x, dtype), multiproc["mesh"]
+                )
+                labels_arr = _mk(data.labels)
+                offsets_arr = _mk(data.offsets)
+                weights_arr = _mk(data.weights)
+            else:
+                labels_arr = jnp.asarray(data.labels, dtype)
+                offsets_arr = jnp.asarray(data.offsets, dtype)
+                weights_arr = jnp.asarray(data.weights, dtype)
             cd = CoordinateDescent(
                 coordinates=coords,
-                labels=jnp.asarray(data.labels, dtype),
-                base_offsets=jnp.asarray(data.offsets, dtype),
-                weights=jnp.asarray(data.weights, dtype),
+                labels=labels_arr,
+                base_offsets=offsets_arr,
+                weights=weights_arr,
                 task=task,
             )
             # validation (like persistence) always sees original-space
@@ -516,6 +725,20 @@ def run_game_training(params) -> GameTrainingRun:
                         if h.seconds is not None
                         else ""
                     )
+                )
+            if multiproc is not None:
+                # every process fetches the identical full host params
+                # (global shards reshard to replicated first), so model
+                # writers below need no process gating
+                from photon_ml_tpu.parallel import fetch_replicated
+
+                model = GameModel(
+                    {
+                        n: jax.tree_util.tree_map(
+                            lambda a: np.asarray(fetch_replicated(a)), p
+                        )
+                        for n, p in model.params.items()
+                    }
                 )
             model = materialize_original_space(model, coords)
             if vfn is not None:
@@ -633,9 +856,14 @@ def main(argv=None) -> None:
     p.add_argument("--overwrite", action="store_true", default=None)
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
-    # the accelerator backend or touch the cache directory
+    # the accelerator backend or touch the cache directory.
+    # JOIN FIRST: jax.distributed.initialize must run before anything
+    # touches the backend, and enable_compilation_cache reads
+    # jax.default_backend()
+    from photon_ml_tpu.parallel import initialize_multihost
     from photon_ml_tpu.utils import enable_compilation_cache
 
+    initialize_multihost()
     enable_compilation_cache()
     with open(args.config) as f:
         base = json.load(f)

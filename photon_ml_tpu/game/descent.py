@@ -323,6 +323,32 @@ class CoordinateDescent:
         )
         history: List[CoordinateUpdateRecord] = []
         key = jax.random.PRNGKey(seed)
+        # Multi-process (multi-controller SPMD): every jit input must be
+        # a GLOBAL array. The data arrays arrive global from the caller
+        # (make_global_batch / make_global_re_design), but locally
+        # created state — the PRNG key and zero-initialized parameter
+        # tables — is a single-device process-local array that jit would
+        # reject; re-place it replicated over the data mesh.
+        if jax.process_count() > 1 and (
+            isinstance(self.labels, jax.Array)
+            and not self.labels.is_fully_addressable
+        ):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.labels.sharding.mesh, PartitionSpec())
+
+            def _globalize(x):
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return x  # already global
+                return jax.device_put(np.asarray(x), rep)
+
+            model = GameModel(
+                {
+                    n: jax.tree_util.tree_map(_globalize, p)
+                    for n, p in model.params.items()
+                }
+            )
+            key = _globalize(key)
         start_it = 0
         if checkpoint_dir is not None and resume:
             from photon_ml_tpu.io.checkpoint import latest_checkpoint
@@ -391,6 +417,14 @@ class CoordinateDescent:
                     )
                 else:
                     fetch.append((p["objective"], (r.reason, r.iterations)))
+            if jax.process_count() > 1:
+                # global arrays with non-addressable shards (entity-lane
+                # sharded trackers) reshard to replicated before fetch
+                from photon_ml_tpu.parallel.multihost import (
+                    fetch_replicated,
+                )
+
+                fetch = jax.tree_util.tree_map(fetch_replicated, fetch)
             host = jax.device_get(fetch)
             for p, (obj, tr) in zip(pending, host):
                 result = p.pop("result")

@@ -26,8 +26,14 @@ from photon_ml_tpu.parallel.mesh import (
     shard_design,
 )
 from photon_ml_tpu.parallel.multihost import (
+    allgather_host,
+    allgather_strings,
+    fetch_replicated,
+    global_entity_space,
     initialize_multihost,
+    make_global_array,
     make_global_batch,
+    make_global_re_design,
     process_local_paths,
     process_local_rows,
 )
@@ -51,8 +57,14 @@ __all__ = [
     "distributed_train_glm",
     "feature_sharded_train_glm",
     "shard_map_value_and_grad",
+    "allgather_host",
+    "allgather_strings",
+    "fetch_replicated",
+    "global_entity_space",
     "initialize_multihost",
+    "make_global_array",
     "make_global_batch",
+    "make_global_re_design",
     "process_local_paths",
     "process_local_rows",
 ]

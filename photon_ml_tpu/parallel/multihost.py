@@ -133,6 +133,215 @@ def process_local_paths(paths):
     return paths[jax.process_index()::n]
 
 
+def make_global_array(x, mesh):
+    """One process-local array -> one GLOBAL jax.Array: every process
+    contributes its rows, concatenated in process order along axis 0 and
+    sharded over all mesh axes flattened (``mesh.batch_sharding``). All
+    processes must contribute the SAME local shape."""
+    import numpy as np
+
+    from photon_ml_tpu.parallel.mesh import batch_sharding
+
+    x = np.asarray(x)
+    sharding = batch_sharding(mesh, x.ndim)
+    global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+
+def allgather_host(x):
+    """Small HOST array -> the concatenation of every process's value
+    (process order, axis 0), returned as a host numpy array on every
+    process. The bookkeeping primitive for globalizing per-process
+    metadata (entity counts, lane->table index vectors)."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(x), tiled=True)
+    )
+
+
+def allgather_strings(strs):
+    """Every process's list of strings -> one list concatenated in
+    process order, identical on every process. Strings are utf-8 encoded
+    into fixed-width uint8 rows (padded to the allgathered max length
+    and count) so the exchange rides the same array allgather as
+    everything else. The globalization primitive for ENTITY VOCABULARIES
+    in multi-process GAME: each process indexes its own entities; the
+    global raw-id -> table-row map is this concatenation."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return list(strs)
+    enc = [s.encode("utf-8") for s in strs]
+    local_count = len(enc)
+    local_max = max((len(b) for b in enc), default=0)
+    meta = allgather_host(
+        np.asarray([[local_count, local_max]], np.int64)
+    )  # (nproc, 2)
+    max_count = int(meta[:, 0].max())
+    max_len = max(int(meta[:, 1].max()), 1)
+    buf = np.zeros((max_count, max_len), np.uint8)
+    lens = np.zeros((max_count,), np.int64)
+    for i, b in enumerate(enc):
+        buf[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    g_buf = allgather_host(buf).reshape(-1, max_count, max_len)
+    g_lens = allgather_host(lens).reshape(-1, max_count)
+    out = []
+    for p in range(jax.process_count()):
+        for i in range(int(meta[p, 0])):
+            out.append(
+                g_buf[p, i, : g_lens[p, i]].tobytes().decode("utf-8")
+            )
+    return out
+
+
+def global_entity_space(local_num_entities: int):
+    """(num_entities_global, entity_base) for THIS process: entities are
+    process-partitioned (the TPU analog of the reference's
+    ``RandomEffectIdPartitioner`` placement — every entity's rows live in
+    exactly one process's input split), and the global coefficient-table
+    row for this process's local entity e is ``entity_base + e``."""
+    import numpy as np
+
+    counts = allgather_host(np.asarray([local_num_entities], np.int64))
+    base = int(counts[: jax.process_index()].sum())
+    return int(counts.sum()), base
+
+
+# one jitted identity-reshard per mesh: a fresh jit per call would
+# retrace/re-lower on every fetched leaf of every update (the pjit cache
+# keys on function identity)
+_REPLICATE_JIT_CACHE: dict = {}
+
+
+def fetch_replicated(x):
+    """Materialize ANY jax.Array on host — including global arrays with
+    non-addressable shards (multi-process): those are resharded to
+    replicated (one all-gather) and then fetched. Fully-addressable
+    arrays (and non-arrays) pass straight to the caller's np.asarray."""
+    import numpy as np
+
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = x.sharding.mesh
+        fn = _REPLICATE_JIT_CACHE.get(mesh)
+        if fn is None:
+            fn = jax.jit(
+                lambda a: a,
+                out_shardings=NamedSharding(mesh, PartitionSpec()),
+            )
+            _REPLICATE_JIT_CACHE[mesh] = fn
+        return np.asarray(fn(x))
+    return x
+
+
+def make_global_re_design(
+    design,
+    mesh,
+    num_entities_global: int,
+    entity_base: int,
+    row_base: int,
+):
+    """Local (per-process) random-effect design -> GLOBAL design whose
+    bucket lanes concatenate over processes and shard over the mesh.
+
+    Contract (the reference's ``RandomEffectIdPartitioner`` placement,
+    ``data/RandomEffectDataSet.scala:39-381``): input rows are
+    ENTITY-PARTITIONED across processes — every entity's rows live in
+    exactly one process's split — and all processes build with the SAME
+    num_buckets and bucket shapes (pin ``active_cap``; shapes must match
+    across processes or the global assembly is rejected by the runtime).
+
+    ``entity_base``/``num_entities_global`` come from
+    :func:`global_entity_space`; ``row_base`` is this process's offset in
+    the global row space (n_local * process_index for even splits) so
+    per-pass residual gathers hit the right global rows. Bucket lane ->
+    table row indices are allgathered host-side (small int vectors);
+    local pad sentinels remap to the global sentinel.
+
+    Processes may hold DIFFERENT entity counts / row caps per bucket:
+    every process's bucket is padded to the allgathered max lane count
+    (rounded up to the local device count so the global lane axis shards
+    evenly) and max row cap before assembly; pad lanes carry the global
+    sentinel and zero masks, so gathers clip and scatters drop them."""
+    import numpy as np
+
+    from photon_ml_tpu.game.data import (
+        BucketedRandomEffectDesign,
+        RandomEffectDesign,
+    )
+
+    if isinstance(design, RandomEffectDesign):
+        design = BucketedRandomEffectDesign(
+            buckets=[design],
+            entity_index=[
+                np.arange(design.num_entities, dtype=np.int32)
+            ],
+            num_entities=design.num_entities,
+        )
+    n_buckets = allgather_host(np.asarray([design.num_buckets], np.int64))
+    if not (n_buckets == n_buckets[0]).all():
+        raise ValueError(
+            f"processes built different bucket counts {n_buckets.tolist()}"
+            " — pin num_buckets in the coordinate spec"
+        )
+    g_buckets, g_index = [], []
+    local_dev = jax.local_device_count()
+    for bucket, eidx in zip(design.buckets, design.entity_index):
+        shapes = allgather_host(
+            np.asarray(
+                [[bucket.num_entities, bucket.rows_per_entity]], np.int64
+            )
+        )  # (nproc, 2)
+        e_max = int(shapes[:, 0].max())
+        e_max = -(-e_max // local_dev) * local_dev
+        r_max = int(shapes[:, 1].max())
+        feats = np.asarray(bucket.features)
+        e_loc, r_loc, dim = feats.shape
+        pe, pr = e_max - e_loc, r_max - r_loc
+
+        def pad2(x, fill=0.0):
+            return np.pad(
+                np.asarray(x), ((0, pe), (0, pr)), constant_values=fill
+            )
+
+        ri = np.asarray(bucket.row_index)
+        ri = np.where(ri >= 0, ri + row_base, -1).astype(np.int32)
+        g_buckets.append(
+            RandomEffectDesign(
+                features=make_global_array(
+                    np.pad(feats, ((0, pe), (0, pr), (0, 0))), mesh
+                ),
+                labels=make_global_array(pad2(bucket.labels), mesh),
+                weights=make_global_array(pad2(bucket.weights), mesh),
+                mask=make_global_array(pad2(bucket.mask), mesh),
+                row_index=make_global_array(pad2(ri, fill=-1), mesh),
+            )
+        )
+        ei = np.asarray(eidx)
+        ei_g = np.where(
+            ei < design.num_entities,
+            ei + entity_base,
+            num_entities_global,
+        ).astype(np.int32)
+        ei_g = np.pad(
+            ei_g, (0, e_max - ei_g.shape[0]),
+            constant_values=num_entities_global,
+        )
+        g_index.append(allgather_host(ei_g))
+    return BucketedRandomEffectDesign(
+        buckets=g_buckets,
+        entity_index=g_index,
+        num_entities=num_entities_global,
+    )
+
+
 def make_global_batch(local_batch, mesh):
     """Assemble a GLOBAL row-sharded batch from THIS process's local rows
     (the multi-host generalization of ``mesh.shard_batch``): every leaf
@@ -147,21 +356,7 @@ def make_global_batch(local_batch, mesh):
     ``shard_batch`` without the padding."""
     import jax.tree_util as jtu
 
-    from photon_ml_tpu.parallel.mesh import batch_sharding
-
-    nproc = jax.process_count()
-
-    def mk(x):
-        import numpy as np
-
-        x = np.asarray(x)
-        sharding = batch_sharding(mesh, x.ndim)
-        global_shape = (x.shape[0] * nproc,) + x.shape[1:]
-        return jax.make_array_from_process_local_data(
-            sharding, x, global_shape
-        )
-
-    return jtu.tree_map(mk, local_batch)
+    return jtu.tree_map(lambda x: make_global_array(x, mesh), local_batch)
 
 
 def process_local_rows(total_rows: int) -> range:
