@@ -42,6 +42,11 @@ class GLMDriverParams:
     tolerance: float = 1e-7
     add_intercept: bool = True
     sparse: bool = False
+    # stream the (dense) dataset to the device one input file at a time
+    # — host decode / host->device transfer / compile overlap, and peak
+    # host memory is one file's chunk instead of the whole dataset
+    # (io.ingest.labeled_batch_streamed; VERDICT r4 #6)
+    streamed_ingest: bool = False
     # with sparse=True: densify the hottest columns into an MXU slab and
     # keep only the power-law tail in the ELL scatter path (ops.sparse
     # HybridFeatures). 0 = off, -1 = auto (count-threshold split), N > 0 =

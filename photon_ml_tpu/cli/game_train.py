@@ -623,7 +623,76 @@ def run_game_training(params) -> GameTrainingRun:
 
     sweep: List[dict] = []
     design_cache: Dict[str, object] = {}
-    for combo_index, combo in enumerate(params.grid()):
+    grid_combos = list(params.grid())
+    # Hyperparameter parallelism (SURVEY §2.5.6): grid entries share
+    # every shape — only reg weights differ — so when warm starts /
+    # per-update validation / checkpointing aren't in play, ALL combos
+    # train simultaneously through one vmapped sweep instead of
+    # sequential runs (``descent.run_grid``).
+    from photon_ml_tpu.ops import sparse as _sparse_ops
+
+    vmappable = (
+        len(grid_combos) > 1
+        and vdata is None
+        and not warm_params
+        and params.checkpoint_every <= 0
+        and multiproc is None
+        # coordinate kinds are statically known from the specs: factored
+        # (latent_dim), projected (projector), and sparse-projected
+        # coordinates don't expose fused_state_for_reg — decide BEFORE
+        # paying a full build that the hasattr check would throw away
+        and all(
+            spec.latent_dim is None
+            and not spec.projector
+            and not (
+                spec.random_effect is not None
+                and _sparse_ops.is_sparse(data.features[spec.shard])
+            )
+            for spec in params.coordinates.values()
+        )
+    )
+    if vmappable:
+        coords = build_coordinates(
+            params, data, task, grid_combos[0], entity_counts,
+            dtype=dtype, shard_vocabs=shard_vocabs,
+            design_cache=design_cache,
+        )
+        vmappable = all(
+            hasattr(c, "fused_state_for_reg") for c in coords.values()
+        )
+        if vmappable:
+            from photon_ml_tpu.game.descent import run_grid
+
+            with timed(
+                logger, f"train grid x{len(grid_combos)} (vmapped)"
+            ):
+                cd = CoordinateDescent(
+                    coordinates=coords,
+                    labels=jnp.asarray(data.labels, dtype),
+                    base_offsets=jnp.asarray(data.offsets, dtype),
+                    weights=jnp.asarray(data.weights, dtype),
+                    task=task,
+                )
+                models, histories = run_grid(
+                    cd, grid_combos, params.num_iterations
+                )
+            for combo, model, hist in zip(grid_combos, models, histories):
+                for h in hist:
+                    logger.info(
+                        f"combo={combo} iter={h.iteration} "
+                        f"coord={h.coordinate} "
+                        f"objective={h.objective:.6g}"
+                    )
+                sweep.append(
+                    {
+                        "combo": combo,
+                        "model": materialize_original_space(model, coords),
+                        "history": hist,
+                        "validation_metric": None,
+                    }
+                )
+    seq_combos = [] if vmappable else grid_combos
+    for combo_index, combo in enumerate(seq_combos):
         with timed(logger, f"train combo {combo}"):
             coords = build_coordinates(
                 params, data, task, combo, entity_counts, dtype=dtype,
@@ -771,10 +840,17 @@ def run_game_training(params) -> GameTrainingRun:
     )
 
     # ---- save models (``Driver.scala:393-441`` output modes) ------------
+    # Multi-process: every process holds the identical fetched model, but
+    # processes typically share one output_dir — concurrent
+    # open-truncate-writes of the same files race, so only process 0
+    # writes (the others return the same in-memory GameTrainingRun).
+    save_process = (not multi) or jax.process_index() == 0
     output_dirs: List[str] = []
     with timed(logger, "save models"):
         to_save: List[int] = []
-        if params.model_output_mode == "BEST":
+        if not save_process:
+            pass  # non-zero process: model already fetched, writes skipped
+        elif params.model_output_mode == "BEST":
             to_save = [best_index]
         elif params.model_output_mode == "ALL":
             to_save = list(range(len(sweep)))
@@ -831,10 +907,13 @@ def run_game_training(params) -> GameTrainingRun:
                     indent=2,
                 )
             output_dirs.append(subdir)
-        for shard, vocab in shard_vocabs.items():
-            vocab.save(
-                os.path.join(params.output_dir, f"feature-index-{shard}.txt")
-            )
+        if save_process:
+            for shard, vocab in shard_vocabs.items():
+                vocab.save(
+                    os.path.join(
+                        params.output_dir, f"feature-index-{shard}.txt"
+                    )
+                )
     logger.close()
 
     return GameTrainingRun(

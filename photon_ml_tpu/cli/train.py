@@ -188,10 +188,20 @@ def run_glm_training(params) -> GLMTrainingRun:
         logger.info(f"feature space: {len(vocab)} columns "
                     f"(intercept={vocab.intercept_index})")
 
-        batch, _uids, _present = source.labeled_batch(
-            vocab, sparse=params.sparse,
-            dtype=driver_dtype(params.precision),
-        )
+        if params.streamed_ingest:
+            if params.sparse:
+                raise ValueError(
+                    "streamed_ingest is dense-only (padded-ELL width is "
+                    "a global property; decode sparse inputs whole)"
+                )
+            batch, _uids, _present = source.labeled_batch_streamed(
+                vocab, dtype=driver_dtype(params.precision)
+            )
+        else:
+            batch, _uids, _present = source.labeled_batch(
+                vocab, sparse=params.sparse,
+                dtype=driver_dtype(params.precision),
+            )
         logger.info(f"read {batch.labels.shape[0]} training records")
         if params.sparse and params.hot_columns:
             batch = _hybridize(batch, params, logger)
@@ -476,6 +486,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--hot-columns", type=int, default=None,
         help="with --sparse: densify the N hottest columns (-1 = auto)",
+    )
+    p.add_argument(
+        "--streamed-ingest", action="store_true", default=None,
+        help="stream the dense dataset to the device per input file "
+        "(decode/transfer overlap; host memory stays one chunk)",
     )
     p.add_argument("--overwrite", action="store_true", default=None)
     p.add_argument("--diagnostics", action="store_true", default=None)

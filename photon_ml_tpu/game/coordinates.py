@@ -281,6 +281,11 @@ class FixedEffectCoordinate:
             )
         self.batch = batch
         self.config = config
+        # the effective reg weight: a Python float normally, or a traced
+        # scalar when a grid sweep threads per-combo weights through the
+        # fused state (``descent.run_grid``). update_step reads THIS, so
+        # one compilation serves every lambda.
+        self._reg_weight = config.reg_weight
         self._update_and_score = (
             _make_fixed_update_and_score_permuted(config)
             if self._row_perm is not None
@@ -360,15 +365,40 @@ class FixedEffectCoordinate:
         closed-over concrete arrays are not hoisted by tracing (they are
         not tracers) and lower to HLO literals — the serialized program
         would carry the whole dataset (observed: remote-compile requests
-        rejected with HTTP 413)."""
-        return (self.batch, self._row_perm, self._inv_perm)
+        rejected with HTTP 413). The reg weight rides along so grid
+        sweeps can vmap a combo axis over it."""
+        return self.fused_state_for_reg(self._reg_weight)
+
+    def fused_state_for_reg(self, reg_weight):
+        """The fused state with a specific reg weight — the grid-sweep
+        axis (``descent.run_grid`` stacks these across combos). The
+        scalar keeps the DEFAULT float width (f64 under x64) so fused
+        modes see the exact same lambda the plain loop casts from the
+        config float — a forced f32 here would silently perturb
+        non-representable lambdas (e.g. 0.1) in float64 runs."""
+        return (
+            self.batch,
+            self._row_perm,
+            self._inv_perm,
+            jnp.asarray(reg_weight, jnp.result_type(float)),
+        )
 
     def with_fused_state(self, state):
         import copy
 
         c = copy.copy(self)
-        c.batch, c._row_perm, c._inv_perm = state
+        c.batch, c._row_perm, c._inv_perm, c._reg_weight = state
         return c
+
+    def reg_term(self, params: jax.Array) -> jax.Array:
+        """Penalty under the EFFECTIVE reg weight (grid sweeps thread it
+        per combo; identical to the config formula otherwise)."""
+        lam = jnp.asarray(self._reg_weight, params.dtype)
+        l2 = lam * (1.0 - self.config.l1_ratio)
+        l1 = lam * self.config.l1_ratio
+        return 0.5 * l2 * jnp.vdot(params, params) + l1 * jnp.sum(
+            jnp.abs(params)
+        )
 
     def update_step(
         self, w: jax.Array, partial_scores: jax.Array, key=None
@@ -392,7 +422,7 @@ class FixedEffectCoordinate:
             if self._ds_budget is not None:
                 result, scores = self._gather_solve(
                     w,
-                    jnp.asarray(self.config.reg_weight, w.dtype),
+                    jnp.asarray(self._reg_weight, w.dtype),
                     self.batch.features,
                     self.batch.labels,
                     self.batch.offsets + partial_scores,
@@ -406,7 +436,7 @@ class FixedEffectCoordinate:
             # inside the dispatch
             result, scores = self._update_and_score(
                 w,
-                jnp.asarray(self.config.reg_weight, w.dtype),
+                jnp.asarray(self._reg_weight, w.dtype),
                 self.batch.features,
                 self.batch.labels,
                 self.batch.offsets,
@@ -419,7 +449,7 @@ class FixedEffectCoordinate:
             return result.w, result, scores
         result, scores = self._update_and_score(
             w,
-            jnp.asarray(self.config.reg_weight, w.dtype),
+            jnp.asarray(self._reg_weight, w.dtype),
             self.batch.features,
             self.batch.labels,
             self.batch.offsets + partial_scores,
@@ -561,6 +591,7 @@ class RandomEffectCoordinate:
         # (E,) per-entity regularization weights
         # (``RandomEffectOptimizationProblem.scala:41-110``: each entity may
         # carry a distinct objective); shared config weight by default
+        self._uniform_reg = reg_weights is None
         if reg_weights is None:
             reg_weights = jnp.full(
                 (design.num_entities,), config.reg_weight, jnp.float32
@@ -646,6 +677,31 @@ class RandomEffectCoordinate:
         """See ``FixedEffectCoordinate.fused_state``."""
         return (
             self.reg_weights,
+            self.full_offsets_base,
+            self._entity_indices,
+            tuple(self.design.buckets),
+            self.row_features,
+            self.row_entities,
+        )
+
+    def fused_state_for_reg(self, reg_weight):
+        """The fused state with every entity's reg weight set to
+        ``reg_weight`` — the grid-sweep axis (``descent.run_grid``).
+        The grid REPLACES the coordinate-level lambda, like the
+        reference's ``GLMOptimizationConfiguration`` grid; a coordinate
+        built with CUSTOM per-entity weights refuses (silently
+        discarding them would break run_grid's sequential-equivalence
+        guarantee)."""
+        if not getattr(self, "_uniform_reg", True):
+            raise ValueError(
+                "grid sweeps replace the coordinate's shared reg weight; "
+                "this RandomEffectCoordinate carries CUSTOM per-entity "
+                "reg_weights — run its combos sequentially instead"
+            )
+        return (
+            jnp.full(
+                (self.design.num_entities,), reg_weight, jnp.float32
+            ),
             self.full_offsets_base,
             self._entity_indices,
             tuple(self.design.buckets),

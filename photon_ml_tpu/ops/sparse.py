@@ -274,6 +274,28 @@ def cast_values(x, dtype):
     return jnp.asarray(x, dtype)
 
 
+def _low_precision_dot(x: jax.Array, w: jax.Array):
+    """x @ w keeping the CONTRACTION in the matrix's low precision with
+    f32 accumulation (``preferred_element_type``) when the design is
+    stored bf16/f16. Without this, jnp's type promotion upcasts the
+    matrix to the vector's f32 — and XLA MATERIALIZES the converted
+    design as a temp, so every pass pays ~3 extra design-sized HBM
+    round trips (measured r5: the dense TRON solve ran 6.0 ms/pass
+    where the roofline pass is ~1.2 ms; benchmarks/dense_roofline_lab).
+    The vector rounds to bf16 — the same precision class the stored
+    design already imposes (docs/PERF.md: coefficients agree with the
+    all-f32 solve to ~2e-4). Full-precision designs are untouched."""
+    low_x = x.dtype in (jnp.bfloat16, jnp.float16)
+    low_w = w.dtype in (jnp.bfloat16, jnp.float16)
+    if low_x != low_w:  # mixed precision: round the f32 side DOWN
+        if low_x:
+            w = w.astype(x.dtype)
+        else:
+            x = x.astype(w.dtype)
+        return jnp.matmul(x, w, preferred_element_type=jnp.float32)
+    return x @ w
+
+
 def matvec(x, w: jax.Array) -> jax.Array:
     """margins contraction: (n, d) @ (d,) -> (n,). Hybrid output is in
     STORED (permuted) row order, matching the permuted batch."""
@@ -290,9 +312,9 @@ def matvec(x, w: jax.Array) -> jax.Array:
         cold = jnp.concatenate(
             [matvec(seg, w) for seg in x.cold_segments]
         )
-        return x.dense @ w[x.hot_ids] + cold
+        return _low_precision_dot(x.dense, w[x.hot_ids]) + cold
     if not is_sparse(x):
-        return x @ w
+        return _low_precision_dot(x, w)
     gathered = w.at[x.indices].get(mode="fill", fill_value=0.0)
     return jnp.sum(x.values * gathered, axis=-1)
 
@@ -313,9 +335,9 @@ def rmatvec(x, a: jax.Array) -> jax.Array:
         g = jnp.zeros((x.d,), a.dtype)
         for (lo, hi), seg in zip(x.segment_bounds(), x.cold_segments):
             g = g + rmatvec(seg, a[lo:hi])
-        return g.at[x.hot_ids].add(a @ x.dense)
+        return g.at[x.hot_ids].add(_low_precision_dot(a, x.dense))
     if not is_sparse(x):
-        return x.T @ a
+        return _low_precision_dot(x.T, a)
     upd = (x.values * a[..., None]).reshape(-1)
     return (
         jnp.zeros((x.d,), upd.dtype)

@@ -219,13 +219,12 @@ def global_entity_space(local_num_entities: int):
 _REPLICATE_JIT_CACHE: dict = {}
 
 
-def fetch_replicated(x):
-    """Materialize ANY jax.Array on host — including global arrays with
-    non-addressable shards (multi-process): those are resharded to
-    replicated (one all-gather) and then fetched. Fully-addressable
-    arrays (and non-arrays) pass straight to the caller's np.asarray."""
-    import numpy as np
-
+def reshard_replicated(x):
+    """Non-fully-addressable global jax.Array -> the same value resharded
+    REPLICATED (one all-gather, still on device, now fully addressable —
+    so a later batched ``jax.device_get`` can fetch it with everything
+    else in one transfer). Addressable arrays and non-arrays pass
+    through unchanged."""
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -237,8 +236,23 @@ def fetch_replicated(x):
                 out_shardings=NamedSharding(mesh, PartitionSpec()),
             )
             _REPLICATE_JIT_CACHE[mesh] = fn
-        return np.asarray(fn(x))
+        return fn(x)
     return x
+
+
+def fetch_replicated(x):
+    """Materialize ANY value on host as numpy — jax.Arrays (including
+    global arrays with non-addressable shards, which reshard to
+    replicated first) transfer synchronously; non-arrays pass through.
+    For BATCHED drains prefer ``reshard_replicated`` + one
+    ``jax.device_get`` over per-leaf calls here (each np.asarray is a
+    synchronous transfer)."""
+    import numpy as np
+
+    out = reshard_replicated(x)
+    if isinstance(out, jax.Array):
+        return np.asarray(out)
+    return out
 
 
 def make_global_re_design(

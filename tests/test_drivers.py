@@ -360,6 +360,30 @@ class TestGameDriver:
             )
             assert np.abs(srun.scores).max() > 0.0
 
+    def test_grid_sweep_vmapped_no_validation(self, rng, game_fixture):
+        """Without validation/warm-start/checkpointing the driver trains
+        the whole reg-weight grid as ONE vmapped sweep (SURVEY §2.5.6);
+        every entry must equal its sequential single-combo run."""
+        train, valid, gs, us, tmp = game_fixture
+        params = game_params(
+            train, None, gs, us, str(tmp / "goutv"),
+            model_output_mode="ALL",
+        )
+        params["coordinates"]["per-user"]["reg_weights"] = [100.0, 1.0]
+        run = run_game_training(params)
+        assert len(run.sweep) == 2
+        for i, lam in enumerate([100.0, 1.0]):
+            p2 = game_params(train, None, gs, us, str(tmp / f"gouts{i}"))
+            p2["coordinates"]["per-user"]["reg_weights"] = [lam]
+            r2 = run_game_training(p2)
+            for k in r2.sweep[0]["model"].params:
+                np.testing.assert_allclose(
+                    np.asarray(run.sweep[i]["model"].params[k]),
+                    np.asarray(r2.sweep[0]["model"].params[k]),
+                    atol=1e-8,
+                    err_msg=f"combo {lam} coord {k}",
+                )
+
     def test_game_scoring_round_trip(self, rng, game_fixture):
         train, valid, gs, us, tmp = game_fixture
         run = run_game_training(

@@ -117,6 +117,42 @@ class TestCoordinateDescent:
                 )
                 assert rf.convergence_histogram == ru.convergence_histogram
 
+    def test_grid_vmap_equals_sequential(self, rng):
+        """run_grid trains every reg-weight combo in one vmapped sweep;
+        each lane must equal the sequential run with that combo's
+        weights (same PRNG stream, same objectives, same params)."""
+        from photon_ml_tpu.game.descent import run_grid
+
+        data, user, n_users = make_mixed_effects_data(rng)
+        combos = [
+            {"fixed": 0.5, "per-user": 2.0},
+            {"fixed": 1.0, "per-user": 1.0},
+            {"fixed": 2.0, "per-user": 0.5},
+        ]
+        cd = build_game(data, n_users)
+        models, history = run_grid(cd, combos, num_iterations=2, seed=3)
+        assert len(models) == len(history) == 3
+        for combo, model, hist in zip(combos, models, history):
+            cd_seq = build_game(
+                data, n_users,
+                fe_reg=combo["fixed"], re_reg=combo["per-user"],
+            )
+            cd_seq.fuse_passes = "coordinate"
+            m_seq, h_seq = cd_seq.run(num_iterations=2, seed=3)
+            for k in m_seq.params:
+                np.testing.assert_allclose(
+                    np.asarray(model.params[k]),
+                    np.asarray(m_seq.params[k]),
+                    atol=1e-10,
+                    err_msg=f"combo={combo} coord={k}",
+                )
+            for rg, rs in zip(hist, h_seq):
+                assert rg.coordinate == rs.coordinate
+                np.testing.assert_allclose(
+                    rg.objective, rs.objective, rtol=1e-10
+                )
+                assert rg.convergence_histogram == rs.convergence_histogram
+
     def test_custom_coordinate_without_fused_surface_uses_plain_loop(
         self, rng
     ):

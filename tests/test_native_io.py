@@ -114,6 +114,60 @@ class TestLabeledBatch:
         assert list(nat[1]) == list(ref[1])  # uids incl. None
         np.testing.assert_array_equal(nat[2], ref[2])
 
+    def test_streamed_matches_whole_read(self, tmp_path):
+        """labeled_batch_streamed (per-file decode + async device
+        transfers, VERDICT r4 #6) must assemble the identical batch the
+        whole-dataset path builds, across multiple part files with
+        different row counts."""
+        paths = []
+        for i, n in enumerate([150, 90, 200]):
+            recs = _records(n, seed=10 + i)
+            p = str(tmp_path / f"part-{i}.avro")
+            write_avro_file(p, TRAINING_EXAMPLE_SCHEMA, recs, codec="deflate")
+            paths.append(p)
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        whole = IngestSource(paths).labeled_batch(vocab)
+        streamed = IngestSource(paths).labeled_batch_streamed(vocab)
+        np.testing.assert_allclose(
+            np.asarray(streamed[0].features),
+            np.asarray(whole[0].features),
+            rtol=1e-6,
+        )
+        for field in ("labels", "offsets", "weights", "mask"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(streamed[0], field)),
+                np.asarray(getattr(whole[0], field)),
+            )
+        assert list(streamed[1]) == list(whole[1])
+        np.testing.assert_array_equal(streamed[2], whole[2])
+
+        # the streamed batch trains like any other
+        from photon_ml_tpu.models import (
+            GLMTrainingConfig,
+            OptimizerType,
+            TaskType,
+            train_glm,
+        )
+        from photon_ml_tpu.ops.objective import RegularizationContext
+
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=15,
+            track_states=False,
+        )
+        (a,) = train_glm(streamed[0], cfg)
+        (b,) = train_glm(whole[0], cfg)
+        np.testing.assert_allclose(
+            np.asarray(a.model.coefficients.means),
+            np.asarray(b.model.coefficients.means),
+            atol=1e-10,
+        )
+
     def test_tiny_vocab(self, tmp_path):
         """Vocabulary blobs short enough for std::string SSO — regression
         for the in-place Vocab construction (a moved SSO string dangles

@@ -1147,31 +1147,34 @@ class TestTwoProcessGameDriver:
         oracle = run_game_training(config(str(tmp_path / "oracle")))
         o_model = oracle.sweep[0]["model"]
 
-        # load BOTH children's saved models through the ORACLE's vocabs
-        # so entity-table rows align by RAW id regardless of per-process
-        # vocab order
+        # load process 0's saved model through the ORACLE's vocabs so
+        # entity-table rows align by RAW id regardless of per-process
+        # vocab order (non-zero processes skip writes — shared output
+        # dirs would race)
+        import os as _os2
+
         from photon_ml_tpu.io.models import load_game_model
 
+        assert not _os2.path.isdir(str(tmp_path / "out1" / "best"))
         coord_vocabs = {
             "global": oracle.shard_vocabs["gshard"],
             "per-user": oracle.shard_vocabs["ushard"],
         }
-        for pid in range(2):
-            loaded, _, _, _ = load_game_model(
-                str(tmp_path / f"out{pid}" / "best"),
-                coord_vocabs,
-                {"per-user": oracle.entity_vocabs["userId"]},
-            )
-            np.testing.assert_allclose(
-                np.asarray(loaded["global"]),
-                np.asarray(o_model.params["global"]),
-                atol=1e-6,
-            )
-            np.testing.assert_allclose(
-                np.asarray(loaded["per-user"]),
-                np.asarray(o_model.params["per-user"]),
-                atol=1e-6,
-            )
+        loaded, _, _, _ = load_game_model(
+            str(tmp_path / "out0" / "best"),
+            coord_vocabs,
+            {"per-user": oracle.entity_vocabs["userId"]},
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded["global"]),
+            np.asarray(o_model.params["global"]),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded["per-user"]),
+            np.asarray(o_model.params["per-user"]),
+            atol=1e-6,
+        )
 
 
 class TestMultihost:
