@@ -153,6 +153,38 @@ class TestCoordinateDescent:
                 )
                 assert rg.convergence_histogram == rs.convergence_histogram
 
+    def test_grid_refuses_custom_per_entity_reg_weights(self, rng):
+        """A coordinate built with CUSTOM per-entity reg weights must
+        refuse the grid sweep (silently replacing them with the combo's
+        uniform weight would break sequential equivalence)."""
+        from photon_ml_tpu.game.descent import run_grid
+
+        data, user, n_users = make_mixed_effects_data(rng)
+        cd = build_game(data, n_users)
+        re = cd.coordinates["per-user"]
+        custom = RandomEffectCoordinate(
+            design=re.design,
+            row_features=re.row_features,
+            row_entities=re.row_entities,
+            full_offsets_base=re.full_offsets_base,
+            config=re.config,
+            reg_weights=np.linspace(0.5, 2.0, n_users),
+        )
+        cd.coordinates["per-user"] = custom
+        with pytest.raises(ValueError, match="CUSTOM per-entity"):
+            run_grid(
+                cd,
+                [{"fixed": 1.0, "per-user": 1.0},
+                 {"fixed": 2.0, "per-user": 2.0}],
+                num_iterations=1,
+            )
+        with pytest.raises(ValueError, match=">= 2 combos"):
+            run_grid(
+                build_game(data, n_users),
+                [{"fixed": 1.0, "per-user": 1.0}],
+                num_iterations=1,
+            )
+
     def test_custom_coordinate_without_fused_surface_uses_plain_loop(
         self, rng
     ):
