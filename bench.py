@@ -1318,7 +1318,9 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
         if args.sparse_scaling:  # the curve needs the 8-device mesh
-            jax.config.update("jax_num_cpu_devices", 8)
+            from photon_ml_tpu.utils.compat import force_cpu_devices
+
+            force_cpu_devices(8)
     # persistent XLA compilation cache: re-runs load compiled programs
     # from disk instead of re-JITting (VERDICT r3 #7); warmup lines below
     # report the cold-vs-warm difference

@@ -236,6 +236,14 @@ class GameDriverParams:
     # (0 = disabled); resume=True continues a previous run in-place
     checkpoint_every: int = 0
     resume: bool = False
+    # roll back + damped-retry non-finite coordinate updates, freezing a
+    # coordinate that keeps failing so the rest of the model trains on
+    # (docs/ROBUSTNESS.md). Forces the per-update dispatch loop.
+    divergence_guard: bool = False
+    # install SIGTERM/SIGINT handlers that finish the current pass, write
+    # a final checkpoint + resumable marker, and exit cleanly — the TPU
+    # preemption contract (docs/ROBUSTNESS.md)
+    graceful_shutdown: bool = True
     # warm-start: root of a previously saved GAME model (best/ or all/<i>)
     initial_model_dir: Optional[str] = None
     # merge coordinates sharing (effect type, shard) by coefficient

@@ -401,7 +401,9 @@ class GameTrainingRun:
 
 
 def run_game_training(params) -> GameTrainingRun:
-    from photon_ml_tpu.cli.train import driver_dtype
+    """Entry point: config load, log file, fault-drill arming, and the
+    preemption handler lifecycle around the actual training body."""
+    from photon_ml_tpu.resilience import GracefulShutdown, arm_from_env
 
     params = load_params(params, GameDriverParams)
     params.validate()
@@ -410,6 +412,27 @@ def run_game_training(params) -> GameTrainingRun:
         os.path.join(params.output_dir, "log-message.txt"),
         level=params.log_level,
     )
+    armed = arm_from_env()
+    if armed:
+        logger.warn(
+            f"{armed} fault-injection spec(s) armed from PHOTON_FAULTS — "
+            "this is a resilience drill, not a production run"
+        )
+    shutdown = GracefulShutdown(logger)
+    if params.graceful_shutdown:
+        shutdown.install()
+    try:
+        return _run_game_training(params, logger, shutdown)
+    finally:
+        shutdown.uninstall()
+        logger.close()
+
+
+def _run_game_training(
+    params: GameDriverParams, logger: PhotonLogger, shutdown
+) -> GameTrainingRun:
+    from photon_ml_tpu.cli.train import driver_dtype
+
     task = TaskType[params.task]
     dtype = driver_dtype(params.precision)
     logger.info(
@@ -637,6 +660,8 @@ def run_game_training(params) -> GameTrainingRun:
         and not warm_params
         and params.checkpoint_every <= 0
         and multiproc is None
+        # the guard needs per-update host objectives; lanes can't branch
+        and not params.divergence_guard
         # coordinate kinds are statically known from the specs: factored
         # (latent_dim), projected (projector), and sparse-projected
         # coordinates don't expose fused_state_for_reg — decide BEFORE
@@ -779,7 +804,20 @@ def run_game_training(params) -> GameTrainingRun:
                 checkpoint_dir=ckpt_dir,
                 checkpoint_every=max(params.checkpoint_every, 1),
                 resume=params.resume,
+                divergence_guard=params.divergence_guard,
+                # polled at pass boundaries: SIGTERM/SIGINT finishes the
+                # pass, checkpoints, and falls through to the break below
+                stop_check=shutdown,
             )
+            frozen_events = [
+                h for h in history if getattr(h, "event", None) == "frozen"
+            ]
+            for h in frozen_events:
+                logger.warn(
+                    f"combo={combo} iter={h.iteration} coordinate "
+                    f"{h.coordinate!r} FROZEN by the divergence guard; "
+                    "remaining coordinates kept training"
+                )
             for h in history:
                 logger.info(
                     f"combo={combo} iter={h.iteration} coord={h.coordinate} "
@@ -824,6 +862,13 @@ def run_game_training(params) -> GameTrainingRun:
                     "validation_metric": final_metric,
                 }
             )
+            if shutdown.requested:
+                logger.warn(
+                    f"preempted during combo {combo}: final checkpoint + "
+                    f"resumable marker written under {ckpt_dir}; re-run "
+                    "with resume=true to continue"
+                )
+                break
 
     # best = highest validation metric (metrics are oriented so higher is
     # better); without validation data the last combo wins, like the
@@ -844,7 +889,11 @@ def run_game_training(params) -> GameTrainingRun:
     # processes typically share one output_dir — concurrent
     # open-truncate-writes of the same files race, so only process 0
     # writes (the others return the same in-memory GameTrainingRun).
-    save_process = (not multi) or jax.process_index() == 0
+    # A preempted run saves nothing: its durable artifact is the
+    # checkpoint + marker, and the resumed run does the saving.
+    save_process = (
+        (not multi) or jax.process_index() == 0
+    ) and not shutdown.requested
     output_dirs: List[str] = []
     with timed(logger, "save models"):
         to_save: List[int] = []
@@ -914,7 +963,6 @@ def run_game_training(params) -> GameTrainingRun:
                         params.output_dir, f"feature-index-{shard}.txt"
                     )
                 )
-    logger.close()
 
     return GameTrainingRun(
         params=params,

@@ -56,6 +56,11 @@ class CoordinateUpdateRecord:
     # validation metric after this update, when a validation_fn is supplied
     # (``CoordinateDescent.scala:173-189``)
     validation_metric: Optional[float] = None
+    # divergence-guard annotation: None for a normal update, "recovered"
+    # when a non-finite update was rolled back and the damped retry
+    # succeeded, "frozen" when the retry also failed and the coordinate
+    # was excluded from further training (docs/ROBUSTNESS.md)
+    event: Optional[str] = None
 
 
 def _coordinate_reg_term(coord, params) -> jax.Array:
@@ -87,6 +92,7 @@ def _history_record(
     iterations,
     seconds,
     validation_metric=None,
+    event=None,
 ) -> CoordinateUpdateRecord:
     """THE record builder both the sequential drain and the grid sweep
     use — one place for the reason histogram / solver-iteration
@@ -99,6 +105,7 @@ def _history_record(
         objective=float(objective),
         seconds=seconds,
         validation_metric=validation_metric,
+        event=event,
         solver_iterations=(
             float(np.mean(iters_arr)) if iters_arr.size else 0.0
         ),
@@ -330,6 +337,8 @@ class CoordinateDescent:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1,
         resume: bool = True,
+        divergence_guard: bool = False,
+        stop_check=None,
     ):
         """Returns (model, history). Objective is logged after every
         coordinate update like ``CoordinateDescent.scala:160-170``;
@@ -342,7 +351,26 @@ class CoordinateDescent:
         run restarted over the same directory continues from the latest
         completed pass with an identical PRNG stream, reproducing the
         uninterrupted run exactly (SURVEY §5.4; the reference has no
-        analog, it leans on Spark lineage)."""
+        analog, it leans on Spark lineage).
+
+        ``divergence_guard`` (docs/ROBUSTNESS.md): after each coordinate
+        update, check the training objective for non-finites; on failure
+        roll the coordinate back to its pre-update state and retry once
+        against a DAMPED residual (half the partial score), and if the
+        retry also fails, FREEZE the coordinate — its params stay at the
+        last finite state and it is skipped for the rest of the run (and
+        of any resumed run: the frozen set rides in the checkpoint) while
+        the remaining coordinates keep training. Guarded runs use the
+        per-update dispatch loop (the check needs the objective on the
+        host after every update), so the fused whole-pass dispatch is
+        bypassed — enable it for resilience, not throughput.
+
+        ``stop_check`` (preemption): a zero-arg callable polled at PASS
+        boundaries (e.g. :class:`photon_ml_tpu.resilience.GracefulShutdown`
+        wired to SIGTERM). When it turns true the loop writes a final
+        checkpoint plus a ``preempted.json`` marker (with checkpoint_dir)
+        and returns early; restarting with ``resume=True`` continues
+        bit-for-bit, reproducing the uninterrupted run."""
         names = list(self.coordinates)
         model = (
             initial_model.copy()
@@ -380,6 +408,7 @@ class CoordinateDescent:
             )
             key = _globalize(key)
         start_it = 0
+        frozen: set = set()  # divergence-guard casualties (skip updates)
         if checkpoint_dir is not None and resume:
             from photon_ml_tpu.io.checkpoint import latest_checkpoint
 
@@ -409,6 +438,7 @@ class CoordinateDescent:
                 history = [
                     CoordinateUpdateRecord(**h) for h in ckpt.history
                 ]
+                frozen = set(ckpt.frozen) & set(names)
 
         scores = {
             n: self.coordinates[n].score(model.params[n]) for n in names
@@ -486,6 +516,7 @@ class CoordinateDescent:
                         iterations,
                         p["seconds"],
                         p["validation_metric"],
+                        p.get("event"),
                     )
                 )
             pending.clear()
@@ -501,10 +532,37 @@ class CoordinateDescent:
             for c in self.coordinates.values()
         )
         mode = _normalize_fuse_passes(self.fuse_passes)
+        # the guard needs every update's objective ON THE HOST before the
+        # next update commits — incompatible with the fused whole-pass
+        # dispatch (and with deferring the check), so guarded runs take
+        # the plain per-update loop
         use_fused = (
             mode is True and validation_fn is None and has_surface
+            and not divergence_guard
+            # a resumed frozen set can't be excluded inside the one-dispatch
+            # pass program; take a per-update loop that can skip
+            and not frozen
         )
-        use_chunked = mode == "coordinate" and has_surface
+        use_chunked = (
+            mode == "coordinate" and has_surface and not divergence_guard
+        )
+        from photon_ml_tpu.resilience import faults as _faults
+
+        def _save_ckpt(step):
+            from photon_ml_tpu.io.checkpoint import save_checkpoint
+
+            materialize()
+            save_checkpoint(
+                checkpoint_dir,
+                step,
+                # save_checkpoint handles plain tables AND FactoredParams
+                dict(model.params),
+                np.asarray(key),
+                [dataclasses.asdict(h) for h in history],
+                frozen=sorted(frozen),
+            )
+
+        stopped = False
         for it in range(start_it, num_iterations):
             if use_fused:
                 t0 = time.perf_counter()
@@ -535,6 +593,8 @@ class CoordinateDescent:
             elif use_chunked:
                 fns, states = self._coordinate_step_fns()
                 for name in names:
+                    if name in frozen:
+                        continue
                     t0 = time.perf_counter()
                     key, sub = jax.random.split(key)
                     p, tr, s, obj = fns[name](
@@ -568,20 +628,73 @@ class CoordinateDescent:
                     )
             else:
                 for name in names:
+                    if name in frozen:
+                        continue
                     t0 = time.perf_counter()
                     coord = self.coordinates[name]
                     total = sum(scores.values())
                     partial = total - scores[name]
+
+                    def _attempt(prev_p, residual, sub):
+                        if hasattr(coord, "update_and_score"):
+                            p, r, s = coord.update_and_score(
+                                prev_p, residual, sub
+                            )
+                        else:
+                            p, r = coord.update(prev_p, residual, sub)
+                            s = coord.score(p)
+                        # fault site: corrupt-mode poisons the accepted
+                        # update with non-finites — the drill for the
+                        # divergence guard (and, unguarded, for the
+                        # one-NaN-poisons-the-run failure mode)
+                        if _faults.fire("descent.update", key=name).corrupt:
+                            p = jax.tree_util.tree_map(
+                                lambda a: jnp.full_like(a, jnp.nan), p
+                            )
+                            s = jnp.full_like(s, jnp.nan)
+                        return p, r, s
+
                     key, sub = jax.random.split(key)
-                    if hasattr(coord, "update_and_score"):
-                        params, result, new_scores = coord.update_and_score(
-                            model.params[name], partial, sub
+                    params, result, new_scores = _attempt(
+                        model.params[name], partial, sub
+                    )
+                    event = None
+                    if divergence_guard:
+                        cand_scores = {**scores, name: new_scores}
+                        cand_params = {**model.params, name: params}
+                        obj_host = float(
+                            self._full_objective(cand_scores, cand_params)
                         )
-                    else:
-                        params, result = coord.update(
-                            model.params[name], partial, sub
-                        )
-                        new_scores = coord.score(params)
+                        if not np.isfinite(obj_host):
+                            # rollback to the pre-update state and retry
+                            # once against a DAMPED residual (half the
+                            # partial score): overshoot-driven overflow
+                            # gets a gentler target, injected faults get a
+                            # second probe
+                            key, sub = jax.random.split(key)
+                            params, result, new_scores = _attempt(
+                                model.params[name], partial * 0.5, sub
+                            )
+                            cand_scores = {**scores, name: new_scores}
+                            cand_params = {**model.params, name: params}
+                            obj_host = float(
+                                self._full_objective(
+                                    cand_scores, cand_params
+                                )
+                            )
+                            if np.isfinite(obj_host):
+                                event = "recovered"
+                            else:
+                                # graceful degradation: keep the last
+                                # finite state, exclude the coordinate
+                                # from further passes, keep training the
+                                # rest (the record's objective is the
+                                # retained finite state; event="frozen"
+                                # marks the failure)
+                                frozen.add(name)
+                                event = "frozen"
+                                params = model.params[name]
+                                new_scores = scores[name]
                     model.params[name] = params
                     scores[name] = new_scores
 
@@ -601,6 +714,7 @@ class CoordinateDescent:
                             "objective": obj,
                             "seconds": seconds,
                             "validation_metric": vmetric,
+                            "event": event,
                             # the result object is kept whole: reading
                             # .reason/.iterations on a
                             # RandomEffectUpdateSummary materializes device
@@ -609,22 +723,39 @@ class CoordinateDescent:
                             "result": result,
                         }
                     )
+            saved = False
             if (
                 checkpoint_dir is not None
                 and (it + 1 - start_it) % checkpoint_every == 0
             ):
-                from photon_ml_tpu.io.checkpoint import save_checkpoint
+                _save_ckpt(it + 1)
+                saved = True
+            # preemption poll at the pass boundary — the only point where
+            # the training state is a complete, checkpointable snapshot
+            if stop_check is not None and stop_check():
+                stopped = True
+                if checkpoint_dir is not None:
+                    if not saved:
+                        _save_ckpt(it + 1)
+                    from photon_ml_tpu.resilience.shutdown import (
+                        write_preempted_marker,
+                    )
 
-                materialize()
-                save_checkpoint(
-                    checkpoint_dir,
-                    it + 1,
-                    # save_checkpoint handles plain tables AND FactoredParams
-                    dict(model.params),
-                    np.asarray(key),
-                    [dataclasses.asdict(h) for h in history],
-                )
+                    write_preempted_marker(
+                        checkpoint_dir,
+                        it + 1,
+                        getattr(stop_check, "signum", None),
+                    )
+                break
         materialize()
+        if checkpoint_dir is not None and not stopped:
+            # the run reached its target: a stale marker from an earlier
+            # preempted attempt no longer applies
+            from photon_ml_tpu.resilience.shutdown import (
+                clear_preempted_marker,
+            )
+
+            clear_preempted_marker(checkpoint_dir)
         return model, history
 
     def total_scores(self, model: GameModel) -> jax.Array:

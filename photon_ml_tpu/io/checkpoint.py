@@ -12,21 +12,44 @@ Layout: ``<dir>/step-<k>/`` holding ``arrays.npz`` (plain parameter tables
 keyed ``param/<coordinate>``; factored coordinates store two leaves,
 ``param/<coordinate>#gamma`` and ``param/<coordinate>#projection``, with
 the kind recorded in the manifest) + ``manifest.json`` (counters, RNG key,
-history). The write is atomic (temp dir + rename) so a crash
-mid-checkpoint leaves the previous step intact.
+history, frozen-coordinate list, and a sha256 digest per data file).
+
+Failure model (docs/ROBUSTNESS.md):
+
+- The write is ATOMIC: temp dir + rename. A crash mid-write leaves a
+  ``*.tmp`` leftover (pruned on the next save) and the previous steps
+  intact. The swap renames any existing same-step dir ASIDE first and
+  deletes it only after the new dir is in place — there is no window
+  where the step exists in neither location (the old
+  rmtree-then-rename ordering lost the step if the process died
+  between the two).
+- The write RETRIES transient ``OSError`` with exponential backoff
+  (:mod:`photon_ml_tpu.resilience.retry`).
+- Loads VERIFY the manifest's sha256 digests, and
+  :func:`latest_checkpoint` falls back to the newest step that loads
+  clean — a truncated manifest, missing ``arrays.npz``, or torn write
+  (digest mismatch) skips that step instead of crashing the resume.
+- Fault-injection sites ``checkpoint.save`` (between temp write and
+  swap) and ``checkpoint.load`` (per step-load attempt) make all of the
+  above drillable (:mod:`photon_ml_tpu.resilience.faults`).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import shutil
-from typing import Dict, List, Optional, Tuple
+import zipfile
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from photon_ml_tpu.resilience import faults, retry
+
 _STEP_PREFIX = "step-"
+_DATA_FILES = ("arrays.npz",)
 
 
 @dataclasses.dataclass
@@ -36,6 +59,32 @@ class TrainingCheckpoint:
     params: Dict[str, object]
     rng_key: np.ndarray
     history: List[dict]
+    # coordinates frozen by the divergence guard (game.descent): excluded
+    # from further updates when the run resumes
+    frozen: List[str] = dataclasses.field(default_factory=list)
+
+
+class CheckpointCorrupted(Exception):
+    """A step directory failed integrity verification."""
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _prune_leftovers(directory: str) -> None:
+    """Remove ``*.tmp`` / ``*.old`` debris from prior crashes. A ``.tmp``
+    is an unfinished write (never valid); a ``.old`` is a superseded step
+    whose replacement already swapped in (delete was interrupted)."""
+    for name in os.listdir(directory):
+        if name.startswith(_STEP_PREFIX) and (
+            name.endswith(".tmp") or name.endswith(".old")
+        ):
+            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
 
 
 def save_checkpoint(
@@ -45,8 +94,15 @@ def save_checkpoint(
     rng_key,
     history: Optional[List[dict]] = None,
     keep: int = 2,
+    frozen: Optional[List[str]] = None,
+    retries: int = 4,
+    logger=None,
 ) -> str:
-    """Atomically write ``<directory>/step-<step>`` and prune old steps."""
+    """Atomically write ``<directory>/step-<step>`` and prune old steps.
+
+    Transient ``OSError`` during the write (including injected faults at
+    site ``checkpoint.save``) is retried with backoff; each attempt
+    restarts from a clean temp dir."""
     from photon_ml_tpu.game.factored import is_factored_params
 
     for name in params:
@@ -59,11 +115,11 @@ def save_checkpoint(
                 "checkpoint leaf encoding)"
             )
     os.makedirs(directory, exist_ok=True)
+    _prune_leftovers(directory)
     final = os.path.join(directory, f"{_STEP_PREFIX}{step}")
     tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    old = final + ".old"
+
     arrays: Dict[str, np.ndarray] = {}
     param_kinds: Dict[str, str] = {}
     for name, p in params.items():
@@ -75,23 +131,49 @@ def save_checkpoint(
         else:
             param_kinds[name] = "array"
             arrays[f"param/{name}"] = np.asarray(p)
-    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
-    manifest = {
-        "step": step,
-        "rng_key": np.asarray(rng_key).tolist(),
-        "param_names": sorted(params),
-        "param_kinds": param_kinds,
-        "history": history or [],
-    }
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
-    if os.path.exists(final):
-        shutil.rmtree(final)
-    os.rename(tmp, final)
+
+    def _write() -> None:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+        manifest = {
+            "step": step,
+            "rng_key": np.asarray(rng_key).tolist(),
+            "param_names": sorted(params),
+            "param_kinds": param_kinds,
+            "history": history or [],
+            "frozen": sorted(frozen or []),
+            "digests": {
+                f: _sha256(os.path.join(tmp, f)) for f in _DATA_FILES
+            },
+        }
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        # fault site: the classic torn-checkpoint window — the temp dir is
+        # fully written but the swap has not happened. raise-mode kills the
+        # write here; corrupt-mode tears arrays.npz AFTER its digest was
+        # recorded, so the load-side verification must catch it.
+        if faults.fire("checkpoint.save").corrupt:
+            faults.corrupt_file(os.path.join(tmp, "arrays.npz"))
+        # swap: old step aside -> new step in -> delete old. Unlike
+        # rmtree(final); rename(tmp, final), every instant of this
+        # sequence keeps at least one complete copy of the step on disk.
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        if os.path.exists(final):
+            os.rename(final, old)
+        os.rename(tmp, final)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+
+    retry.retry_call(
+        _write, retries=retries, logger=logger, label=f"checkpoint step {step}"
+    )
     # prune all but the newest `keep` steps
     steps = sorted(_list_steps(directory))
-    for old in steps[:-keep]:
-        shutil.rmtree(os.path.join(directory, f"{_STEP_PREFIX}{old}"))
+    for old_step in steps[:-keep]:
+        shutil.rmtree(os.path.join(directory, f"{_STEP_PREFIX}{old_step}"))
     return final
 
 
@@ -100,7 +182,11 @@ def _list_steps(directory: str) -> List[int]:
         return []
     out = []
     for name in os.listdir(directory):
-        if name.startswith(_STEP_PREFIX) and not name.endswith(".tmp"):
+        if (
+            name.startswith(_STEP_PREFIX)
+            and not name.endswith(".tmp")
+            and not name.endswith(".old")
+        ):
             try:
                 out.append(int(name[len(_STEP_PREFIX):]))
             except ValueError:
@@ -108,31 +194,80 @@ def _list_steps(directory: str) -> List[int]:
     return out
 
 
-def latest_checkpoint(directory: str) -> Optional[TrainingCheckpoint]:
-    """Load the newest complete checkpoint, or None."""
-    steps = _list_steps(directory)
-    if not steps:
-        return None
-    step = max(steps)
+def _load_step(directory: str, step: int) -> TrainingCheckpoint:
+    """Load one step directory, verifying integrity. Raises
+    :class:`CheckpointCorrupted` on any defect (truncated/unparseable
+    manifest, missing data file, digest mismatch, missing npz key)."""
     d = os.path.join(directory, f"{_STEP_PREFIX}{step}")
-    with open(os.path.join(d, "manifest.json")) as f:
-        manifest = json.load(f)
-    arrays = np.load(os.path.join(d, "arrays.npz"))
+    faults.fire("checkpoint.load")
+    try:
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorrupted(f"{d}: unreadable manifest ({e})") from e
+    digests = manifest.get("digests")
+    if digests is not None:  # pre-digest checkpoints stay loadable
+        for fname, want in digests.items():
+            path = os.path.join(d, fname)
+            if not os.path.exists(path):
+                raise CheckpointCorrupted(f"{d}: missing {fname}")
+            got = _sha256(path)
+            if got != want:
+                raise CheckpointCorrupted(
+                    f"{d}: {fname} digest mismatch "
+                    f"(manifest {want[:12]}…, file {got[:12]}…)"
+                )
+    try:
+        arrays = np.load(os.path.join(d, "arrays.npz"))
+    except (OSError, ValueError, zipfile.BadZipFile) as e:
+        raise CheckpointCorrupted(f"{d}: unreadable arrays.npz ({e})") from e
     kinds = manifest.get("param_kinds", {})
     params = {}
-    for name in manifest["param_names"]:
-        if kinds.get(name, "array") == "factored":
-            from photon_ml_tpu.game.factored import FactoredParams
+    try:
+        for name in manifest["param_names"]:
+            if kinds.get(name, "array") == "factored":
+                from photon_ml_tpu.game.factored import FactoredParams
 
-            params[name] = FactoredParams(
-                gamma=arrays[f"param/{name}#gamma"],
-                projection=arrays[f"param/{name}#projection"],
-            )
-        else:
-            params[name] = arrays[f"param/{name}"]
-    return TrainingCheckpoint(
-        step=manifest["step"],
-        params=params,
-        rng_key=np.asarray(manifest["rng_key"], np.uint32),
-        history=manifest["history"],
-    )
+                params[name] = FactoredParams(
+                    gamma=arrays[f"param/{name}#gamma"],
+                    projection=arrays[f"param/{name}#projection"],
+                )
+            else:
+                params[name] = arrays[f"param/{name}"]
+        return TrainingCheckpoint(
+            step=manifest["step"],
+            params=params,
+            rng_key=np.asarray(manifest["rng_key"], np.uint32),
+            history=manifest["history"],
+            frozen=list(manifest.get("frozen", [])),
+        )
+    except (KeyError, zipfile.BadZipFile) as e:
+        raise CheckpointCorrupted(f"{d}: manifest/arrays mismatch ({e})") from e
+
+
+def verify_checkpoint(directory: str, step: int) -> TrainingCheckpoint:
+    """Integrity-check one step (operator tooling); raises
+    :class:`CheckpointCorrupted` on failure."""
+    return _load_step(directory, step)
+
+
+def latest_checkpoint(
+    directory: str, logger=None
+) -> Optional[TrainingCheckpoint]:
+    """Load the newest VALID checkpoint, or None.
+
+    Steps that fail to load clean — truncated manifest, missing or torn
+    ``arrays.npz``, digest mismatch, injected ``checkpoint.load`` fault —
+    are skipped (newest first) instead of crashing the resume: a run that
+    died mid-write must restart from the last good pass, not die again."""
+    steps = sorted(_list_steps(directory), reverse=True)
+    for step in steps:
+        try:
+            return _load_step(directory, step)
+        except (CheckpointCorrupted, OSError) as e:
+            if logger is not None:
+                logger.warn(
+                    f"checkpoint step {step} invalid, falling back: {e}"
+                )
+            continue
+    return None

@@ -19,6 +19,22 @@ import numpy as np
 
 from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+from photon_ml_tpu.resilience import faults as _faults
+from photon_ml_tpu.resilience import retry as _retry
+
+
+def _resilient_read(fn, *args, label: str, logger=None, **kwargs):
+    """Run one input-read with the ``ingest.read`` fault site armed and
+    transient ``OSError`` retried (backoff; resilience.retry). A flaky
+    network filesystem — or an injected fault drill — costs a retry, not
+    the run. Non-I/O errors (bad schema, bad records) propagate
+    immediately."""
+
+    def attempt():
+        _faults.fire("ingest.read")
+        return fn(*args, **kwargs)
+
+    return _retry.retry_call(attempt, retries=3, label=label, logger=logger)
 
 
 # Avro field-name sets (``avro/FieldNamesType.scala:20``): the driver flag
@@ -431,7 +447,7 @@ class IngestSource:
 
             recs: List[dict] = []
             for f in self.files:
-                _, r = read_avro_file(f)
+                _, r = _resilient_read(read_avro_file, f, label=f"read {f}")
                 recs.extend(r)
             self._check_nonempty(len(recs))
             self._records = normalize_field_names(recs, self.field_names)
@@ -442,12 +458,14 @@ class IngestSource:
         if native is None:
             return None
         try:
-            return native.read_columnar(
+            return _resilient_read(
+                native.read_columnar,
                 self.files,
                 vocabs,
                 entity_keys,
                 label_field=self.label_field,
                 allow_null_labels=allow_null_labels,
+                label=f"native read {self.files}",
             )
         except native.UnsupportedSchema:
             return None
@@ -581,12 +599,14 @@ class IngestSource:
         total = 0
         for path in self.files:
             try:
-                out = native.read_columnar(
+                out = _resilient_read(
+                    native.read_columnar,
                     [path],
                     [vocab],
                     (),
                     label_field=self.label_field,
                     allow_null_labels=allow_null_labels,
+                    label=f"native read {path}",
                 )
             except native.UnsupportedSchema as e:
                 raise RuntimeError(

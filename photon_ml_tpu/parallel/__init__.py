@@ -21,9 +21,11 @@ from photon_ml_tpu.parallel.mesh import (
     make_game_mesh,
     make_mesh,
     replicated,
+    set_mesh,
     shard_batch,
     shard_bucketed_design,
     shard_design,
+    shard_map,
 )
 from photon_ml_tpu.parallel.multihost import (
     allgather_host,

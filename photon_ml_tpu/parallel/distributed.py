@@ -43,7 +43,9 @@ from photon_ml_tpu.parallel.mesh import (
     DATA_AXIS,
     FEATURE_AXIS,
     replicated,
+    set_mesh,
     shard_batch,
+    shard_map,
 )
 
 
@@ -61,7 +63,7 @@ def distributed_train_glm(
     deterministic for a fixed mesh shape.
     """
     sharded = shard_batch(batch, mesh)
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         return train_glm(sharded, config, **kwargs)
 
 
@@ -182,7 +184,7 @@ def feature_sharded_train_glm(
                 NamedSharding(mesh, P(FEATURE_AXIS)),
             )
         )
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         models = train_glm(
             padded, blocked_config, initial_coefficients=init, **kwargs
         )
@@ -217,7 +219,7 @@ def shard_map_value_and_grad(
     obj = objective.with_axis(DATA_AXIS)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS)),
         out_specs=(P(), P()),

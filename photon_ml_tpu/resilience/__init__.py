@@ -1,0 +1,60 @@
+"""Fault tolerance for the training runtime (docs/ROBUSTNESS.md).
+
+The reference outsources durability to Spark lineage recompute — with a
+documented fault-tolerance bug in that very strategy
+(``data/RandomEffectDataSet.scala:282-286``, SURVEY §5.3-5.4). This
+subsystem makes failure handling explicit and *testable*:
+
+- :mod:`.faults`   — deterministic fault injection at named sites
+- :mod:`.retry`    — bounded exponential backoff for transient I/O
+- :mod:`.shutdown` — SIGTERM/SIGINT -> checkpoint + resumable exit
+
+Checkpoint integrity (sha256 manifests, newest-VALID fallback) lives with
+the store in :mod:`photon_ml_tpu.io.checkpoint`; the divergence guard
+(rollback / damped retry / coordinate freeze) in
+:mod:`photon_ml_tpu.game.descent`.
+"""
+
+from photon_ml_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    arm_from_env,
+    corrupt_file,
+    fire,
+    inject,
+    parse_spec,
+    registry,
+)
+from photon_ml_tpu.resilience.retry import (
+    RetryBudgetExceeded,
+    backoff_delays,
+    retry_call,
+)
+from photon_ml_tpu.resilience.shutdown import (
+    PREEMPTED_MARKER,
+    GracefulShutdown,
+    clear_preempted_marker,
+    read_preempted_marker,
+    write_preempted_marker,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFault",
+    "arm_from_env",
+    "corrupt_file",
+    "fire",
+    "inject",
+    "parse_spec",
+    "registry",
+    "RetryBudgetExceeded",
+    "backoff_delays",
+    "retry_call",
+    "PREEMPTED_MARKER",
+    "GracefulShutdown",
+    "clear_preempted_marker",
+    "read_preempted_marker",
+    "write_preempted_marker",
+]

@@ -1,6 +1,7 @@
 from photon_ml_tpu.utils.logging import PhotonLogger, timed
 from photon_ml_tpu.utils.dates import DateRange, expand_date_paths
 from photon_ml_tpu.utils.compile_cache import enable_compilation_cache
+from photon_ml_tpu.utils.compat import force_cpu_devices
 
 __all__ = [
     "PhotonLogger",
@@ -8,4 +9,5 @@ __all__ = [
     "DateRange",
     "expand_date_paths",
     "enable_compilation_cache",
+    "force_cpu_devices",
 ]

@@ -1,0 +1,34 @@
+"""jax version-compatibility seams.
+
+The runtime must survive the toolchain it is actually deployed on: the
+harness pins different jax releases across environments, and two APIs
+this codebase leans on moved between 0.4.x and newer lines. Each seam
+lives here once (mesh-context and shard_map compat live with the mesh
+helpers in :mod:`photon_ml_tpu.parallel.mesh`); call sites never probe
+``jax`` attributes themselves.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force an ``n``-device virtual CPU platform — the test/bench fake
+    pod (the analog of the reference's local-mode Spark cluster).
+
+    Newer jax spells it ``jax_num_cpu_devices``; 0.4.x only has the XLA
+    host-platform flag, which is read lazily at backend creation, so
+    appending to ``XLA_FLAGS`` works even after ``import jax`` (only
+    backend USE must come later). Must run before first backend use
+    either way."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        )

@@ -45,8 +45,13 @@ class PhotonLogger:
             return
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
         line = f"{stamp} [{level}] {msg}"
-        print(line, file=self.stream)
-        if self._file is not None:
+        # a closed stream/file must not turn a log call into a ValueError —
+        # shutdown paths log AFTER teardown started (e.g. a timed() phase
+        # unwinding through close()); losing the line beats crashing the
+        # unwind
+        if not getattr(self.stream, "closed", False):
+            print(line, file=self.stream)
+        if self._file is not None and not self._file.closed:
             self._file.write(line + "\n")
             self._file.flush()
 
@@ -76,9 +81,19 @@ class PhotonLogger:
 
 @contextlib.contextmanager
 def timed(logger: Optional[PhotonLogger], label: str):
-    """Log the wall-clock of a phase (``Driver.scala:232-291`` timing)."""
+    """Log the wall-clock of a phase (``Driver.scala:232-291`` timing).
+    Failed phases still report their duration — where the time went is
+    most valuable exactly when the phase died."""
     t0 = time.perf_counter()
-    yield
-    dt = time.perf_counter() - t0
-    if logger is not None:
-        logger.info(f"{label} took {dt:.3f}s")
+    ok = True
+    try:
+        yield
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        if logger is not None:
+            logger.info(
+                f"{label} took {dt:.3f}s" + ("" if ok else " (failed)")
+            )

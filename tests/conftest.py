@@ -14,8 +14,9 @@ Must run before the first jax import, hence module-level in conftest.
 # (valid any time before first backend use).
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from photon_ml_tpu.utils.compat import force_cpu_devices
+
+force_cpu_devices(8)  # handles the jax_num_cpu_devices/XLA_FLAGS seam
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np

@@ -20,6 +20,20 @@ from photon_ml_tpu.parallel import (
     shard_map_value_and_grad,
 )
 
+# the two-process tests spawn REAL jax.distributed child processes; the
+# 0.4.x CPU backend has no multiprocess collectives implementation
+# ("Multiprocess computations aren't implemented on the CPU backend";
+# the gloo option exists but deadlocks), so they can only run on newer
+# jax lines — skip fast instead of failing (or hanging) tier-1
+_JAX_VERSION = tuple(
+    int(p) for p in jax.__version__.split(".")[:3] if p.isdigit()
+)
+two_process = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason="CPU multiprocess collectives unsupported on jax "
+    f"{jax.__version__} (< 0.5)",
+)
+
 
 def make_data(rng, n=400, d=10):
     x = rng.normal(size=(n, d))
@@ -597,8 +611,9 @@ f0, f1, vocab_path = sys.argv[4], sys.argv[5], sys.argv[6]
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+from photon_ml_tpu.utils.compat import force_cpu_devices
+
+force_cpu_devices(4)
 jax.config.update("jax_enable_x64", True)
 
 from photon_ml_tpu.parallel import (
@@ -606,6 +621,7 @@ from photon_ml_tpu.parallel import (
     make_global_batch,
     make_mesh,
     process_local_paths,
+    set_mesh,
 )
 
 joined = initialize_multihost(
@@ -645,7 +661,7 @@ cfg = GLMTrainingConfig(
     tolerance=1e-12,
     track_states=False,
 )
-with jax.set_mesh(mesh):
+with set_mesh(mesh):
     (tm,) = train_glm(global_batch, cfg)
 w = np.asarray(tm.model.coefficients.means)
 np.save(out_path, w)
@@ -658,7 +674,7 @@ local_sp, _, _ = IngestSource(mine).labeled_batch(
     vocab, dtype="float64", sparse=True, nnz_per_row=12
 )
 global_sp = make_global_batch(local_sp, mesh)
-with jax.set_mesh(mesh):
+with set_mesh(mesh):
     (tm_sp,) = train_glm(global_sp, cfg)
 np.save(out_path.replace(".npy", "_sparse.npy"),
         np.asarray(tm_sp.model.coefficients.means))
@@ -666,6 +682,7 @@ print("child", proc_id, "ok", w.shape)
 '''
 
 
+@two_process
 class TestTwoProcessDistributed:
     """VERDICT r3 #6: an ACTUAL two-process jax.distributed run (the
     analog of the reference's local-mode-Spark fake cluster,
@@ -790,8 +807,9 @@ data_path = sys.argv[4]
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+from photon_ml_tpu.utils.compat import force_cpu_devices
+
+force_cpu_devices(4)
 jax.config.update("jax_enable_x64", True)
 
 from photon_ml_tpu.parallel import (
@@ -893,6 +911,7 @@ print("game child", proc_id, "ok")
 '''
 
 
+@two_process
 class TestTwoProcessGame:
     """VERDICT r4 missing #1 / next #3: a FULL GAME coordinate-descent
     pass (fixed + bucketed random effect, scores assembled globally)
@@ -1036,6 +1055,7 @@ class TestTwoProcessGame:
         )
 
 
+@two_process
 class TestTwoProcessGameDriver:
     """VERDICT r4 next #3 (driver leg): a REAL 2-process invocation of
     the GAME training CLI — each process ingests its entity-partitioned

@@ -1,0 +1,588 @@
+"""Fault-tolerance drills (docs/ROBUSTNESS.md): deterministic fault
+injection, retry policy, checkpoint integrity + fallback, divergence
+rollback/freeze, and preemption-safe shutdown. The end-to-end drill is
+the PR's acceptance contract: injected checkpoint-write crashes plus a
+simulated SIGTERM mid-run, and the resumed run reproduces the
+uninterrupted run's final parameters to 1e-10. Everything here is
+CPU-only and timing-insensitive (injected faults are counted, not
+raced)."""
+
+import io
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.checkpoint import (
+    CheckpointCorrupted,
+    latest_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+    _list_steps,
+)
+from photon_ml_tpu.resilience import (
+    FaultSpec,
+    GracefulShutdown,
+    InjectedFault,
+    RetryBudgetExceeded,
+    backoff_delays,
+    corrupt_file,
+    inject,
+    parse_spec,
+    read_preempted_marker,
+    registry,
+    retry_call,
+)
+from test_game import build_game, make_mixed_effects_data
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# fault registry
+
+
+class TestFaultRegistry:
+    def test_nth_trigger_and_count(self):
+        with inject(FaultSpec("checkpoint.save", "raise", nth=2, count=2)):
+            registry.fire("checkpoint.save")  # call 1: clean
+            with pytest.raises(InjectedFault):
+                registry.fire("checkpoint.save")  # call 2
+            with pytest.raises(InjectedFault):
+                registry.fire("checkpoint.save")  # call 3 (count=2)
+            registry.fire("checkpoint.save")  # call 4: clean again
+
+    def test_count_forever(self):
+        with inject(FaultSpec("ingest.read", "raise", nth=1, count=-1)):
+            for _ in range(4):
+                with pytest.raises(InjectedFault):
+                    registry.fire("ingest.read")
+
+    def test_key_filter(self):
+        with inject(
+            FaultSpec("descent.update", "corrupt", nth=1, count=-1, key="re")
+        ):
+            assert not registry.fire("descent.update", key="fixed").corrupt
+            assert registry.fire("descent.update", key="re").corrupt
+
+    def test_seeded_probability_is_deterministic(self):
+        def draws():
+            with inject(FaultSpec("ingest.read", "corrupt", p=0.5, seed=7)):
+                return [
+                    registry.fire("ingest.read").corrupt for _ in range(20)
+                ]
+
+        a, b = draws(), draws()
+        assert a == b and any(a) and not all(a)
+
+    def test_inject_restores_registry(self):
+        before = registry.calls("checkpoint.save")
+        with inject(FaultSpec("checkpoint.save", "delay", nth=1, delay=0.0)):
+            registry.fire("checkpoint.save")
+        assert not registry.active()
+        assert registry.calls("checkpoint.save") == before
+
+    def test_parse_env_spec(self):
+        specs = parse_spec(
+            "checkpoint.save:raise@n=2;"
+            "ingest.read:delay@p=0.1,seed=7,delay=0.2;"
+            "descent.update:corrupt@n=3,count=-1,key=per-user"
+        )
+        assert [s.mode for s in specs] == ["raise", "delay", "corrupt"]
+        assert specs[0].nth == 2 and specs[1].p == 0.1
+        assert specs[2].key == "per-user" and specs[2].count == -1
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_spec("checkpoint.save:explode@n=1")
+        with pytest.raises(ValueError):
+            parse_spec("checkpoint.save:raise@n=1,p=0.5")  # both triggers
+
+    def test_corrupt_file_flips_bytes(self, tmp_path):
+        p = str(tmp_path / "blob")
+        with open(p, "wb") as f:
+            f.write(b"\x00" * 64)
+        corrupt_file(p)
+        with open(p, "rb") as f:
+            data = f.read()
+        assert len(data) == 64 and data != b"\x00" * 64
+
+
+# ---------------------------------------------------------------------------
+# retry
+
+
+class TestRetry:
+    def test_recovers_from_transient_fault(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise InjectedFault("ingest.read", len(calls))
+            return "ok"
+
+        assert (
+            retry_call(flaky, retries=4, base_delay=0.001, seed=0) == "ok"
+        )
+        assert len(calls) == 3
+
+    def test_budget_exhaustion_chains_last_error(self):
+        def always():
+            raise OSError("disk on fire")
+
+        with pytest.raises(RetryBudgetExceeded) as ei:
+            retry_call(always, retries=2, base_delay=0.001, seed=0)
+        assert isinstance(ei.value.__cause__, OSError)
+        assert ei.value.attempts == 3  # 1 initial + 2 retries
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("schema mismatch is not transient")
+
+        with pytest.raises(ValueError):
+            retry_call(bad, retries=5, base_delay=0.001)
+        assert len(calls) == 1
+
+    def test_deadline_stops_retrying(self):
+        def always():
+            raise OSError("nope")
+
+        with pytest.raises(RetryBudgetExceeded):
+            # huge attempt budget, but the first sleep (>=1s) would cross
+            # the deadline, so it gives up after one attempt
+            retry_call(
+                always, retries=100, base_delay=2.0, max_delay=2.0,
+                jitter=0.0, deadline=0.5,
+            )
+
+    def test_backoff_schedule_seeded_and_capped(self):
+        a = list(backoff_delays(5, 0.1, 2.0, 0.4, jitter=1.0, seed=3))
+        b = list(backoff_delays(5, 0.1, 2.0, 0.4, jitter=1.0, seed=3))
+        assert a == b
+        nojit = list(backoff_delays(5, 0.1, 2.0, 0.4, jitter=0.0))
+        assert nojit == [0.1, 0.2, 0.4, 0.4, 0.4]  # capped at max_delay
+
+
+# ---------------------------------------------------------------------------
+# logging fixes
+
+
+class TestLoggingRobustness:
+    def test_emit_after_close_does_not_raise(self, tmp_path):
+        from photon_ml_tpu.utils.logging import PhotonLogger
+
+        logger = PhotonLogger(str(tmp_path / "run.log"))
+        logger.info("before close")
+        # simulate teardown racing a log call: the file object is closed
+        # but still attached (close() also nulls it; a shared/externally
+        # closed stream hits the same guard)
+        logger._file.close()
+        logger.info("after close")  # guarded: dropped, not ValueError
+        logger.close()
+        stream = io.StringIO()
+        logger2 = PhotonLogger(stream=stream)
+        stream.close()
+        logger2.info("into a closed stream")  # also guarded
+
+    def test_timed_logs_duration_when_body_raises(self):
+        from photon_ml_tpu.utils.logging import PhotonLogger, timed
+
+        stream = io.StringIO()
+        logger = PhotonLogger(stream=stream)
+        with pytest.raises(RuntimeError):
+            with timed(logger, "doomed phase"):
+                raise RuntimeError("boom")
+        out = stream.getvalue()
+        assert "doomed phase took" in out and "(failed)" in out
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+
+
+def _save_steps(tmp_path, steps, keep=10):
+    for s in steps:
+        save_checkpoint(
+            str(tmp_path), s, {"w": np.full(3, float(s))},
+            np.zeros(2, np.uint32), keep=keep,
+        )
+
+
+class TestCheckpointIntegrity:
+    def test_digest_mismatch_falls_back_to_previous_step(self, tmp_path):
+        _save_steps(tmp_path, [1, 2])
+        corrupt_file(str(tmp_path / "step-2" / "arrays.npz"))
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck.step == 1
+        np.testing.assert_array_equal(ck.params["w"], np.full(3, 1.0))
+        with pytest.raises(CheckpointCorrupted):
+            verify_checkpoint(str(tmp_path), 2)
+
+    def test_truncated_manifest_falls_back(self, tmp_path):
+        _save_steps(tmp_path, [1, 2])
+        with open(tmp_path / "step-2" / "manifest.json", "w") as f:
+            f.write('{"step": 2, "rng_')  # torn mid-write
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_missing_arrays_falls_back(self, tmp_path):
+        _save_steps(tmp_path, [1, 2])
+        os.remove(tmp_path / "step-2" / "arrays.npz")
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_all_invalid_returns_none(self, tmp_path):
+        _save_steps(tmp_path, [1])
+        corrupt_file(str(tmp_path / "step-1" / "arrays.npz"))
+        assert latest_checkpoint(str(tmp_path)) is None
+
+    def test_pre_digest_checkpoints_still_load(self, tmp_path):
+        _save_steps(tmp_path, [1])
+        mpath = tmp_path / "step-1" / "manifest.json"
+        manifest = json.loads(mpath.read_text())
+        del manifest["digests"]  # a checkpoint written before this PR
+        mpath.write_text(json.dumps(manifest))
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_frozen_list_round_trips(self, tmp_path):
+        save_checkpoint(
+            str(tmp_path), 1, {"w": np.ones(2)}, np.zeros(2, np.uint32),
+            frozen=["per-user"],
+        )
+        assert latest_checkpoint(str(tmp_path)).frozen == ["per-user"]
+
+    def test_crash_between_write_and_swap_keeps_previous(self, tmp_path):
+        _save_steps(tmp_path, [1])
+        with inject(FaultSpec("checkpoint.save", "raise", nth=1, count=-1)):
+            with pytest.raises(RetryBudgetExceeded):
+                save_checkpoint(
+                    str(tmp_path), 2, {"w": np.full(3, 2.0)},
+                    np.zeros(2, np.uint32), retries=1,
+                )
+        # previous step intact, torn temp dir left behind...
+        assert latest_checkpoint(str(tmp_path)).step == 1
+        assert (tmp_path / "step-2.tmp").exists()
+        # ...and pruned by the next successful save
+        _save_steps(tmp_path, [2])
+        assert not (tmp_path / "step-2.tmp").exists()
+        assert latest_checkpoint(str(tmp_path)).step == 2
+
+    def test_transient_write_fault_is_retried(self, tmp_path):
+        with inject(FaultSpec("checkpoint.save", "raise", nth=1, count=1)):
+            save_checkpoint(
+                str(tmp_path), 1, {"w": np.ones(3)}, np.zeros(2, np.uint32),
+            )
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_torn_write_detected_by_digest(self, tmp_path):
+        _save_steps(tmp_path, [1])
+        # corrupt-mode save: bytes torn AFTER the digest was recorded —
+        # the write "succeeds" but the load must reject step 2
+        with inject(FaultSpec("checkpoint.save", "corrupt", nth=1)):
+            save_checkpoint(
+                str(tmp_path), 2, {"w": np.full(3, 2.0)},
+                np.zeros(2, np.uint32),
+            )
+        assert sorted(_list_steps(str(tmp_path))) == [1, 2]
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_rewrite_same_step_never_loses_it(self, tmp_path):
+        """The satellite fix: re-writing an existing step dies between the
+        old dir's removal and the new dir's rename — the step must still
+        load (old content) instead of vanishing."""
+        _save_steps(tmp_path, [1])
+        with inject(FaultSpec("checkpoint.save", "raise", nth=1, count=-1)):
+            with pytest.raises(RetryBudgetExceeded):
+                save_checkpoint(
+                    str(tmp_path), 1, {"w": np.full(3, 9.0)},
+                    np.zeros(2, np.uint32), retries=0,
+                )
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck.step == 1
+        np.testing.assert_array_equal(ck.params["w"], np.full(3, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# ingest retry
+
+
+class TestIngestRetry:
+    def test_transient_read_fault_recovers(self, tmp_path):
+        from photon_ml_tpu.io.avro import write_avro_file
+        from photon_ml_tpu.io.ingest import IngestSource, make_training_example
+        from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        path = str(tmp_path / "train.avro")
+        recs = [
+            make_training_example(1.0, {("f", "1"): 2.0}),
+            make_training_example(0.0, {("f", "2"): 3.0}),
+        ]
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, recs)
+        with inject(FaultSpec("ingest.read", "raise", nth=1, count=1)):
+            out = IngestSource([path]).records()
+        assert len(out) == 2
+
+    def test_persistent_read_fault_gives_up(self, tmp_path):
+        from photon_ml_tpu.io.avro import write_avro_file
+        from photon_ml_tpu.io.ingest import IngestSource, make_training_example
+        from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        path = str(tmp_path / "train.avro")
+        write_avro_file(
+            path, TRAINING_EXAMPLE_SCHEMA,
+            [make_training_example(1.0, {("f", "1"): 2.0})],
+        )
+        with inject(FaultSpec("ingest.read", "raise", nth=1, count=-1)):
+            with pytest.raises(RetryBudgetExceeded):
+                IngestSource([path]).records()
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe shutdown
+
+
+class TestGracefulShutdown:
+    def test_sigterm_sets_flag_instead_of_killing(self):
+        with GracefulShutdown() as s:
+            assert not s.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            # CPython delivers pending signals between bytecodes; this
+            # loop gives it that chance without any wall-clock dependence
+            for _ in range(10_000):
+                if s.requested:
+                    break
+            assert s.requested and s.signum == signal.SIGTERM
+        # handler restored: s() is still truthy but no handler installed
+        assert signal.getsignal(signal.SIGTERM) != s._handle
+
+    def test_stop_check_writes_checkpoint_and_marker(self, rng, tmp_path):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=10
+        )
+        ckdir = str(tmp_path / "ck")
+        shutdown = GracefulShutdown()
+        shutdown.request(signal.SIGTERM)  # preempted before pass 1 ends
+        cd = build_game(data, n_users)
+        cd.run(
+            num_iterations=5, seed=3, checkpoint_dir=ckdir,
+            checkpoint_every=2,  # pass 1 is NOT a scheduled save...
+            stop_check=shutdown,
+        )
+        ck = latest_checkpoint(ckdir)
+        assert ck is not None and ck.step == 1  # ...but preemption saved it
+        marker = read_preempted_marker(ckdir)
+        assert marker == {"step": 1, "signal": int(signal.SIGTERM)}
+
+    def test_resumed_after_preemption_matches_uninterrupted(
+        self, rng, tmp_path
+    ):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=6, rows_per_user=12
+        )
+        model_a, hist_a = build_game(data, n_users).run(
+            num_iterations=3, seed=11
+        )
+
+        ckdir = str(tmp_path / "ck")
+        stops = []
+
+        def stop_after_first_pass():
+            stops.append(1)
+            return len(stops) >= 1
+
+        build_game(data, n_users).run(
+            num_iterations=3, seed=11, checkpoint_dir=ckdir,
+            checkpoint_every=1, stop_check=stop_after_first_pass,
+        )
+        assert read_preempted_marker(ckdir) is not None
+
+        model_b, hist_b = build_game(data, n_users).run(
+            num_iterations=3, seed=11, checkpoint_dir=ckdir,
+            checkpoint_every=1, resume=True,
+        )
+        for name in model_a.params:
+            np.testing.assert_allclose(
+                np.asarray(model_b.params[name]),
+                np.asarray(model_a.params[name]),
+                rtol=0, atol=1e-10, err_msg=name,
+            )
+        assert [h.objective for h in hist_b] == [
+            h.objective for h in hist_a
+        ]
+        # run reached its target: the stale marker is cleared
+        assert read_preempted_marker(ckdir) is None
+
+
+# ---------------------------------------------------------------------------
+# divergence guard
+
+
+class TestDivergenceGuard:
+    def test_injected_nan_recovers_via_damped_retry(self, rng):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=10
+        )
+        cd = build_game(data, n_users)
+        # two coordinates => fire order per pass: fixed, per-user. Poison
+        # pass 2's per-user update only; the damped retry (next probe) is
+        # clean and must rescue the update.
+        with inject(
+            FaultSpec(
+                "descent.update", "corrupt", nth=4, count=1, key="per-user"
+            )
+        ):
+            model, hist = cd.run(num_iterations=3, divergence_guard=True)
+        events = [h.event for h in hist]
+        assert "recovered" in events and "frozen" not in events
+        assert len(hist) == 6  # no update was lost
+        for p in model.params.values():
+            assert np.all(np.isfinite(np.asarray(p)))
+        assert np.isfinite(hist[-1].objective)
+
+    def test_persistent_nan_freezes_coordinate_rest_trains_on(self, rng):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=10
+        )
+        cd = build_game(data, n_users)
+        with inject(
+            FaultSpec(
+                "descent.update", "corrupt", nth=4, count=-1, key="per-user"
+            )
+        ):
+            model, hist = cd.run(num_iterations=4, divergence_guard=True)
+        frozen_recs = [h for h in hist if h.event == "frozen"]
+        assert [(h.coordinate, h.iteration) for h in frozen_recs] == [
+            ("per-user", 1)
+        ]
+        # passes 3 and 4 train ONLY the surviving coordinate
+        tail = [h.coordinate for h in hist if h.iteration >= 2]
+        assert tail == ["fixed", "fixed"]
+        # frozen coordinate retains its last finite state; everything
+        # stays finite and the objective keeps improving for the rest
+        for p in model.params.values():
+            assert np.all(np.isfinite(np.asarray(p)))
+        fixed_objs = [
+            h.objective for h in hist
+            if h.coordinate == "fixed" and h.iteration >= 1
+        ]
+        assert all(np.isfinite(fixed_objs))
+        assert fixed_objs[-1] <= fixed_objs[0] + 1e-9
+
+    def test_guard_off_matches_guarded_run_without_faults(self, rng):
+        """The guard must be a no-op on healthy runs (same PRNG stream,
+        same updates) — only the dispatch granularity differs."""
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=10
+        )
+        cd_plain = build_game(data, n_users)
+        cd_plain.fuse_passes = False  # same dispatch shape as guarded
+        m_plain, _ = cd_plain.run(num_iterations=2, seed=5)
+        m_guard, _ = build_game(data, n_users).run(
+            num_iterations=2, seed=5, divergence_guard=True
+        )
+        for name in m_plain.params:
+            np.testing.assert_array_equal(
+                np.asarray(m_guard.params[name]),
+                np.asarray(m_plain.params[name]),
+                err_msg=name,
+            )
+
+    def test_frozen_set_survives_resume(self, rng, tmp_path):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=10
+        )
+        ckdir = str(tmp_path / "ck")
+        with inject(
+            FaultSpec(
+                "descent.update", "corrupt", nth=2, count=-1, key="per-user"
+            )
+        ):
+            build_game(data, n_users).run(
+                num_iterations=2, divergence_guard=True,
+                checkpoint_dir=ckdir, checkpoint_every=1,
+            )
+        assert latest_checkpoint(ckdir).frozen == ["per-user"]
+        # resumed run (faults cleared!) keeps the coordinate excluded
+        _, hist = build_game(data, n_users).run(
+            num_iterations=4, divergence_guard=True,
+            checkpoint_dir=ckdir, checkpoint_every=1, resume=True,
+        )
+        new = [h for h in hist if h.iteration >= 2]
+        assert new and all(h.coordinate == "fixed" for h in new)
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end drill (acceptance criterion)
+
+
+class TestEndToEndDrill:
+    def test_crash_preempt_resume_reproduces_uninterrupted(
+        self, rng, tmp_path
+    ):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=6, rows_per_user=12
+        )
+        model_a, hist_a = build_game(data, n_users).run(
+            num_iterations=4, seed=17
+        )
+
+        ckdir = str(tmp_path / "ck")
+        # leg 1: pass 1 checkpoints fine; pass 2's checkpoint write
+        # crashes persistently (every retry) -> the "process" dies
+        with inject(FaultSpec("checkpoint.save", "raise", nth=2, count=-1)):
+            with pytest.raises(RetryBudgetExceeded):
+                build_game(data, n_users).run(
+                    num_iterations=4, seed=17,
+                    checkpoint_dir=ckdir, checkpoint_every=1,
+                )
+        assert latest_checkpoint(ckdir).step == 1
+
+        # leg 2: restart resumes from step 1, then SIGTERM lands during
+        # the next pass -> checkpoint + resumable marker, clean exit
+        shutdown = GracefulShutdown()
+        shutdown.request(signal.SIGTERM)
+        build_game(data, n_users).run(
+            num_iterations=4, seed=17, checkpoint_dir=ckdir,
+            checkpoint_every=1, resume=True, stop_check=shutdown,
+        )
+        assert latest_checkpoint(ckdir).step == 2
+        assert read_preempted_marker(ckdir)["step"] == 2
+
+        # leg 3: final restart runs to completion
+        model_b, hist_b = build_game(data, n_users).run(
+            num_iterations=4, seed=17, checkpoint_dir=ckdir,
+            checkpoint_every=1, resume=True,
+        )
+        for name in model_a.params:
+            np.testing.assert_allclose(
+                np.asarray(model_b.params[name]),
+                np.asarray(model_a.params[name]),
+                rtol=0, atol=1e-10, err_msg=name,
+            )
+        assert [h.objective for h in hist_b] == [
+            h.objective for h in hist_a
+        ]
+        assert read_preempted_marker(ckdir) is None
+
+    def test_driver_config_knobs_parse(self):
+        from photon_ml_tpu.cli.config import GameDriverParams, load_params
+
+        params = load_params(
+            {
+                "train_input": ["x"],
+                "output_dir": "y",
+                "coordinates": {"g": {"shard": "s"}},
+                "updating_sequence": ["g"],
+                "divergence_guard": True,
+                "graceful_shutdown": False,
+                "checkpoint_every": 1,
+                "resume": True,
+            },
+            GameDriverParams,
+        )
+        params.validate()
+        assert params.divergence_guard and not params.graceful_shutdown
