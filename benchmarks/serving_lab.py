@@ -1,0 +1,201 @@
+"""Closed-loop load generator for the online serving subsystem.
+
+Stands up a synthetic GAME model (fixed effect + one random effect + one
+factored coordinate) inside a :class:`ScoringEngine`, fronts it with the
+micro-batcher, and drives it with N closed-loop clients (each submits a
+request, blocks on its score, repeats) — the canonical open-vs-closed-loop
+serving benchmark shape: throughput is client-limited, so latency numbers
+are honest (no coordinated omission from a fixed-rate generator stalling).
+
+Reported record (BENCH-style single JSON line on stdout):
+
+    {"metric": "serving_p99_ms", "value": <p99>, "unit": "ms",
+     "vs_baseline": <unbatched-sequential p99 / batched p99>,
+     "extra": {qps, p50/p95/p99, occupancy, bucket counters,
+               steady-state compiles (must be 0), ...}}
+
+``--smoke`` shrinks everything for a CPU-only sanity run
+(``JAX_PLATFORMS=cpu python benchmarks/serving_lab.py --smoke``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+# runnable as `python benchmarks/serving_lab.py` from the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_synthetic_engine(
+    rng, d_fixed=64, d_user=16, n_users=512, latent_k=4, dtype=None
+):
+    """In-memory model: 'global' fixed effect over shard 'g', 'per-user'
+    random effect and 'fact' factored coordinate over shard 'u'."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.factored import FactoredParams
+    from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+    from photon_ml_tpu.serving.engine import ScoringEngine
+
+    g_vocab = FeatureVocabulary(
+        [feature_key(f"g{j}", "") for j in range(d_fixed)]
+    )
+    u_vocab = FeatureVocabulary(
+        [feature_key(f"u{j}", "") for j in range(d_user)]
+    )
+    params = {
+        "global": rng.normal(size=d_fixed),
+        "per-user": rng.normal(size=(n_users, d_user))
+        * (rng.uniform(size=(n_users, d_user)) < 0.3),
+        "fact": FactoredParams(
+            gamma=jnp.asarray(rng.normal(size=(n_users, latent_k))),
+            projection=jnp.asarray(rng.normal(size=(d_user, latent_k))),
+        ),
+    }
+    re_vocab = {f"user{i}": i for i in range(n_users)}
+    return ScoringEngine(
+        params,
+        shards={"global": "g", "per-user": "u", "fact": "u"},
+        random_effects={
+            "global": None, "per-user": "userId", "fact": "userId"
+        },
+        shard_vocabs={"g": g_vocab, "u": u_vocab},
+        re_vocabs={"userId": re_vocab},
+        **({"dtype": dtype} if dtype is not None else {}),
+    )
+
+
+def make_request(rng, d_fixed, d_user, n_users, cold_rate=0.1):
+    from photon_ml_tpu.serving.engine import ScoreRequest
+
+    feats = {
+        f"g{int(j)}": float(rng.normal())
+        for j in rng.integers(0, d_fixed, size=8)
+    }
+    feats.update(
+        {
+            f"u{int(j)}": float(rng.normal())
+            for j in rng.integers(0, d_user, size=4)
+        }
+    )
+    user = (
+        f"user{int(rng.integers(0, n_users))}"
+        if rng.uniform() > cold_rate
+        else f"coldstart{int(rng.integers(0, 1 << 30))}"
+    )
+    return ScoreRequest(features=feats, entities={"userId": user})
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(prog="benchmarks/serving_lab.py")
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--requests", type=int, default=2000,
+                   help="total requests across all clients")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--max-wait-ms", type=float, default=1.0)
+    p.add_argument("--baseline-requests", type=int, default=200,
+                   help="sequential unbatched calls for the baseline")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny CPU-safe configuration")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.clients = min(args.clients, 4)
+        args.requests = min(args.requests, 400)
+        args.baseline_requests = min(args.baseline_requests, 50)
+
+    from photon_ml_tpu.serving.batcher import MicroBatcher
+    from photon_ml_tpu.serving.stats import xla_compile_events
+
+    rng = np.random.default_rng(20260804)
+    d_fixed, d_user, n_users = (32, 8, 128) if args.smoke else (64, 16, 512)
+    engine = build_synthetic_engine(rng, d_fixed, d_user, n_users)
+    engine.warmup(max_batch=args.max_batch)
+
+    # pre-generate requests so the generator is not part of the loop
+    reqs = [
+        make_request(rng, d_fixed, d_user, n_users)
+        for _ in range(max(args.requests, args.baseline_requests))
+    ]
+
+    # -- baseline: sequential, unbatched (batch-of-1 engine calls) ---------
+    base_lat = []
+    for r in reqs[: args.baseline_requests]:
+        t0 = time.perf_counter()
+        engine.score([r])
+        base_lat.append((time.perf_counter() - t0) * 1e3)
+    base_p99 = float(np.percentile(base_lat, 99))
+
+    # -- closed loop through the micro-batcher -----------------------------
+    batcher = MicroBatcher(
+        engine.score,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=4 * args.requests,
+        stats=engine.stats,  # one ledger: bucket counters + batch latencies
+    )
+    per_client = args.requests // args.clients
+    latencies = [[] for _ in range(args.clients)]
+    compiles_before = xla_compile_events()
+
+    def client(ci: int) -> None:
+        lo = ci * per_client
+        for r in reqs[lo: lo + per_client]:
+            t0 = time.perf_counter()
+            batcher.submit(r).result(timeout=60)
+            latencies[ci].append((time.perf_counter() - t0) * 1e3)
+
+    threads = [
+        threading.Thread(target=client, args=(ci,))
+        for ci in range(args.clients)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    batcher.drain()
+    steady_compiles = xla_compile_events() - compiles_before
+
+    lat = np.concatenate([np.asarray(c) for c in latencies])
+    snap = batcher.stats.snapshot()
+    p99 = float(np.percentile(lat, 99))
+    record = {
+        "metric": "serving_p99_ms",
+        "value": round(p99, 4),
+        "unit": "ms",
+        "vs_baseline": round(base_p99 / p99, 3) if p99 > 0 else None,
+        "extra": {
+            "clients": args.clients,
+            "requests": int(lat.size),
+            "qps": round(lat.size / wall, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)), 4),
+            "p95_ms": round(float(np.percentile(lat, 95)), 4),
+            "p99_ms": round(p99, 4),
+            "max_ms": round(float(lat.max()), 4),
+            "baseline_unbatched_p99_ms": round(base_p99, 4),
+            "batch_occupancy_mean": round(
+                snap["batch_occupancy_mean"], 2
+            ),
+            "buckets": snap["buckets"],
+            "steady_state_compiles": steady_compiles,
+            "device_p50_ms": snap["device_latency"]["p50_ms"],
+            "engine_compile_count": engine.compile_count,
+            "smoke": bool(args.smoke),
+        },
+    }
+    print(json.dumps(record))
+    return record
+
+
+if __name__ == "__main__":
+    run()

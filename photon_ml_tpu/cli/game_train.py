@@ -963,6 +963,13 @@ def _run_game_training(
                         params.output_dir, f"feature-index-{shard}.txt"
                     )
                 )
+        if save_process and output_dirs:
+            # sha256 manifest over the whole export (models + vocabs): the
+            # serving registry verifies it before hot-reloading, so a
+            # partially-written or tampered export can never serve
+            from photon_ml_tpu.io.models import write_model_manifest
+
+            write_model_manifest(params.output_dir)
 
     return GameTrainingRun(
         params=params,

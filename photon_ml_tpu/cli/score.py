@@ -30,7 +30,7 @@ from photon_ml_tpu.cli.train import (
 from photon_ml_tpu.core.tasks import TaskType
 from photon_ml_tpu.game.scoring import score_game_data
 from photon_ml_tpu.io.avro import write_avro_file
-from photon_ml_tpu.io.models import load_game_model, load_glm_model
+from photon_ml_tpu.io.models import load_glm_model
 from photon_ml_tpu.io.schemas import SCORING_RESULT_SCHEMA
 from photon_ml_tpu.io.vocab import FeatureVocabulary
 from photon_ml_tpu.ops import metrics as metrics_mod
@@ -47,45 +47,8 @@ class ScoringRun:
     output_path: str
 
 
-def _resolve_game_dirs(root: str):
-    """(model_root, vocab_root): model_root holds fixed-effect/random-effect
-    subdirs — the training-output root itself, its 'best' child, or the
-    first 'all/<i>' child; vocab_root holds the feature-index-*.txt files
-    (the training-output root, walking up from model_root)."""
-
-    def has_model(d):
-        return os.path.isdir(os.path.join(d, "fixed-effect")) or os.path.isdir(
-            os.path.join(d, "random-effect")
-        )
-
-    candidates = [root, os.path.join(root, "best")]
-    all_dir = os.path.join(root, "all")
-    if os.path.isdir(all_dir):
-        candidates += [
-            os.path.join(all_dir, s) for s in sorted(os.listdir(all_dir))
-        ]
-    model_root = next((c for c in candidates if has_model(c)), None)
-    if model_root is None:
-        raise FileNotFoundError(
-            f"no GAME model (fixed-effect/random-effect dirs) under {root}"
-        )
-
-    def has_vocabs(d):
-        return any(
-            f.startswith("feature-index-") and f.endswith(".txt")
-            for f in os.listdir(d)
-        )
-
-    vocab_root = model_root
-    while not has_vocabs(vocab_root):
-        parent = os.path.dirname(vocab_root.rstrip(os.sep))
-        if not parent or parent == vocab_root:
-            raise FileNotFoundError(
-                f"no feature-index-*.txt vocab files found at or above "
-                f"{model_root}"
-            )
-        vocab_root = parent
-    return model_root, vocab_root
+# moved to io.models so the online engine shares it; alias kept for callers
+from photon_ml_tpu.io.models import resolve_game_dirs as _resolve_game_dirs
 
 
 def write_scored_items(
@@ -207,81 +170,20 @@ def run_scoring(params) -> ScoringRun:
             labels = np.asarray(batch.labels)
             weights = np.asarray(batch.effective_weights())
         else:
-            # GAME directory layout; shard vocabs saved next to the model
-            model_root, vocab_root = _resolve_game_dirs(params.model_dir)
-            vocab_files = {
-                f[len("feature-index-"):-len(".txt")]: os.path.join(vocab_root, f)
-                for f in os.listdir(vocab_root)
-                if f.startswith("feature-index-") and f.endswith(".txt")
-            }
-            shard_vocabs = {
-                shard: FeatureVocabulary.load(path)
-                for shard, path in vocab_files.items()
-            }
-            # coordinate -> shard comes from id-info; vocabs keyed per
-            # coordinate for load_game_model
-            coord_shards: Dict[str, str] = {}
-            for kind in (
-                "fixed-effect", "random-effect", "factored-random-effect"
-            ):
-                kdir = os.path.join(model_root, kind)
-                if not os.path.isdir(kdir):
-                    continue
-                for name in os.listdir(kdir):
-                    with open(os.path.join(kdir, name, "id-info")) as f:
-                        for line in f:
-                            if line.startswith("featureShardId="):
-                                coord_shards[name] = line.strip().split("=", 1)[1]
-            coord_vocabs = {
-                name: shard_vocabs[shard]
-                for name, shard in coord_shards.items()
-            }
-            model_params, shards, random_effects, entity_vocabs = (
-                load_game_model(model_root, coord_vocabs)
-            )
-            entity_keys = sorted(
-                {re for re in random_effects.values() if re is not None}
-            )
-            # Entity vocab per RE TYPE = the UNION over the coordinates
-            # sharing it (the data is indexed once per type; each
-            # coordinate's table rows must live in that shared space —
-            # a first-coordinate-wins merge would silently misattribute
-            # every other coordinate's per-entity rows). Coordinates
-            # lacking an entity contribute zero rows, the reference's
-            # missing-entity-scores-0 cogroup semantic.
-            from photon_ml_tpu.game.factored import (
-                FactoredParams,
-                is_factored_params,
-            )
-            from photon_ml_tpu.io.models import (
-                remap_entity_rows,
-                union_entity_vocab,
-            )
+            # GAME directory layout; shard vocabs saved next to the model.
+            # load_game_model_auto (io/models.py, shared with the online
+            # serving engine) resolves dirs, loads coordinates, and merges
+            # entity vocabularies per random-effect TYPE.
+            from photon_ml_tpu.io.models import load_game_model_auto
 
-            re_vocabs: Dict[str, dict] = {}
-            for re_key in entity_keys:
-                re_vocabs[re_key] = union_entity_vocab(
-                    entity_vocabs[name]
-                    for name, rk in random_effects.items()
-                    if rk == re_key
-                )
-            for name, re_key in random_effects.items():
-                if re_key is None:
-                    continue
-                shared = re_vocabs[re_key]
-                own = entity_vocabs[name]
-                p = model_params[name]
-                if is_factored_params(p):
-                    model_params[name] = FactoredParams(
-                        gamma=jnp.asarray(
-                            remap_entity_rows(p.gamma, own, shared)
-                        ),
-                        projection=p.projection,
-                    )
-                else:
-                    model_params[name] = remap_entity_rows(
-                        p, own, shared
-                    )
+            (
+                model_params,
+                shards,
+                random_effects,
+                shard_vocabs,
+                re_vocabs,
+            ) = load_game_model_auto(params.model_dir)
+            entity_keys = sorted(re_vocabs)
             data, _, uids, label_present = source.game_data(
                 shard_vocabs,
                 entity_keys,
@@ -289,10 +191,20 @@ def run_scoring(params) -> ScoringRun:
                 allow_null_labels=True,
                 sparse_shards=set(params.sparse_shards),
             )
-            margins = (
-                score_game_data(model_params, shards, random_effects, data)
-                + jnp.asarray(data.offsets)
-            )
+            # Pad to the serving engine's power-of-two buckets: ragged
+            # final batches would otherwise compile a fresh executable per
+            # distinct row count; padded rows carry zero features and
+            # entity -1, and are sliced off host-side.
+            from photon_ml_tpu.serving.engine import bucket_size, pad_game_data
+
+            n = data.num_rows
+            padded = pad_game_data(data, bucket_size(n))
+            margins = np.asarray(
+                score_game_data(
+                    model_params, shards, random_effects, padded
+                )
+                + jnp.asarray(padded.offsets)
+            )[:n]
             labels = np.asarray(data.labels)
             weights = np.asarray(data.weights)
 

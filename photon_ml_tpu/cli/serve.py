@@ -1,0 +1,266 @@
+"""Online scoring server: resident GAME engine behind a JSON-lines protocol.
+
+The batch drivers (``cli/score.py``) load, score once, and exit; this
+entrypoint keeps the model device-resident and answers requests as they
+arrive, micro-batched (docs/SERVING.md). Run over stdin/stdout
+
+    python -m photon_ml_tpu.cli.serve --model-dir out/game
+
+or as a TCP socket server (one JSON object per line per connection):
+
+    python -m photon_ml_tpu.cli.serve --model-dir out/game --socket 7474
+
+Protocol (one JSON object per line):
+
+    {"features": {"age": 0.7, "ctr\\u0001day7": 1.2},
+     "entities": {"userId": "u123"}, "offset": 0.0}
+        -> {"score": 1.234}
+    {"cmd": "stats"}    -> latency/QPS/bucket snapshot (serving/stats.py)
+    {"cmd": "version"}  -> {"version": "<current model version>"}
+    {"cmd": "reload", "path": "<export dir>"} -> {"reloaded": "<version>"}
+
+Unknown feature keys are ignored per shard vocabulary (ingest semantics);
+unknown entity ids score fixed-effect-only (cold start). SIGTERM/SIGINT
+drain the micro-batcher — accepted requests finish, new ones are refused —
+via the ``GracefulShutdown.register_drain`` hook. With ``--watch-root``,
+new verified model exports under the directory hot-reload automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import Optional
+
+from photon_ml_tpu.serving.batcher import Backpressure, MicroBatcher
+from photon_ml_tpu.serving.engine import ScoreRequest
+from photon_ml_tpu.serving.registry import ModelRegistry
+from photon_ml_tpu.serving.stats import ServingStats
+
+
+def build_request(obj: dict) -> ScoreRequest:
+    if not isinstance(obj, dict):
+        raise ValueError(f"request must be a JSON object, got {type(obj)}")
+    features = obj.get("features", {})
+    if not isinstance(features, dict):
+        raise ValueError("'features' must be an object of key -> value")
+    return ScoreRequest(
+        features=features,
+        entities=obj.get("entities", {}),
+        offset=float(obj.get("offset", 0.0)),
+    )
+
+
+def serve_lines(
+    lines,
+    out,
+    batcher: MicroBatcher,
+    registry: Optional[ModelRegistry] = None,
+    stats: Optional[ServingStats] = None,
+    shutdown=None,
+    window: int = 128,
+) -> int:
+    """Pump a JSON-lines stream through the batcher, writing one response
+    line per request IN ORDER. A dedicated writer thread emits each
+    response as soon as its (in-order) future resolves, so an interactive
+    client gets its score promptly while a pipelining client can keep up
+    to ``window`` requests outstanding (which is what fills micro-batches
+    from a single stream). Commands execute at read time; their replies
+    take their place in the output order. Returns the number of scored
+    requests."""
+    import queue as queue_mod
+
+    outbox: "queue_mod.Queue" = queue_mod.Queue(maxsize=window)
+    scored = [0]
+
+    def writer() -> None:
+        broken = False
+        while True:
+            item = outbox.get()
+            if item is None:
+                return
+            kind, payload = item
+            if kind == "score":
+                try:
+                    reply = json.dumps({"score": payload.result()})
+                    scored[0] += 1
+                except Exception as e:  # noqa: BLE001 — per-request reply
+                    reply = json.dumps({"error": str(e)})
+            else:
+                reply = payload
+            if broken:
+                continue  # output gone: keep draining so readers don't block
+            try:
+                out.write(reply + "\n")
+                out.flush()
+            except Exception:  # noqa: BLE001 — e.g. client hung up
+                broken = True
+
+    wt = threading.Thread(target=writer, name="serve-writer", daemon=True)
+    wt.start()
+
+    def reply_now(obj: dict) -> None:
+        outbox.put(("line", json.dumps(obj)))
+
+    try:
+        for line in lines:
+            if shutdown is not None and shutdown.requested:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                reply_now({"error": f"bad JSON: {e}"})
+                continue
+            cmd = obj.get("cmd") if isinstance(obj, dict) else None
+            if cmd is not None:
+                try:
+                    if cmd == "stats":
+                        reply_now((stats or batcher.stats).snapshot())
+                    elif cmd == "version":
+                        reply_now({"version": registry.version()})
+                    elif cmd == "reload":
+                        v = registry.load(obj["path"])
+                        reply_now({"reloaded": v.version_id})
+                    else:
+                        reply_now({"error": f"unknown cmd {cmd!r}"})
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    reply_now({"error": str(e)})
+                continue
+            try:
+                outbox.put(("score", batcher.submit(build_request(obj))))
+            except (Backpressure, ValueError) as e:
+                reply_now({"error": str(e)})
+    finally:
+        outbox.put(None)
+        wt.join()
+    return scored[0]
+
+
+def _watch_loop(registry, watch_root, poll_s, shutdown, logger):
+    while not shutdown.requested:
+        try:
+            loaded = registry.poll(watch_root)
+            if loaded is not None and logger is not None:
+                logger.info(f"hot-reloaded version {loaded!r}")
+        except Exception as e:  # noqa: BLE001 — watcher must survive
+            if logger is not None:
+                logger.warn(f"watch poll failed: {e}")
+        shutdown._event.wait(poll_s)
+
+
+def _serve_socket(port, batcher, registry, stats, shutdown, logger):
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            lines = (raw.decode("utf-8") for raw in self.rfile)
+
+            class _W:  # text adapter over the binary wfile
+                def write(inner, s):
+                    self.wfile.write(s.encode("utf-8"))
+
+                def flush(inner):
+                    pass
+
+            serve_lines(
+                lines, _W(), batcher, registry, stats, shutdown=shutdown
+            )
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server(("127.0.0.1", port), Handler) as server:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        if logger is not None:
+            logger.info(f"serving on 127.0.0.1:{port}")
+        shutdown._event.wait()  # SIGTERM/SIGINT or programmatic request
+        server.shutdown()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli.serve",
+        description="Serve a GAME model online (stdin or TCP JSON lines).",
+    )
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--watch-root", help="poll for new model versions here")
+    p.add_argument("--poll-s", type=float, default=5.0)
+    p.add_argument("--socket", type=int, help="TCP port (default: stdin)")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--queue-depth", type=int, default=1024)
+    p.add_argument("--min-bucket", type=int, default=8)
+    p.add_argument(
+        "--dtype", choices=["float32", "float64"], default="float32"
+    )
+    p.add_argument(
+        "--no-verify-manifest",
+        action="store_true",
+        help="serve exports without a sha256 manifest (NOT recommended)",
+    )
+    p.add_argument("--stats-json", help="dump a stats snapshot here on exit")
+    args = p.parse_args(argv)
+    # after parse_args: --help / bad flags must not initialize the backend
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.resilience import GracefulShutdown
+    from photon_ml_tpu.utils import PhotonLogger, enable_compilation_cache
+
+    enable_compilation_cache()
+    logger = PhotonLogger(None)
+    stats = ServingStats()
+    registry = ModelRegistry(
+        verify=not args.no_verify_manifest,
+        warmup_max_batch=args.max_batch,
+        stats=stats,
+        logger=logger,
+        dtype={"float32": jnp.float32, "float64": jnp.float64}[args.dtype],
+        min_bucket=args.min_bucket,
+    )
+    registry.load(args.model_dir)
+    batcher = MicroBatcher(
+        registry.score,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        stats=stats,
+    )
+    shutdown = GracefulShutdown(logger).install()
+    shutdown.register_drain(batcher.begin_drain)
+    if args.watch_root:
+        threading.Thread(
+            target=_watch_loop,
+            args=(registry, args.watch_root, args.poll_s, shutdown, logger),
+            daemon=True,
+        ).start()
+    try:
+        if args.socket:
+            _serve_socket(
+                args.socket, batcher, registry, stats, shutdown, logger
+            )
+        else:
+            serve_lines(
+                sys.stdin,
+                sys.stdout,
+                batcher,
+                registry,
+                stats,
+                shutdown=shutdown,
+                window=args.max_batch * 2,
+            )
+    finally:
+        batcher.drain()
+        if args.stats_json:
+            stats.dump(args.stats_json)
+        shutdown.uninstall()
+
+
+if __name__ == "__main__":
+    main()

@@ -139,6 +139,24 @@ def _random_scores_sparse(cols_tab, vals_tab, feats, ents):
 
 
 @jax.jit
+def _random_scores_compact_dense(cols_tab, vals_tab, feats, ents):
+    """x_i . w_{e_i} through a :class:`CompactReTable` against DENSE per-row
+    features: gather the entity's k active (column, value) pairs and pick
+    those columns out of the dense row. O(n * k) work regardless of d, so a
+    pre-compacted table serves dense rows (the online engine's featurized
+    requests) as cheaply as sparse ones. Column pad d is out of range for
+    the (n, d) row — clip the gather; its value pad 0 zeroes the term."""
+    safe_e = jnp.maximum(ents, 0)
+    ec = cols_tab[safe_e]  # (n, k) active columns of the row's entity
+    ev = vals_tab[safe_e]  # (n, k) matching coefficients
+    picked = jnp.take_along_axis(
+        feats, jnp.minimum(ec, feats.shape[1] - 1), axis=1
+    )
+    per_row = jnp.sum(picked * ev, axis=-1)
+    return jnp.where(ents >= 0, per_row, 0.0)
+
+
+@jax.jit
 def _factored_scores(gamma, projection, feats, ents):
     """score = (x B) . gamma_e without materializing B gamma^T
     (``FactoredRandomEffectCoordinate`` scoring contraction)."""
@@ -193,18 +211,18 @@ def score_game_data(
                 ents,
             )
         elif isinstance(p, CompactReTable) or is_structured(raw):
-            if not is_structured(raw):
-                raise ValueError(
-                    f"coordinate {name!r}: CompactReTable params score "
-                    f"against sparse shards; shard {shard!r} is dense"
-                )
             ents = jnp.asarray(data.entity_ids[re_key])
             compact = (
                 p
                 if isinstance(p, CompactReTable)
                 else _compact_table_cached(p)
             )
-            total = total + _random_scores_sparse(
+            kernel = (
+                _random_scores_sparse
+                if is_structured(raw)
+                else _random_scores_compact_dense
+            )
+            total = total + kernel(
                 jnp.asarray(np.asarray(compact.columns, np.int32)),
                 jnp.asarray(compact.values, dtype),
                 feats,
@@ -216,3 +234,23 @@ def score_game_data(
                 jnp.asarray(p, dtype), feats, ents
             )
     return total
+
+
+def precompact_model(params: Dict[str, object]) -> Dict[str, object]:
+    """Replace every (E, d) random-effect coefficient table with its
+    :class:`CompactReTable` — pre-compact ONCE instead of leaning on the
+    id-keyed weakref cache per call. Fixed-effect vectors (1-D), factored
+    params, and already-compact tables pass through unchanged. The compact
+    form scores against sparse ELL shards and dense rows alike, so both
+    the offline driver and the online serving engine share it."""
+    out: Dict[str, object] = {}
+    for name, p in params.items():
+        if (
+            isinstance(p, CompactReTable)
+            or hasattr(p, "gamma")  # FactoredParams
+            or np.ndim(p) != 2
+        ):
+            out[name] = p
+        else:
+            out[name] = _compact_table_cached(p)
+    return out

@@ -68,12 +68,17 @@ class CheckpointCorrupted(Exception):
     """A step directory failed integrity verification."""
 
 
-def _sha256(path: str) -> str:
+def sha256_file(path: str) -> str:
+    """Streaming sha256 of a file — shared by checkpoint manifests and the
+    serving model-export manifests (:mod:`photon_ml_tpu.io.models`)."""
     h = hashlib.sha256()
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+_sha256 = sha256_file
 
 
 def _prune_leftovers(directory: str) -> None:

@@ -17,7 +17,9 @@ feature-shard id (and random-effect type for RE coordinates).
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -119,6 +121,101 @@ def load_glm_model(
         ),
         task,
     )
+
+
+# ---------------------------------------------------------------------------
+# Model-export integrity manifests (the serving hot-reload gate)
+# ---------------------------------------------------------------------------
+
+MODEL_MANIFEST = "model-manifest.json"
+
+
+class ModelIntegrityError(Exception):
+    """A model export failed sha256 manifest verification — partially
+    written, tampered with, or missing its manifest entirely."""
+
+
+_MODEL_KINDS = ("fixed-effect", "random-effect", "factored-random-effect")
+
+
+def _manifest_files(root: str) -> List[str]:
+    """Model-BEARING files under an export root: coordinate directories
+    (at any nesting — ``best/``, ``all/<i>/``), feature-index vocabularies,
+    and model-spec.json. Volatile run artifacts riding along in a training
+    output dir (logs, checkpoints, metrics) are deliberately outside the
+    integrity boundary — they keep changing after the export is sealed."""
+    out = []
+    for dirpath, _, files in os.walk(root):
+        for name in sorted(files):
+            if name == MODEL_MANIFEST:
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, name), root)
+            parts = rel.split(os.sep)
+            if (
+                any(p in _MODEL_KINDS for p in parts[:-1])
+                or (name.startswith("feature-index-") and name.endswith(".txt"))
+                or name == "model-spec.json"
+            ):
+                out.append(rel)
+    return sorted(out)
+
+
+def write_model_manifest(root: str) -> str:
+    """Walk a model export directory and record a sha256 digest per file in
+    ``<root>/model-manifest.json`` — the same integrity scheme as training
+    checkpoints (:mod:`photon_ml_tpu.io.checkpoint`). The serving registry
+    refuses to hot-reload an export whose digests do not verify, so a
+    partially-written or torn export can never serve."""
+    from photon_ml_tpu.io.checkpoint import sha256_file
+
+    digests = {
+        rel: sha256_file(os.path.join(root, rel))
+        for rel in _manifest_files(root)
+    }
+    if not digests:
+        raise ValueError(
+            f"{root}: no model files to manifest (an empty manifest would "
+            "verify vacuously and defeat the serving integrity gate)"
+        )
+    path = os.path.join(root, MODEL_MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"created": time.time(), "digests": digests}, f, indent=2)
+    os.replace(tmp, path)  # atomic: a reader never sees a torn manifest
+    return path
+
+
+def verify_model_manifest(root: str, require: bool = True) -> Dict[str, str]:
+    """Verify every digest in ``<root>/model-manifest.json`` against the
+    files on disk. Raises :class:`ModelIntegrityError` on a missing file or
+    digest mismatch — and on a missing manifest when ``require`` (files the
+    manifest does not list are ignored: logs and metrics riding along in
+    the export directory are not integrity-bearing). Returns the verified
+    ``{relpath: digest}`` map."""
+    from photon_ml_tpu.io.checkpoint import sha256_file
+
+    path = os.path.join(root, MODEL_MANIFEST)
+    if not os.path.exists(path):
+        if require:
+            raise ModelIntegrityError(f"{root}: no {MODEL_MANIFEST}")
+        return {}
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        digests = manifest["digests"]
+    except (OSError, json.JSONDecodeError, KeyError) as e:
+        raise ModelIntegrityError(f"{path}: unreadable manifest ({e})") from e
+    for rel, want in digests.items():
+        fpath = os.path.join(root, rel)
+        if not os.path.exists(fpath):
+            raise ModelIntegrityError(f"{root}: missing {rel}")
+        got = sha256_file(fpath)
+        if got != want:
+            raise ModelIntegrityError(
+                f"{root}: {rel} digest mismatch "
+                f"(manifest {want[:12]}…, file {got[:12]}…)"
+            )
+    return digests
 
 
 # ---------------------------------------------------------------------------
@@ -285,6 +382,119 @@ def remap_entity_rows(
     out = np.zeros((len(shared), table.shape[1]), table.dtype)
     out[dst] = table[src]
     return out
+
+
+def resolve_game_dirs(root: str) -> Tuple[str, str]:
+    """(model_root, vocab_root): model_root holds fixed-effect/random-effect
+    subdirs — the training-output root itself, its 'best' child, or the
+    first 'all/<i>' child; vocab_root holds the feature-index-*.txt files
+    (the training-output root, walking up from model_root)."""
+
+    def has_model(d):
+        return os.path.isdir(os.path.join(d, "fixed-effect")) or os.path.isdir(
+            os.path.join(d, "random-effect")
+        )
+
+    candidates = [root, os.path.join(root, "best")]
+    all_dir = os.path.join(root, "all")
+    if os.path.isdir(all_dir):
+        candidates += [
+            os.path.join(all_dir, s) for s in sorted(os.listdir(all_dir))
+        ]
+    model_root = next((c for c in candidates if has_model(c)), None)
+    if model_root is None:
+        raise FileNotFoundError(
+            f"no GAME model (fixed-effect/random-effect dirs) under {root}"
+        )
+
+    def has_vocabs(d):
+        return any(
+            f.startswith("feature-index-") and f.endswith(".txt")
+            for f in os.listdir(d)
+        )
+
+    vocab_root = model_root
+    while not has_vocabs(vocab_root):
+        parent = os.path.dirname(vocab_root.rstrip(os.sep))
+        if not parent or parent == vocab_root:
+            raise FileNotFoundError(
+                f"no feature-index-*.txt vocab files found at or above "
+                f"{model_root}"
+            )
+        vocab_root = parent
+    return model_root, vocab_root
+
+
+def load_game_model_auto(root: str):
+    """One-call GAME model load for scoring: resolve the model/vocab dirs
+    under a training-output root, load every coordinate, and merge entity
+    vocabularies per random-effect TYPE (the union over the coordinates
+    sharing it — data is indexed once per type, and each coordinate's table
+    rows must live in that shared space; a first-coordinate-wins merge
+    would silently misattribute per-entity rows). Coordinates lacking an
+    entity contribute zero rows — the reference's missing-entity-scores-0
+    cogroup semantic.
+
+    Returns ``(params, shards, random_effects, shard_vocabs, re_vocabs)``
+    where ``shard_vocabs`` maps feature-shard id -> FeatureVocabulary and
+    ``re_vocabs`` maps random-effect type -> shared {raw_id: row} vocab.
+    Shared by the offline scoring driver (:mod:`photon_ml_tpu.cli.score`)
+    and the online engine (:mod:`photon_ml_tpu.serving.engine`)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.factored import FactoredParams, is_factored_params
+
+    model_root, vocab_root = resolve_game_dirs(root)
+    vocab_files = {
+        f[len("feature-index-"):-len(".txt")]: os.path.join(vocab_root, f)
+        for f in os.listdir(vocab_root)
+        if f.startswith("feature-index-") and f.endswith(".txt")
+    }
+    shard_vocabs = {
+        shard: FeatureVocabulary.load(path)
+        for shard, path in vocab_files.items()
+    }
+    # coordinate -> shard comes from id-info; vocabs keyed per coordinate
+    # for load_game_model
+    coord_shards: Dict[str, str] = {}
+    for kind in ("fixed-effect", "random-effect", "factored-random-effect"):
+        kdir = os.path.join(model_root, kind)
+        if not os.path.isdir(kdir):
+            continue
+        for name in os.listdir(kdir):
+            with open(os.path.join(kdir, name, "id-info")) as f:
+                for line in f:
+                    if line.startswith("featureShardId="):
+                        coord_shards[name] = line.strip().split("=", 1)[1]
+    coord_vocabs = {
+        name: shard_vocabs[shard] for name, shard in coord_shards.items()
+    }
+    params, shards, random_effects, entity_vocabs = load_game_model(
+        model_root, coord_vocabs
+    )
+    re_vocabs: Dict[str, dict] = {}
+    for re_key in sorted(
+        {re for re in random_effects.values() if re is not None}
+    ):
+        re_vocabs[re_key] = union_entity_vocab(
+            entity_vocabs[name]
+            for name, rk in random_effects.items()
+            if rk == re_key
+        )
+    for name, re_key in random_effects.items():
+        if re_key is None:
+            continue
+        shared = re_vocabs[re_key]
+        own = entity_vocabs[name]
+        p = params[name]
+        if is_factored_params(p):
+            params[name] = FactoredParams(
+                gamma=jnp.asarray(remap_entity_rows(p.gamma, own, shared)),
+                projection=p.projection,
+            )
+        else:
+            params[name] = remap_entity_rows(p, own, shared)
+    return params, shards, random_effects, shard_vocabs, re_vocabs
 
 
 def collapse_game_model(

@@ -38,6 +38,7 @@ class GracefulShutdown:
         self._logger = logger
         self._event = threading.Event()
         self._prev = {}
+        self._drain_hooks = []
         self.signum: Optional[int] = None
 
     # -- flag --------------------------------------------------------------
@@ -50,7 +51,37 @@ class GracefulShutdown:
         """Programmatic trigger (tests, cluster-manager hooks)."""
         if signum is not None:
             self.signum = signum
+        first = not self._event.is_set()
         self._event.set()
+        if first:
+            self.drain()
+
+    # -- drain hooks -------------------------------------------------------
+
+    def register_drain(self, hook):
+        """Register a callable to run when shutdown is requested — the
+        seam that lets subsystems with their own in-flight work (the
+        serving micro-batcher, stats flushers) join the graceful exit
+        WITHOUT re-installing signal handlers over the ones a driver
+        already armed. Hooks may run in signal-handler context, so they
+        must be non-blocking: set a flag, wake a worker — never join a
+        thread or wait on a queue. Returns the hook (decorator-friendly).
+        """
+        self._drain_hooks.append(hook)
+        return hook
+
+    def drain(self) -> None:
+        """Invoke every registered drain hook once (idempotent hooks are
+        the hooks' responsibility). Called automatically on the first
+        :meth:`request`; callable directly for tests and manual drains. A
+        raising hook is logged and skipped — one bad hook must not stop
+        the shutdown path."""
+        for hook in list(self._drain_hooks):
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001 — shutdown must proceed
+                if self._logger is not None:
+                    self._logger.warn(f"drain hook {hook!r} failed: {e}")
 
     def __call__(self) -> bool:
         """A GracefulShutdown IS a ``stop_check`` callable."""

@@ -1,0 +1,58 @@
+"""Online serving subsystem: device-resident GAME scoring (docs/SERVING.md).
+
+The offline drivers under ``cli/`` are batch jobs; this package is the
+resident low-latency path the ROADMAP's "serve heavy traffic" north star
+asks for:
+
+- :mod:`.engine`   — device-resident ScoringEngine; power-of-two padded
+  buckets so steady-state traffic never recompiles; cold-start entities
+  score fixed-effect-only (cogroup-with-default-0 semantics).
+- :mod:`.batcher`  — deadline micro-batching (max_batch / max_wait_ms),
+  bounded-queue backpressure, drain-on-SIGTERM.
+- :mod:`.registry` — versioned models, sha256-manifest-gated atomic
+  hot-reload, drain-before-retire.
+- :mod:`.stats`    — latency histograms (p50/p95/p99), QPS, batch
+  occupancy, bucket/compile counters; JSON snapshots.
+
+Entry points: ``python -m photon_ml_tpu.cli.serve`` and
+``benchmarks/serving_lab.py`` (closed-loop load generator).
+"""
+
+from photon_ml_tpu.serving.batcher import Backpressure, MicroBatcher
+from photon_ml_tpu.serving.engine import (
+    DEFAULT_MIN_BUCKET,
+    ScoreRequest,
+    ScoringEngine,
+    bucket_size,
+    pad_game_data,
+    warmup_buckets,
+)
+from photon_ml_tpu.serving.registry import (
+    ModelRegistry,
+    ModelVersion,
+    NoModelLoaded,
+)
+from photon_ml_tpu.serving.stats import (
+    LatencyHistogram,
+    ServingStats,
+    install_compile_listener,
+    xla_compile_events,
+)
+
+__all__ = [
+    "Backpressure",
+    "MicroBatcher",
+    "DEFAULT_MIN_BUCKET",
+    "ScoreRequest",
+    "ScoringEngine",
+    "bucket_size",
+    "pad_game_data",
+    "warmup_buckets",
+    "ModelRegistry",
+    "ModelVersion",
+    "NoModelLoaded",
+    "LatencyHistogram",
+    "ServingStats",
+    "install_compile_listener",
+    "xla_compile_events",
+]

@@ -1,0 +1,448 @@
+"""Device-resident online GAME scoring engine.
+
+The offline driver (``cli/score.py``) is a batch job: load model, score one
+big dataset, exit. The ROADMAP's north star — "serve heavy traffic from
+millions of users as fast as the hardware allows" — needs the opposite
+shape: a *resident* engine that loads the GAME model once, keeps it pinned
+on device, and answers small concurrent requests at low latency. Three
+design rules make that work:
+
+1. **Device residency.** The fixed-effect vector, every random-effect
+   table (pre-compacted through :class:`~photon_ml_tpu.game.scoring.
+   CompactReTable` — (E, k) active pairs instead of a dense (E, d) slab),
+   and factored latent tables are transferred once at construction and
+   passed to every call as device arrays; requests move only O(batch)
+   bytes host->device.
+
+2. **Power-of-two padded buckets.** XLA specializes each compiled
+   executable to static shapes, so naively scoring a 7-row batch then an
+   8-row batch recompiles. Every batch is padded to the next power of two
+   (floor ``min_bucket``), and the engine AOT-compiles one executable per
+   bucket (``jax.jit(...).lower(...).compile()``); after warmup on a fixed
+   bucket set, steady-state traffic NEVER recompiles — asserted in tests
+   against both the engine's own compile counter and the process-wide
+   ``jax.monitoring`` compile-event stream (:mod:`.stats`).
+
+3. **Cold-start = fixed-effect-only.** A request whose entity id is
+   unknown (or absent) carries index -1, and every random-effect kernel
+   scores it 0 — the reference's cogroup-with-default-0 semantics
+   (``model/RandomEffectModel.scala:117-146``), bit-identical to
+   ``score_game_data`` on the same rows.
+
+The engine is synchronous and thread-safe for scoring; coalescing of
+concurrent requests belongs to :mod:`.batcher`, versioning/hot-reload to
+:mod:`.registry`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.scoring import (
+    CompactReTable,
+    _factored_scores,
+    _fixed_scores,
+    _random_scores_compact_dense,
+    precompact_model,
+)
+from photon_ml_tpu.io.schemas import NAME_TERM_DELIMITER
+from photon_ml_tpu.serving.stats import ServingStats, install_compile_listener
+
+DEFAULT_MIN_BUCKET = 8
+DEFAULT_MAX_BUCKET = 1024
+
+
+def bucket_size(n: int, min_bucket: int = DEFAULT_MIN_BUCKET) -> int:
+    """Smallest power of two >= max(n, min_bucket) — the shared padded-batch
+    policy of the online engine AND the offline driver (``cli/score.py``),
+    so both hit the same compiled executables."""
+    if n <= 0:
+        raise ValueError(f"batch must be non-empty, got {n} rows")
+    return 1 << (max(n, min_bucket) - 1).bit_length()
+
+
+def warmup_buckets(
+    max_batch: int, min_bucket: int = DEFAULT_MIN_BUCKET
+) -> Sequence[int]:
+    """The power-of-two ladder [bucket_size(min_bucket) .. bucket_size(
+    max_batch)] — the fixed bucket set to precompile so any batch of at
+    most ``max_batch`` rows dispatches without compiling."""
+    out = []
+    b = bucket_size(1, min_bucket)
+    top = bucket_size(max_batch, min_bucket)
+    while b <= top:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _pad_rows(x: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    if x.shape[0] == rows:
+        return x
+    pad = np.full((rows - x.shape[0],) + x.shape[1:], fill, x.dtype)
+    return np.concatenate([x, pad], axis=0)
+
+
+def pad_game_data(data: GameData, rows: int) -> GameData:
+    """Pad every row-aligned column of a :class:`GameData` to ``rows``:
+    features with zero rows (ELL shards with all-pad rows), entity ids
+    with -1 (scores 0), labels/offsets/weights with 0. Padding is
+    algebraically invisible to scoring; callers slice scores back to the
+    real row count. Used by ``cli/score.py`` so ragged final batches land
+    on the same power-of-two executables as everything else."""
+    from photon_ml_tpu.ops.sparse import SparseFeatures, is_sparse, is_structured
+
+    n = data.num_rows
+    if rows == n:
+        return data
+    if rows < n:
+        raise ValueError(f"cannot pad {n} rows down to {rows}")
+    features = {}
+    for name, v in data.features.items():
+        if is_sparse(v):
+            extra = rows - v.indices.shape[0]
+            pad_i = jnp.full((extra, v.nnz_per_row), v.d, v.indices.dtype)
+            pad_v = jnp.zeros((extra, v.nnz_per_row), v.values.dtype)
+            features[name] = SparseFeatures(
+                indices=jnp.concatenate([v.indices, pad_i], axis=0),
+                values=jnp.concatenate([v.values, pad_v], axis=0),
+                d=v.d,
+            )
+        elif is_structured(v):
+            raise ValueError(
+                f"shard {name!r}: only dense and plain-ELL shards pad "
+                "(GameData already rejects hybrid containers)"
+            )
+        else:
+            features[name] = _pad_rows(np.asarray(v), rows)
+    return GameData(
+        features=features,
+        labels=_pad_rows(data.labels, rows),
+        offsets=_pad_rows(data.offsets, rows),
+        weights=_pad_rows(data.weights, rows),
+        entity_ids={
+            k: _pad_rows(v, rows, fill=-1)
+            for k, v in data.entity_ids.items()
+        },
+    )
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One scoring request.
+
+    features: feature -> value; keys are ``"name\\x01term"`` strings,
+        ``(name, term)`` tuples, or bare names (empty term). Applied
+        against every shard's vocabulary — each shard picks the features
+        it knows, exactly like ingest; unknown keys are ignored.
+    entities: random-effect type -> raw entity id (missing or unknown ids
+        score fixed-effect-only).
+    offset: added to the returned score (the data offset column).
+    """
+
+    features: Mapping
+    entities: Mapping = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+
+
+class ScoringEngine:
+    """In-process online scorer for one loaded GAME model version.
+
+    Construct from in-memory params (``ScoringEngine(params, shards,
+    random_effects, shard_vocabs, re_vocabs)``) or straight from a model
+    export directory (:meth:`from_model_dir`). Scoring entry points:
+
+    - :meth:`score` — featurize :class:`ScoreRequest` objects and score.
+    - :meth:`score_arrays` — pre-featurized (B, d) arrays per shard.
+    - :meth:`score_data` — a dense-sharded :class:`GameData` (offline
+      parity testing; returns margins WITHOUT offsets, like
+      ``score_game_data``).
+    """
+
+    def __init__(
+        self,
+        params: Dict[str, object],
+        shards: Dict[str, str],
+        random_effects: Dict[str, Optional[str]],
+        shard_vocabs: Optional[Dict[str, object]] = None,
+        re_vocabs: Optional[Dict[str, dict]] = None,
+        *,
+        dtype=jnp.float64,
+        min_bucket: int = DEFAULT_MIN_BUCKET,
+        max_bucket: int = DEFAULT_MAX_BUCKET,
+        device=None,
+        stats: Optional[ServingStats] = None,
+    ):
+        install_compile_listener()
+        self.dtype = jnp.empty((), dtype).dtype  # canonicalized (x64 seam)
+        self.min_bucket = min_bucket
+        self.max_bucket = max_bucket
+        self.shards = dict(shards)
+        self.random_effects = dict(random_effects)
+        self.shard_vocabs = dict(shard_vocabs or {})
+        self.re_vocabs = dict(re_vocabs or {})
+        self.stats = stats if stats is not None else ServingStats()
+        self._coord_order = sorted(params)
+
+        def put(x):
+            a = jnp.asarray(x)
+            return jax.device_put(a, device) if device is not None else a
+
+        # pre-compact every (E, d) table once, then pin all leaves device-
+        # resident at the serving dtype (int32 columns stay int32)
+        self._params: Dict[str, object] = {}
+        for name, p in precompact_model(params).items():
+            if isinstance(p, CompactReTable):
+                self._params[name] = CompactReTable(
+                    columns=put(np.asarray(p.columns, np.int32)),
+                    values=put(np.asarray(p.values, self.dtype)),
+                )
+            elif hasattr(p, "gamma"):  # FactoredParams
+                self._params[name] = type(p)(
+                    gamma=put(np.asarray(p.gamma, self.dtype)),
+                    projection=put(np.asarray(p.projection, self.dtype)),
+                )
+            else:
+                self._params[name] = put(np.asarray(p, self.dtype))
+        jax.block_until_ready(
+            [leaf for leaf in jax.tree_util.tree_leaves(self._params)]
+        )
+        self._used_shards = sorted(
+            {self.shards[name] for name in self._coord_order}
+        )
+        self._re_keys = sorted(
+            {rk for rk in self.random_effects.values() if rk is not None}
+        )
+        self._scorer = jax.jit(self._score_padded)
+        self._compiled: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self.compile_count = 0
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_model_dir(cls, root: str, **kw) -> "ScoringEngine":
+        """Load a GAME model export (training-output layout) and stand up
+        an engine over it. Integrity verification belongs to the registry
+        (:mod:`.registry`) — this loads whatever is on disk."""
+        from photon_ml_tpu.io.models import load_game_model_auto
+
+        params, shards, random_effects, shard_vocabs, re_vocabs = (
+            load_game_model_auto(root)
+        )
+        return cls(
+            params, shards, random_effects, shard_vocabs, re_vocabs, **kw
+        )
+
+    # -- traced scoring body ----------------------------------------------
+
+    def _score_padded(self, params, feats, ents):
+        """Pure traced body: sum of coordinate scores over padded (B, d)
+        dense shards. Shares kernels with ``score_game_data`` so online
+        and offline scores agree to float rounding."""
+        n = feats[self._used_shards[0]].shape[0]
+        total = jnp.zeros((n,), self.dtype)
+        for name in self._coord_order:
+            p = params[name]
+            f = feats[self.shards[name]]
+            re_key = self.random_effects.get(name)
+            if re_key is None:
+                total = total + _fixed_scores(p, f)
+            elif hasattr(p, "gamma"):
+                total = total + _factored_scores(
+                    p.gamma, p.projection, f, ents[re_key]
+                )
+            else:
+                total = total + _random_scores_compact_dense(
+                    p.columns, p.values, f, ents[re_key]
+                )
+        return total
+
+    # -- compilation cache -------------------------------------------------
+
+    def _ensure_compiled(self, bucket: int, dims: Optional[Dict[str, int]] = None):
+        """Executable for one padded bucket; ``dims`` (shard -> feature
+        dim) defaults to the vocabularies' lengths. Shard dims are a fixed
+        property of the model, so the cache keys on bucket alone."""
+        with self._lock:
+            hit = self._compiled.get(bucket)
+        if hit is not None:
+            self.stats.record_bucket(bucket, hit=True)
+            return hit
+        feats_s = {
+            s: jax.ShapeDtypeStruct(
+                (bucket, dims[s] if dims else self._shard_dim(s)), self.dtype
+            )
+            for s in self._used_shards
+        }
+        ents_s = {
+            rk: jax.ShapeDtypeStruct((bucket,), jnp.int32)
+            for rk in self._re_keys
+        }
+        compiled = self._scorer.lower(self._params, feats_s, ents_s).compile()
+        with self._lock:
+            prior = self._compiled.setdefault(bucket, compiled)
+        if prior is compiled:
+            self.compile_count += 1
+            self.stats.record_compile()
+        self.stats.record_bucket(bucket, hit=False)
+        return prior
+
+    def _shard_dim(self, shard: str) -> int:
+        """Feature dimension of a shard, from its vocab or its params."""
+        if shard in self.shard_vocabs:
+            return len(self.shard_vocabs[shard])
+        for name in self._coord_order:
+            if self.shards[name] != shard:
+                continue
+            p = self._params[name]
+            if isinstance(p, CompactReTable):
+                # compact pad column id == d by construction
+                raise ValueError(
+                    f"shard {shard!r}: dimension unknown without a "
+                    "vocabulary (compact tables do not carry d)"
+                )
+            if hasattr(p, "gamma"):
+                return p.projection.shape[0]
+            return int(np.shape(p)[-1])
+        raise KeyError(f"no coordinate uses shard {shard!r}")
+
+    def warmup(
+        self,
+        buckets: Optional[Sequence[int]] = None,
+        max_batch: Optional[int] = None,
+    ) -> Sequence[int]:
+        """AOT-compile the executables for a fixed bucket set (default:
+        the power-of-two ladder up to ``max_batch`` or ``max_bucket``).
+        After this, any batch of at most the largest warmed bucket scores
+        with zero compiles. Returns the warmed buckets."""
+        if buckets is None:
+            buckets = warmup_buckets(
+                max_batch or self.max_bucket, self.min_bucket
+            )
+        for b in buckets:
+            self._ensure_compiled(int(b))
+        return list(buckets)
+
+    # -- featurization (host-side, numpy only: no tracing on this path) ----
+
+    def _feature_index(self, shard: str, key) -> Optional[int]:
+        vocab = self.shard_vocabs[shard]
+        if isinstance(key, tuple):
+            return vocab.get(*key)
+        if NAME_TERM_DELIMITER not in key:
+            key = key + NAME_TERM_DELIMITER
+        return vocab.key_to_index.get(key)
+
+    def featurize(self, requests: Sequence[ScoreRequest]):
+        """Requests -> (dense (B, d) per shard, (B,) int32 per RE type,
+        (B,) offsets). Unknown feature keys are ignored (each shard picks
+        what its vocabulary knows, like ingest); unknown entity ids map to
+        -1 (cold start); shard intercept columns are set to 1.0 exactly as
+        ingest injects them."""
+        from photon_ml_tpu.io.models import _maybe_int
+
+        if not self.shard_vocabs:
+            raise ValueError(
+                "featurize needs shard vocabularies; construct the engine "
+                "with shard_vocabs or use score_arrays/score_data"
+            )
+        b = len(requests)
+        feats = {
+            s: np.zeros((b, len(self.shard_vocabs[s])), self.dtype)
+            for s in self._used_shards
+        }
+        for s in self._used_shards:
+            icpt = self.shard_vocabs[s].intercept_index
+            if icpt is not None:
+                feats[s][:, icpt] = 1.0
+        for i, r in enumerate(requests):
+            for key, val in r.features.items():
+                for s in self._used_shards:
+                    j = self._feature_index(s, key)
+                    if j is not None:
+                        feats[s][i, j] = val
+        ents = {
+            rk: np.full(b, -1, np.int32) for rk in self._re_keys
+        }
+        for rk in self._re_keys:
+            vocab = self.re_vocabs.get(rk, {})
+            col = ents[rk]
+            for i, r in enumerate(requests):
+                raw = r.entities.get(rk)
+                if raw is None:
+                    continue
+                e = vocab.get(raw)
+                if e is None:
+                    e = vocab.get(_maybe_int(raw))
+                if e is not None:
+                    col[i] = e
+        offsets = np.asarray([r.offset for r in requests], np.float64)
+        return feats, ents, offsets
+
+    # -- scoring -----------------------------------------------------------
+
+    def score_arrays(
+        self,
+        features: Dict[str, np.ndarray],
+        entity_ids: Optional[Dict[str, np.ndarray]] = None,
+        offsets: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Score pre-featurized dense rows. ``features`` maps every shard
+        the model uses to a (B, d_shard) array; ``entity_ids`` maps each
+        random-effect type to (B,) int32 indices (-1 = unknown). Returns
+        (B,) float scores (+ offsets when given)."""
+        entity_ids = entity_ids or {}
+        missing = [s for s in self._used_shards if s not in features]
+        if missing:
+            raise KeyError(f"missing feature shard(s): {missing}")
+        n = int(np.shape(features[self._used_shards[0]])[0])
+        bucket = bucket_size(n, self.min_bucket)
+        feats_p = {
+            s: _pad_rows(np.asarray(features[s], self.dtype), bucket)
+            for s in self._used_shards
+        }
+        ents_p = {}
+        for rk in self._re_keys:
+            col = entity_ids.get(rk)
+            col = (
+                np.full(n, -1, np.int32)
+                if col is None
+                else np.asarray(col, np.int32)
+            )
+            ents_p[rk] = _pad_rows(col, bucket, fill=-1)
+        compiled = self._ensure_compiled(
+            bucket, {s: feats_p[s].shape[1] for s in self._used_shards}
+        )
+        out = np.asarray(compiled(self._params, feats_p, ents_p))[:n]
+        if offsets is not None:
+            out = out + np.asarray(offsets, out.dtype)
+        return out
+
+    def score(self, requests: Sequence[ScoreRequest]) -> np.ndarray:
+        """Featurize and score a batch of requests (scores include each
+        request's offset)."""
+        feats, ents, offsets = self.featurize(requests)
+        return self.score_arrays(feats, ents, offsets)
+
+    def score_data(self, data: GameData) -> np.ndarray:
+        """Score a dense-sharded :class:`GameData` through the bucketed
+        online path; returns margins WITHOUT offsets — directly comparable
+        to ``score_game_data`` on the same data."""
+        from photon_ml_tpu.ops.sparse import is_structured
+
+        for s in self._used_shards:
+            if is_structured(data.features[s]):
+                raise ValueError(
+                    f"shard {s!r}: the online engine featurizes densely; "
+                    "score structured shards through score_game_data"
+                )
+        feats = {s: np.asarray(data.features[s]) for s in self._used_shards}
+        return self.score_arrays(feats, dict(data.entity_ids))

@@ -1,0 +1,246 @@
+"""Serving telemetry: latency histograms, QPS, batching/bucket counters.
+
+The online engine's contract is "steady-state traffic never recompiles and
+tail latency is bounded" — both are claims about *distributions*, so the
+subsystem carries its own measurement: log-spaced latency histograms with
+p50/p95/p99 readout, queue-wait vs device-call split, micro-batch occupancy,
+bucket hit/miss counters, and an XLA compile counter fed straight from
+``jax.monitoring`` (the same event stream the zero-recompile test asserts
+on). Everything is lock-guarded and snapshot-able as plain JSON for the
+``cli/serve`` stats endpoint and ``benchmarks/serving_lab.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import threading
+import time
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# XLA compile events (jax.monitoring)
+# ---------------------------------------------------------------------------
+
+# every backend compile fires this duration event exactly once (jax 0.4.x);
+# tracing-only events are deliberately excluded — a cache-hit retrace that
+# does not reach XLA costs microseconds, a backend compile costs seconds
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_compile_lock = threading.Lock()
+_compile_events = 0
+_listener_installed = False
+
+
+def _on_event_duration(name: str, _secs: float, **_kw) -> None:
+    global _compile_events
+    if name == _COMPILE_EVENT:
+        with _compile_lock:
+            _compile_events += 1
+
+
+def install_compile_listener() -> None:
+    """Idempotently register the jax.monitoring listener that feeds
+    :func:`xla_compile_events`. Listener registration is global and
+    permanent in jax, so this installs exactly once per process."""
+    global _listener_installed
+    with _compile_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+
+
+def xla_compile_events() -> int:
+    """Process-wide count of XLA backend compiles observed since
+    :func:`install_compile_listener` — the ground truth the engine's own
+    per-instance ``compile_count`` is cross-checked against in tests."""
+    with _compile_lock:
+        return _compile_events
+
+
+# ---------------------------------------------------------------------------
+# Latency histogram
+# ---------------------------------------------------------------------------
+
+
+class LatencyHistogram:
+    """Log-spaced latency histogram (milliseconds) with quantile readout.
+
+    Fixed geometric bucket edges keep recording O(1) and lock-cheap; the
+    quantile interpolates within the winning bucket, so resolution is the
+    edge ratio (~12% at the default 64 bins over 1e-3..6e4 ms) — plenty
+    for p99 dashboards, and bounded memory regardless of request count.
+    NOT thread-safe on its own; :class:`ServingStats` holds the lock.
+    """
+
+    def __init__(
+        self, lo_ms: float = 1e-3, hi_ms: float = 6e4, bins: int = 64
+    ):
+        self._lo = math.log(lo_ms)
+        self._span = math.log(hi_ms) - self._lo
+        self._bins = bins
+        self.counts = [0] * (bins + 2)  # + underflow/overflow
+        self.count = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+
+    def _edge(self, i: int) -> float:
+        return math.exp(self._lo + self._span * i / self._bins)
+
+    def record(self, ms: float) -> None:
+        self.count += 1
+        self.sum_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+        if ms <= 0:
+            b = 0
+        else:
+            f = (math.log(ms) - self._lo) / self._span
+            b = min(max(int(f * self._bins) + 1, 0), self._bins + 1)
+        self.counts[b] += 1
+
+    def quantile(self, q: float) -> float:
+        """q in [0, 1] -> latency in ms (0.0 when empty)."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for b, c in enumerate(self.counts):
+            seen += c
+            if seen >= target and c > 0:
+                if b == 0:
+                    return self._edge(0)
+                if b == self._bins + 1:
+                    return self.max_ms
+                # geometric midpoint of the winning bucket
+                return math.sqrt(self._edge(b - 1) * self._edge(b))
+        return self.max_ms
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean_ms": self.sum_ms / self.count if self.count else 0.0,
+            "p50_ms": round(self.quantile(0.50), 4),
+            "p95_ms": round(self.quantile(0.95), 4),
+            "p99_ms": round(self.quantile(0.99), 4),
+            "max_ms": round(self.max_ms, 4),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Aggregate serving stats
+# ---------------------------------------------------------------------------
+
+
+class ServingStats:
+    """Thread-safe counters + histograms for one serving process.
+
+    - ``request_ms``: end-to-end per-request latency (enqueue -> result).
+    - ``device_ms``: per-micro-batch device call (featurize + dispatch).
+    - occupancy: rows per micro-batch (how well coalescing works).
+    - buckets: padded-size hit/miss counters; a miss is a NEW compile.
+    """
+
+    def __init__(self, qps_window: int = 4096):
+        self._lock = threading.Lock()
+        self.started = time.monotonic()
+        self.requests = 0
+        self.batches = 0
+        self.rejected = 0  # backpressure: bounded queue was full
+        self.errors = 0
+        self.compile_count = 0
+        self.bucket_hits = 0
+        self.bucket_misses = 0
+        self.reloads = 0
+        self.occupancy_sum = 0
+        self.bucket_counts: Dict[int, int] = collections.Counter()
+        self.request_ms = LatencyHistogram()
+        self.device_ms = LatencyHistogram()
+        self._recent = collections.deque(maxlen=qps_window)
+
+    # -- recording ---------------------------------------------------------
+
+    def record_batch(self, size: int, device_s: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.batches += 1
+            self.requests += size
+            self.occupancy_sum += size
+            self.device_ms.record(device_s * 1e3)
+            self._recent.extend([now] * size)
+
+    def record_request_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.request_ms.record(seconds * 1e3)
+
+    def record_bucket(self, bucket: int, hit: bool) -> None:
+        with self._lock:
+            self.bucket_counts[bucket] += 1
+            if hit:
+                self.bucket_hits += 1
+            else:
+                self.bucket_misses += 1
+
+    def record_compile(self) -> None:
+        with self._lock:
+            self.compile_count += 1
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_reload(self) -> None:
+        with self._lock:
+            self.reloads += 1
+
+    # -- readout -----------------------------------------------------------
+
+    def qps(self) -> float:
+        """Recent throughput over the sliding request window (falls back
+        to lifetime mean while the window is still filling)."""
+        with self._lock:
+            if len(self._recent) >= 2:
+                span = self._recent[-1] - self._recent[0]
+                if span > 0:
+                    return (len(self._recent) - 1) / span
+            elapsed = time.monotonic() - self.started
+            return self.requests / elapsed if elapsed > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        qps = self.qps()
+        with self._lock:
+            return {
+                "uptime_s": round(time.monotonic() - self.started, 3),
+                "requests": self.requests,
+                "batches": self.batches,
+                "rejected": self.rejected,
+                "errors": self.errors,
+                "reloads": self.reloads,
+                "qps": round(qps, 2),
+                "batch_occupancy_mean": (
+                    self.occupancy_sum / self.batches if self.batches else 0.0
+                ),
+                "buckets": {
+                    str(k): v for k, v in sorted(self.bucket_counts.items())
+                },
+                "bucket_hits": self.bucket_hits,
+                "bucket_misses": self.bucket_misses,
+                "compile_count": self.compile_count,
+                "request_latency": self.request_ms.snapshot(),
+                "device_latency": self.device_ms.snapshot(),
+            }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.snapshot(), **kw)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json(indent=2))

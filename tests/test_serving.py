@@ -1,0 +1,744 @@
+"""Online serving subsystem drills (docs/SERVING.md).
+
+The contracts under test, per coordinate of the subsystem:
+
+- engine: online scores == offline ``score_game_data`` to 1e-10 including
+  cold-start entities; after warmup on a fixed bucket set, 1000 mixed-size
+  calls trigger ZERO new XLA compilations (asserted against both the
+  engine's compile counter and the process-wide jax.monitoring stream).
+- batcher: concurrent requests coalesce into one device call; the bounded
+  queue backpressures; drain-on-shutdown completes every accepted request.
+- registry: hot-reload under concurrent load drops zero requests; an
+  export whose sha256 manifest fails verification can never serve.
+- offline driver: scoring batches pad to the same power-of-two buckets.
+"""
+
+import json
+import os
+import threading
+import time
+import weakref
+from io import StringIO
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game.data import GameData
+from photon_ml_tpu.game.factored import FactoredParams
+from photon_ml_tpu.game.scoring import (
+    CompactReTable,
+    _COMPACT_CACHE,
+    _compact_table,
+    _compact_table_cached,
+    precompact_model,
+    score_game_data,
+)
+from photon_ml_tpu.io.models import (
+    ModelIntegrityError,
+    save_game_model,
+    verify_model_manifest,
+    write_model_manifest,
+)
+from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+from photon_ml_tpu.serving import (
+    Backpressure,
+    MicroBatcher,
+    ModelRegistry,
+    ScoreRequest,
+    ScoringEngine,
+    bucket_size,
+    pad_game_data,
+    warmup_buckets,
+    xla_compile_events,
+)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def _dense_model(rng, n_users=6, d_g=5, d_u=4, latent_k=2):
+    params = {
+        "global": rng.normal(size=d_g),
+        "per-user": rng.normal(size=(n_users, d_u))
+        * (rng.uniform(size=(n_users, d_u)) < 0.5),
+        "fact": FactoredParams(
+            gamma=jnp.asarray(rng.normal(size=(n_users, latent_k))),
+            projection=jnp.asarray(rng.normal(size=(d_u, latent_k))),
+        ),
+    }
+    shards = {"global": "g", "per-user": "u", "fact": "u"}
+    res = {"global": None, "per-user": "userId", "fact": "userId"}
+    return params, shards, res
+
+
+def _dense_data(rng, n, d_g=5, d_u=4, n_users=6, cold_every=4):
+    ents = rng.integers(0, n_users, size=n).astype(np.int32)
+    ents[::cold_every] = -1  # cold-start rows
+    return GameData.create(
+        features={
+            "g": rng.normal(size=(n, d_g)),
+            "u": rng.normal(size=(n, d_u)),
+        },
+        labels=np.zeros(n),
+        entity_ids={"userId": ents},
+    )
+
+
+def _save_disk_model(root, rng, scale=1.0, n_users=4, d_u=3):
+    """GAME export on disk (fixed + random effect), vocabs + manifest."""
+    u_vocab = FeatureVocabulary(
+        [feature_key(f"uf{j}", "") for j in range(d_u)]
+    )
+    table = scale * np.arange(1, n_users * d_u + 1, dtype=float).reshape(
+        n_users, d_u
+    )
+    save_game_model(
+        root,
+        params={"global": scale * np.asarray([1.0, 2.0, 3.0]),
+                "per-user": table},
+        shards={"global": "us", "per-user": "us"},
+        vocabs={"global": u_vocab, "per-user": u_vocab},
+        entity_vocabs={"per-user": {f"u{i}": i for i in range(n_users)}},
+        random_effects={"global": None, "per-user": "userId"},
+    )
+    u_vocab.save(os.path.join(root, "feature-index-us.txt"))
+    write_model_manifest(root)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# bucketing helpers
+# ---------------------------------------------------------------------------
+
+
+class TestBucketing:
+    def test_bucket_size_is_pow2_with_floor(self):
+        assert bucket_size(1) == 8  # default min_bucket
+        assert bucket_size(8) == 8
+        assert bucket_size(9) == 16
+        assert bucket_size(100) == 128
+        assert bucket_size(3, min_bucket=1) == 4
+        assert bucket_size(1, min_bucket=1) == 1
+        with pytest.raises(ValueError):
+            bucket_size(0)
+
+    def test_warmup_ladder(self):
+        assert list(warmup_buckets(64)) == [8, 16, 32, 64]
+        assert list(warmup_buckets(100)) == [8, 16, 32, 64, 128]
+
+    def test_pad_game_data_dense_and_sparse(self, rng):
+        from photon_ml_tpu.ops.sparse import SparseFeatures
+
+        n, d = 5, 7
+        idx = rng.integers(0, d, size=(n, 3)).astype(np.int32)
+        vals = rng.normal(size=(n, 3))
+        sf = SparseFeatures(
+            indices=jnp.asarray(np.sort(idx, axis=1)),
+            values=jnp.asarray(vals),
+            d=d,
+        )
+        data = GameData.create(
+            features={"dense": rng.normal(size=(n, 4)), "ell": sf},
+            labels=np.arange(n, dtype=float),
+            entity_ids={"userId": np.asarray([0, 1, -1, 2, 0], np.int32)},
+        )
+        padded = pad_game_data(data, 8)
+        assert padded.num_rows == 8
+        assert np.all(np.asarray(padded.entity_ids["userId"])[5:] == -1)
+        assert np.all(np.asarray(padded.features["dense"])[5:] == 0)
+        assert np.all(np.asarray(padded.features["ell"].indices)[5:] == d)
+        # padding is algebraically invisible to scoring
+        w = rng.normal(size=d)
+        table = rng.normal(size=(3, 4))
+        params = {"fe": w, "re": table}
+        shards = {"fe": "ell", "re": "dense"}
+        res = {"fe": None, "re": "userId"}
+        base = np.asarray(score_game_data(params, shards, res, data))
+        pad = np.asarray(score_game_data(params, shards, res, padded))
+        np.testing.assert_allclose(pad[:n], base, rtol=1e-12)
+        np.testing.assert_allclose(pad[n:], 0.0, atol=0)
+        with pytest.raises(ValueError):
+            pad_game_data(data, 3)
+
+
+# ---------------------------------------------------------------------------
+# engine: offline/online parity + cold start
+# ---------------------------------------------------------------------------
+
+
+class TestEngineParity:
+    def test_engine_matches_score_game_data(self, rng):
+        params, shards, res = _dense_model(rng)
+        data = _dense_data(rng, n=23)
+        offline = np.asarray(score_game_data(params, shards, res, data))
+        engine = ScoringEngine(params, shards, res)
+        online = engine.score_data(data)
+        np.testing.assert_allclose(online, offline, rtol=1e-10, atol=1e-12)
+
+    def test_cold_start_is_fixed_effect_only_both_paths(self, rng):
+        """Unknown entities (index -1) score identically to a fixed-only
+        model, and offline == online to 1e-12."""
+        params, shards, res = _dense_model(rng)
+        data = _dense_data(rng, n=9, cold_every=1)  # ALL rows cold
+        offline = np.asarray(score_game_data(params, shards, res, data))
+        fixed_only = np.asarray(
+            score_game_data(
+                {"global": params["global"]},
+                {"global": "g"},
+                {"global": None},
+                data,
+            )
+        )
+        np.testing.assert_allclose(offline, fixed_only, rtol=1e-12)
+        engine = ScoringEngine(params, shards, res)
+        np.testing.assert_allclose(
+            engine.score_data(data), offline, rtol=1e-12, atol=1e-14
+        )
+
+    def test_engine_from_model_dir_matches_offline(self, rng, tmp_path):
+        root = _save_disk_model(str(tmp_path / "model"), rng)
+        from photon_ml_tpu.io.models import load_game_model_auto
+
+        params, shards, res, shard_vocabs, re_vocabs = load_game_model_auto(
+            root
+        )
+        n = 11
+        ents = np.asarray(
+            [0, 1, 2, 3, -1, 0, 1, -1, 2, 3, 0], np.int32
+        )
+        data = GameData.create(
+            features={"us": rng.normal(size=(n, 3))},
+            labels=np.zeros(n),
+            entity_ids={"userId": ents},
+        )
+        offline = np.asarray(score_game_data(params, shards, res, data))
+        engine = ScoringEngine.from_model_dir(root)
+        np.testing.assert_allclose(
+            engine.score_data(data), offline, rtol=1e-10, atol=1e-12
+        )
+
+    def test_featurize_requests(self, rng, tmp_path):
+        """Key forms (tuple / delimited / bare name), unknown features
+        ignored, unknown entity ids -> cold start, offsets added."""
+        root = _save_disk_model(str(tmp_path / "model"), rng)
+        engine = ScoringEngine.from_model_dir(root)
+        reqs = [
+            ScoreRequest(
+                features={("uf0", ""): 2.0, "uf1": 3.0, "nosuch": 9.9},
+                entities={"userId": "u1"},
+                offset=0.5,
+            ),
+            ScoreRequest(
+                features={"uf0\x01": 1.0},
+                entities={"userId": "never-seen"},
+            ),
+        ]
+        got = engine.score(reqs)
+        # u1 row of the table is [4, 5, 6]; fixed effect [1, 2, 3]
+        want0 = (2 * 1 + 3 * 2) + (2 * 4 + 3 * 5) + 0.5
+        want1 = 1 * 1  # cold start: fixed only
+        np.testing.assert_allclose(got, [want0, want1], rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# engine: zero recompiles after warmup
+# ---------------------------------------------------------------------------
+
+
+class TestZeroRecompile:
+    def test_1000_mixed_size_calls_zero_new_compiles(self, rng):
+        params, shards, res = _dense_model(rng, n_users=8, d_g=6, d_u=4)
+        engine = ScoringEngine(params, shards, res)
+        warmed = engine.warmup(max_batch=128)
+        assert list(warmed) == [8, 16, 32, 64, 128]
+        assert engine.compile_count == len(warmed)
+
+        pool_g = rng.normal(size=(128, 6))
+        pool_u = rng.normal(size=(128, 4))
+        pool_e = rng.integers(-1, 8, size=128).astype(np.int32)
+        probe_sizes = []
+        compiles_engine = engine.compile_count
+        compiles_xla = xla_compile_events()
+        for i in range(1000):
+            n = 1 + (i * 37) % 128
+            probe_sizes.append(n)
+            engine.score_arrays(
+                {"g": pool_g[:n], "u": pool_u[:n]},
+                {"userId": pool_e[:n]},
+            )
+        assert engine.compile_count == compiles_engine, "engine recompiled"
+        assert xla_compile_events() == compiles_xla, (
+            "XLA compiled during steady-state serving (jax.monitoring)"
+        )
+        assert len(set(bucket_size(n) for n in probe_sizes)) == 5
+        assert engine.stats.bucket_misses == len(warmed)
+        assert engine.stats.bucket_hits >= 1000
+        # and the scores coming off the padded path are still right
+        n = 77
+        data = GameData.create(
+            features={"g": pool_g[:n], "u": pool_u[:n]},
+            labels=np.zeros(n),
+            entity_ids={"userId": pool_e[:n]},
+        )
+        np.testing.assert_allclose(
+            engine.score_data(data),
+            np.asarray(score_game_data(params, shards, res, data)),
+            rtol=1e-10,
+            atol=1e-12,
+        )
+
+
+# ---------------------------------------------------------------------------
+# precompaction
+# ---------------------------------------------------------------------------
+
+
+class TestPrecompact:
+    def test_precompact_model_compacts_only_re_tables(self, rng):
+        params, shards, res = _dense_model(rng)
+        out = precompact_model(params)
+        assert isinstance(out["per-user"], CompactReTable)
+        assert out["global"] is params["global"]
+        assert out["fact"] is params["fact"]
+        # already-compact tables pass through
+        again = precompact_model(out)
+        assert again["per-user"] is out["per-user"]
+        # compact columns/values reproduce the dense table
+        e, d = np.shape(params["per-user"])
+        dense = np.zeros((e, d))
+        cols = np.asarray(out["per-user"].columns)
+        vals = np.asarray(out["per-user"].values)
+        for i in range(e):
+            for c, v in zip(cols[i], vals[i]):
+                if c < d:
+                    dense[i, c] += v
+        np.testing.assert_allclose(dense, np.asarray(params["per-user"]))
+
+    def test_compact_cache_id_recycling_guard(self, rng):
+        """A stale cache entry whose weakref points at a DIFFERENT (dead
+        or recycled) table must not serve: the identity check re-compacts."""
+        t1 = rng.normal(size=(4, 6)) * (rng.uniform(size=(4, 6)) < 0.5)
+        t1.flags.writeable = False
+        t2 = rng.normal(size=(4, 6)) * (rng.uniform(size=(4, 6)) < 0.5)
+        t2.flags.writeable = False
+        sentinel = CompactReTable(
+            np.zeros((1, 1), np.int32), np.zeros((1, 1))
+        )
+        key = id(t2)
+        # simulate id recycling: the slot for t2's id holds an entry made
+        # for t1 (as after t_old died and the allocator reused its id
+        # before the weakref callback pruned the slot)
+        _COMPACT_CACHE[key] = (weakref.ref(t1), sentinel)
+        try:
+            got = _compact_table_cached(t2)
+            assert got is not sentinel
+            cols, vals = _compact_table(np.asarray(t2))
+            np.testing.assert_array_equal(np.asarray(got.columns), cols)
+            np.testing.assert_allclose(np.asarray(got.values), vals)
+        finally:
+            _COMPACT_CACHE.pop(key, None)
+        # the guard replaced the stale entry with a live one for t2
+        del t1
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+
+class TestMicroBatcher:
+    def test_coalesces_queued_requests_into_one_call(self, rng):
+        calls = []
+
+        def score_fn(reqs):
+            calls.append(len(reqs))
+            return np.asarray([float(r) * 2 for r in reqs])
+
+        b = MicroBatcher(
+            score_fn, max_batch=16, max_wait_ms=5.0, auto_start=False
+        )
+        futs = [b.submit(i) for i in range(10)]
+        b.start()
+        assert [f.result(timeout=10) for f in futs] == [
+            2.0 * i for i in range(10)
+        ]
+        assert b.drain()
+        assert calls and max(calls) > 1, f"no coalescing: {calls}"
+        assert sum(calls) == 10
+        assert b.stats.batches == len(calls)
+        assert b.stats.requests == 10
+
+    def test_backpressure_bounded_queue(self):
+        b = MicroBatcher(
+            lambda reqs: np.zeros(len(reqs)),
+            queue_depth=4,
+            auto_start=False,
+        )
+        for i in range(4):
+            b.submit(i)
+        with pytest.raises(Backpressure, match="full"):
+            b.submit(99)
+        assert b.stats.rejected == 1
+        b.start()
+        assert b.drain()
+
+    def test_score_errors_propagate_to_futures(self):
+        def boom(reqs):
+            raise RuntimeError("device on fire")
+
+        b = MicroBatcher(boom, auto_start=False)
+        f = b.submit(1)
+        b.start()
+        with pytest.raises(RuntimeError, match="device on fire"):
+            f.result(timeout=10)
+        b.drain()
+        assert b.stats.errors == 1
+
+    def test_drain_on_shutdown_drops_nothing(self):
+        """GracefulShutdown.register_drain -> begin_drain: queued requests
+        complete, new ones are refused, no signal-handler monkey-patching."""
+        from photon_ml_tpu.resilience import GracefulShutdown
+
+        b = MicroBatcher(
+            lambda reqs: np.asarray([float(r) for r in reqs]),
+            auto_start=False,
+        )
+        shutdown = GracefulShutdown()
+        shutdown.register_drain(b.begin_drain)
+        futs = [b.submit(i) for i in range(5)]
+        shutdown.request()  # as the SIGTERM handler would
+        with pytest.raises(Backpressure, match="draining"):
+            b.submit(99)
+        b.start()
+        assert b.drain()
+        assert [f.result(timeout=10) for f in futs] == [
+            float(i) for i in range(5)
+        ]
+
+    def test_drain_hook_errors_do_not_block_shutdown(self):
+        from photon_ml_tpu.resilience import GracefulShutdown
+
+        shutdown = GracefulShutdown()
+        fired = []
+        shutdown.register_drain(lambda: 1 / 0)
+        shutdown.register_drain(lambda: fired.append(True))
+        shutdown.request()
+        assert shutdown.requested and fired == [True]
+        # hooks fire once on the FIRST request only
+        shutdown.request()
+        assert fired == [True]
+
+
+# ---------------------------------------------------------------------------
+# registry: integrity-gated hot-reload
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_manifest_roundtrip_and_tamper_detection(self, rng, tmp_path):
+        root = _save_disk_model(str(tmp_path / "m"), rng)
+        digests = verify_model_manifest(root)
+        assert any("coefficients" in k for k in digests)
+        # tamper -> digest mismatch
+        victim = os.path.join(
+            root, "random-effect", "per-user", "coefficients",
+            "part-00000.avro",
+        )
+        blob = bytearray(open(victim, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(blob))
+        with pytest.raises(ModelIntegrityError, match="digest mismatch"):
+            verify_model_manifest(root)
+        # missing file
+        os.remove(victim)
+        with pytest.raises(ModelIntegrityError, match="missing"):
+            verify_model_manifest(root)
+        # absent manifest
+        assert verify_model_manifest(str(tmp_path), require=False) == {}
+        with pytest.raises(ModelIntegrityError, match="no model-manifest"):
+            verify_model_manifest(str(tmp_path))
+
+    def test_bad_export_never_serves(self, rng, tmp_path):
+        root_a = _save_disk_model(str(tmp_path / "v1"), rng, scale=1.0)
+        root_b = _save_disk_model(str(tmp_path / "v2"), rng, scale=2.0)
+        # corrupt v2 AFTER manifesting (a torn/partial write)
+        victim = os.path.join(
+            root_b, "fixed-effect", "global", "coefficients",
+            "part-00000.avro",
+        )
+        blob = bytearray(open(victim, "rb").read())
+        blob[-3] ^= 0xFF
+        open(victim, "wb").write(bytes(blob))
+
+        reg = ModelRegistry(warmup_max_batch=8)
+        reg.load(root_a)
+        probe = ScoreRequest(features={"uf0": 1.0}, entities={})
+        s_a = reg.score([probe])[0]
+        with pytest.raises(ModelIntegrityError):
+            reg.load(root_b)
+        assert reg.version() == "v1"
+        assert reg.score([probe])[0] == s_a
+        # poll() skips the bad candidate and keeps serving
+        assert reg.poll(str(tmp_path)) is None
+        assert reg.version() == "v1"
+
+    def test_hot_reload_under_concurrent_load_drops_nothing(
+        self, rng, tmp_path
+    ):
+        """The smoke drill: engine up, traffic flowing through the
+        batcher, hot-reload mid-flight — every request resolves, each to
+        either the old or the new model's score, old version retires
+        only after its in-flight requests drain."""
+        root_a = _save_disk_model(str(tmp_path / "v1"), rng, scale=1.0)
+        root_b = _save_disk_model(str(tmp_path / "v2"), rng, scale=3.0)
+        reg = ModelRegistry(warmup_max_batch=16)
+        v1 = reg.load(root_a)
+        probe = ScoreRequest(
+            features={"uf0": 1.0, "uf2": 0.5}, entities={"userId": "u2"}
+        )
+        s_a = reg.score([probe])[0]
+        s_b = ScoringEngine.from_model_dir(root_b).score([probe])[0]
+        assert abs(s_a - s_b) > 1e-6
+
+        batcher = MicroBatcher(
+            reg.score, max_batch=16, max_wait_ms=0.5, stats=reg.stats
+        )
+        results = [[] for _ in range(4)]
+        errors = []
+
+        def client(ci):
+            try:
+                for _ in range(40):
+                    results[ci].append(
+                        batcher.submit(probe).result(timeout=30)
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(ci,)) for ci in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.02)
+        v2 = reg.load(root_b)  # hot-reload mid-storm
+        for t in threads:
+            t.join()
+        assert batcher.drain()
+        assert not errors, errors
+        flat = [s for chunk in results for s in chunk]
+        assert len(flat) == 160, "requests were dropped"
+        for s in flat:
+            assert min(abs(s - s_a), abs(s - s_b)) < 1e-9
+        # the swap is visible and the old version fully retired
+        assert reg.version() == "v2"
+        assert abs(reg.score([probe])[0] - s_b) < 1e-9
+        assert v1.retired and v1.engine is None and v1.inflight == 0
+        assert reg.retired_versions == ["v1"]
+        assert v2.inflight == 0
+        assert reg.stats.reloads == 1
+
+    def test_poll_watch_root_picks_up_new_version(self, rng, tmp_path):
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        _save_disk_model(str(watch / "000"), rng, scale=1.0)
+        reg = ModelRegistry(warmup_max_batch=8)
+        assert reg.poll(str(watch)) == "000"
+        assert reg.poll(str(watch)) is None  # already current
+        _save_disk_model(str(watch / "001"), rng, scale=2.0)
+        assert reg.poll(str(watch)) == "001"
+        assert reg.version() == "001"
+
+
+# ---------------------------------------------------------------------------
+# serve CLI plumbing (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestServeStream:
+    def test_serve_lines_json_protocol(self, rng, tmp_path):
+        from photon_ml_tpu.cli.serve import serve_lines
+
+        root = _save_disk_model(str(tmp_path / "m"), rng)
+        reg = ModelRegistry(warmup_max_batch=8)
+        reg.load(root)
+        batcher = MicroBatcher(reg.score, max_wait_ms=0.5, stats=reg.stats)
+        lines = [
+            json.dumps(
+                {"features": {"uf0": 1.0}, "entities": {"userId": "u0"}}
+            ),
+            json.dumps({"features": {"uf1": 2.0}, "offset": 1.0}),
+            json.dumps({"cmd": "version"}),
+            json.dumps({"cmd": "stats"}),
+            "this is not json",
+            json.dumps({"cmd": "nope"}),
+        ]
+        out = StringIO()
+        scored = serve_lines(iter(lines), out, batcher, reg, reg.stats)
+        batcher.drain()
+        replies = [json.loads(s) for s in out.getvalue().splitlines()]
+        assert scored == 2
+        expect0 = reg.score(
+            [ScoreRequest({"uf0": 1.0}, {"userId": "u0"})]
+        )[0]
+        assert abs(replies[0]["score"] - expect0) < 1e-9
+        assert abs(replies[1]["score"] - (2.0 * 2 + 1.0)) < 1e-9
+        assert replies[2] == {"version": "m"}
+        # stats snapshot at read time: structural keys, not exact counts
+        assert "request_latency" in replies[3] and "qps" in replies[3]
+        assert "bad JSON" in replies[4]["error"]
+        assert "unknown cmd" in replies[5]["error"]
+
+    def test_interactive_client_gets_prompt_reply(self, rng, tmp_path):
+        """A request/response client (send one, wait for its score, send
+        the next) must not deadlock on the pipelining window — replies
+        stream out as futures resolve, not at EOF (regression: responses
+        were only flushed when `window` requests piled up or the input
+        stream ended)."""
+        from photon_ml_tpu.cli.serve import serve_lines
+
+        root = _save_disk_model(str(tmp_path / "m"), rng)
+        engine = ScoringEngine.from_model_dir(root)
+        batcher = MicroBatcher(engine.score, max_wait_ms=0.5)
+
+        class Out:
+            def __init__(self):
+                self.lines = []
+                self.got_reply = threading.Event()
+
+            def write(self, s):
+                self.lines.append(s)
+                self.got_reply.set()
+
+            def flush(self):
+                pass
+
+        out = Out()
+
+        def client_lines():
+            yield json.dumps({"features": {"uf0": 1.0}})
+            if not out.got_reply.wait(timeout=10):
+                raise AssertionError(
+                    "no reply to the first request before the second "
+                    "was even sent — interactive serving deadlocked"
+                )
+            yield json.dumps({"features": {"uf1": 1.0}})
+
+        scored = serve_lines(client_lines(), out, batcher)
+        batcher.drain()
+        assert scored == 2
+        replies = [json.loads(s) for s in out.lines]
+        assert abs(replies[0]["score"] - 1.0) < 1e-9  # fixed [1,2,3]·e0
+        assert abs(replies[1]["score"] - 2.0) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# offline driver shares the buckets
+# ---------------------------------------------------------------------------
+
+
+class TestOfflineBucketing:
+    def _write_scoring_input(self, rng, path, n):
+        from photon_ml_tpu.io.avro import write_avro_file
+        from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+
+        os.makedirs(path, exist_ok=True)
+        recs = [
+            {
+                "uid": f"r{i}",
+                "label": 0.0,
+                "features": [
+                    {"name": "uf0", "term": "", "value": 1.0 + i},
+                    {"name": "uf1", "term": "", "value": 0.5},
+                ],
+                "metadataMap": {"userId": f"u{i % 5}"},
+                "weight": None,
+                "offset": None,
+            }
+            for i in range(n)
+        ]
+        write_avro_file(
+            os.path.join(path, "part.avro"), TRAINING_EXAMPLE_SCHEMA, recs
+        )
+
+    def test_ragged_batches_share_compiled_buckets(self, rng, tmp_path):
+        """Two scoring runs with different (ragged) row counts land on the
+        same power-of-two executables: the second run compiles NOTHING."""
+        from photon_ml_tpu.cli.score import run_scoring
+
+        root = _save_disk_model(str(tmp_path / "model"), rng)
+        in3, in5 = str(tmp_path / "in3"), str(tmp_path / "in5")
+        self._write_scoring_input(rng, in3, 3)
+        self._write_scoring_input(rng, in5, 5)
+
+        def score(inp, out):
+            return run_scoring(
+                {
+                    "input": [inp],
+                    "model_dir": root,
+                    "output_dir": str(tmp_path / out),
+                    "model_kind": "game",
+                }
+            )
+
+        run1 = score(in3, "out3")
+        before = xla_compile_events()
+        run2 = score(in5, "out5")
+        assert xla_compile_events() == before, (
+            "second scoring run recompiled despite shared buckets"
+        )
+        # and the scores are unaffected by the padding
+        table = np.arange(1, 13, dtype=float).reshape(4, 3)
+        fixed = np.asarray([1.0, 2.0, 3.0])
+
+        def expect(i):
+            x = np.asarray([1.0 + i, 0.5, 0.0])
+            u = i % 5
+            re = table[u] @ x if u < 4 else 0.0  # u4 unseen -> cold start
+            return fixed @ x + re
+
+        np.testing.assert_allclose(
+            run1.scores, [expect(i) for i in range(3)], rtol=1e-10
+        )
+        np.testing.assert_allclose(
+            run2.scores, [expect(i) for i in range(5)], rtol=1e-10
+        )
+
+
+# ---------------------------------------------------------------------------
+# load lab smoke
+# ---------------------------------------------------------------------------
+
+
+class TestServingLab:
+    def test_lab_smoke_emits_bench_record(self, capsys):
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        try:
+            from benchmarks.serving_lab import run
+        finally:
+            sys.path.pop(0)
+        record = run(
+            [
+                "--smoke",
+                "--clients", "2",
+                "--requests", "64",
+                "--baseline-requests", "8",
+            ]
+        )
+        assert record["metric"] == "serving_p99_ms"
+        assert record["unit"] == "ms"
+        assert record["value"] > 0
+        extra = record["extra"]
+        assert extra["requests"] == 64
+        assert extra["steady_state_compiles"] == 0
+        assert extra["qps"] > 0
+        # the printed line is the parseable BENCH record
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["metric"] == "serving_p99_ms"

@@ -497,15 +497,18 @@ class TestWideSparseRandomEffect:
         )
         np.testing.assert_allclose(got, base, rtol=1e-9)
 
-        # CompactReTable against a dense shard is a usage error
+        # CompactReTable against a dense shard: the compact-dense gather
+        # kernel (the serving engine's path) must reproduce the scores
         dense_data = __import__("dataclasses").replace(
             data, features={"wide": to_dense(sf)}
         )
-        with pytest.raises(ValueError, match="CompactReTable"):
+        got_dense = np.asarray(
             score_game_data(
                 {"re": compact}, {"re": "wide"}, {"re": "userId"},
                 dense_data,
             )
+        )
+        np.testing.assert_allclose(got_dense, base, rtol=1e-9)
 
         # writeable numpy: never cached (in-place mutation must be seen)
         t_np = np.array(table)
