@@ -67,6 +67,20 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# per-phase wall clock of the full bench run (seconds) — lands in the
+# BENCH record's extra so the perf trajectory records where the time
+# went, not just totals
+_PHASE_S = {}
+
+
+def _phase(label, fn, *args, **kwargs):
+    t0 = time.perf_counter()
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        _PHASE_S[label] = round(time.perf_counter() - t0, 3)
+
+
 # TPU v5e peak dense matmul throughput (bf16), FLOP/s
 PEAK_FLOPS = 197e12
 # TPU v5e HBM bandwidth, bytes/s — the relevant roofline for GLM solves
@@ -1342,18 +1356,20 @@ def main():
         print(json.dumps(out))
         return
 
-    rtt = measure_tunnel_rtt()
+    rtt = _phase("tunnel_rtt", measure_tunnel_rtt)
     log(f"tunnel RTT: {rtt}")
-    glm = bench_glm_dense()
-    game = bench_game()
-    game_cpu = _game_cpu_baseline()
-    game_multi = bench_game_multi_re()
-    game_multi_cpu = _game_multi_cpu_baseline()
-    game_wide = bench_game_wide_sparse()
-    linear_en = bench_linear_elastic_net()
-    sparse = bench_sparse()
-    sparse_scaling = _sparse_scaling_cpu()
-    ingest = bench_ingest()
+    glm = _phase("glm_dense", bench_glm_dense)
+    game = _phase("game", bench_game)
+    game_cpu = _phase("game_cpu_baseline", _game_cpu_baseline)
+    game_multi = _phase("game_multi", bench_game_multi_re)
+    game_multi_cpu = _phase(
+        "game_multi_cpu_baseline", _game_multi_cpu_baseline
+    )
+    game_wide = _phase("game_wide_sparse", bench_game_wide_sparse)
+    linear_en = _phase("linear_elastic_net", bench_linear_elastic_net)
+    sparse = _phase("sparse", bench_sparse)
+    sparse_scaling = _phase("sparse_scaling_cpu", _sparse_scaling_cpu)
+    ingest = _phase("ingest", bench_ingest)
 
     extra = {
         **rtt,
@@ -1426,6 +1442,13 @@ def main():
             ingest["native_rec_per_s"]
         )
         extra["ingest_vs_python_codec"] = round(ingest["speedup"], 1)
+    # where the bench run's own wall clock went + the final metrics
+    # registry (solver iteration counters, ingest/checkpoint bytes,
+    # recompiles when the compile listener was installed)
+    from photon_ml_tpu import obs
+
+    extra["phase_s"] = dict(_PHASE_S)
+    extra["metrics"] = obs.registry().snapshot()
     print(
         json.dumps(
             {

@@ -1,0 +1,209 @@
+"""Observability overhead gate: enabled tracing must cost <5% on GAME CD.
+
+The unified tracer's contract is "near-zero overhead when disabled, small
+when enabled" (docs/OBSERVABILITY.md). This micro-benchmark makes the
+second half enforceable: it runs the SAME smoke GAME coordinate-descent
+workload with observability disabled and with the full envelope enabled
+(span tracer + JSONL event log + metrics registry dumps), compares medians
+of repeated measurements, and EXITS NONZERO when the enabled/disabled
+ratio exceeds the threshold — wire it into CI and a chatty span added to
+the hot loop fails the build instead of silently taxing every run.
+
+Also reports the raw disabled-mode ``span()`` call cost (the
+unconditional-call contract: one global read + a shared no-op singleton).
+
+Run in the tier-1 environment::
+
+    JAX_PLATFORMS=cpu python benchmarks/obs_overhead.py --smoke
+
+Prints one BENCH-style JSON line; exit 0 = within budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# runnable as `python benchmarks/obs_overhead.py` from the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_cd(rng, n_rows, d_fixed, n_entities, d_user, fuse_passes):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.tasks import TaskType
+    from photon_ml_tpu.game import (
+        CoordinateConfig,
+        CoordinateDescent,
+        FixedEffectCoordinate,
+        GameData,
+        RandomEffectCoordinate,
+        build_random_effect_design,
+    )
+    from photon_ml_tpu.models.training import OptimizerType
+
+    dtype = jnp.float32
+    user = rng.integers(0, n_entities, size=n_rows).astype(np.int32)
+    xg = rng.standard_normal((n_rows, d_fixed), dtype=np.float32)
+    xu = rng.standard_normal((n_rows, d_user), dtype=np.float32)
+    logits = 0.5 * xg[:, 0] + 0.3 * xu[:, 0]
+    y = (rng.uniform(size=n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(
+        np.float32
+    )
+    data = GameData.create(
+        features={"global": xg, "per_user": xu},
+        labels=y,
+        entity_ids={"userId": user},
+    )
+    base = dict(
+        task=TaskType.LOGISTIC_REGRESSION, max_iters=5, tolerance=1e-5
+    )
+    fixed = FixedEffectCoordinate(
+        data.fixed_effect_batch("global", dtype),
+        CoordinateConfig(
+            shard="global", optimizer=OptimizerType.NEWTON,
+            reg_weight=1.0, **base,
+        ),
+    )
+    design = build_random_effect_design(
+        data, "userId", "per_user", n_entities, dtype=dtype
+    )
+    random = RandomEffectCoordinate(
+        design=design,
+        row_features=jnp.asarray(xu, dtype),
+        row_entities=jnp.asarray(user),
+        full_offsets_base=jnp.zeros((n_rows,), dtype),
+        config=CoordinateConfig(
+            shard="per_user", optimizer=OptimizerType.NEWTON,
+            reg_weight=10.0, random_effect="userId", **base,
+        ),
+    )
+    return CoordinateDescent(
+        coordinates={"fixed": fixed, "per-user": random},
+        labels=jnp.asarray(y, dtype),
+        base_offsets=jnp.zeros((n_rows,), dtype),
+        weights=jnp.ones((n_rows,), dtype),
+        task=TaskType.LOGISTIC_REGRESSION,
+        fuse_passes=fuse_passes,
+    )
+
+
+def time_run(cd, iters, repeats, trace: bool):
+    """Best-of-`repeats` wall of timed cd.run() calls, traced or not.
+    Each traced repeat gets a FRESH trace dir (export + JSONL included in
+    the measured cost — that is the real price a user pays). Min, not
+    median: the workload's own run-to-run jitter on a shared CPU host is
+    comparable to the 5% budget, and the minimum estimates the noise-free
+    cost on both sides while preserving any systematic overhead."""
+    from photon_ml_tpu import obs
+
+    walls = []
+    for _ in range(repeats):
+        if trace:
+            tmp = tempfile.mkdtemp(prefix="obs_overhead_")
+            t0 = time.perf_counter()
+            with obs.observe(trace_dir=tmp):
+                cd.run(num_iterations=iters)
+            walls.append(time.perf_counter() - t0)
+        else:
+            t0 = time.perf_counter()
+            cd.run(num_iterations=iters)
+            walls.append(time.perf_counter() - t0)
+    return float(np.min(walls))
+
+
+def disabled_span_ns(n=200_000):
+    """Cost of one disabled-mode span() call (open+exit), nanoseconds."""
+    from photon_ml_tpu import obs
+
+    t0 = time.perf_counter_ns()
+    for _ in range(n):
+        with obs.span("noop"):
+            pass
+    return (time.perf_counter_ns() - t0) / n
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="CPU-sized shape (the tier-1 configuration)",
+    )
+    p.add_argument(
+        "--threshold", type=float, default=1.05,
+        help="max allowed enabled/disabled wall ratio (default 1.05)",
+    )
+    p.add_argument("--repeats", type=int, default=7)
+    # enough passes that steady-state span cost — not the one-off
+    # envelope setup/export — is what the ratio measures (a real run
+    # amortizes the envelope over minutes; a 50 ms run would not)
+    p.add_argument("--iters", type=int, default=12)
+    args = p.parse_args()
+
+    shape = (
+        dict(n_rows=40_000, d_fixed=16, n_entities=200, d_user=8)
+        if args.smoke
+        else dict(n_rows=200_000, d_fixed=64, n_entities=5_000, d_user=16)
+    )
+    # the chunked per-coordinate mode exercises the span-per-update path
+    # (the fused mode's spans are retro-emitted outside the dispatch and
+    # cost even less)
+    rng = np.random.default_rng(29)
+    cd = build_cd(rng, fuse_passes="coordinate", **shape)
+    cd.run(num_iterations=1)  # compile + warm outside all timers
+
+    # interleave would be fairer under drifting load, but the suite is
+    # short; measure disabled, enabled, disabled and take the best
+    # disabled (guards against a one-off slow first block)
+    disabled_a = time_run(cd, args.iters, args.repeats, trace=False)
+    enabled = time_run(cd, args.iters, args.repeats, trace=True)
+    disabled_b = time_run(cd, args.iters, args.repeats, trace=False)
+    disabled = min(disabled_a, disabled_b)
+    ratio = enabled / disabled
+    span_ns = disabled_span_ns()
+
+    record = {
+        "metric": "obs_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": "enabled/disabled wall ratio",
+        "vs_baseline": round(args.threshold, 3),
+        "extra": {
+            "disabled_s": round(disabled, 4),
+            "disabled_s_repeat": round(
+                max(disabled_a, disabled_b), 4
+            ),
+            "enabled_s": round(enabled, 4),
+            "iters": args.iters,
+            "repeats": args.repeats,
+            "shape": shape,
+            "disabled_span_ns": round(span_ns, 1),
+            "threshold": args.threshold,
+        },
+    }
+    print(json.dumps(record))
+    if ratio > args.threshold:
+        print(
+            f"FAIL: enabled-tracing overhead {ratio:.3f}x exceeds "
+            f"{args.threshold:.2f}x budget "
+            f"(disabled {disabled:.3f}s, enabled {enabled:.3f}s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"ok: overhead {ratio:.3f}x (budget {args.threshold:.2f}x); "
+        f"disabled span() costs {span_ns:.0f} ns",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

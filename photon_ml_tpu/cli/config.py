@@ -89,6 +89,14 @@ class GLMDriverParams:
     profile: bool = False
     # fail at the first NaN-producing op inside training — SURVEY §5.2
     debug_nans: bool = False
+    # observability (docs/OBSERVABILITY.md): span tracer output directory
+    # (Chrome trace-event JSON + events.jsonl + metrics.json), periodic
+    # metrics-registry snapshot interval in seconds (0 = final-only), and
+    # a jax.profiler capture window around the whole run (unlike
+    # `profile`, which captures only the train phase)
+    trace_dir: Optional[str] = None
+    metrics_every: float = 0.0
+    profile_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input:
@@ -253,6 +261,13 @@ class GameDriverParams:
     # bag regime). Sparse shards serve plain fixed-effect coordinates
     # only: per-entity designs gather dense rows.
     sparse_shards: List[str] = dataclasses.field(default_factory=list)
+    # observability (docs/OBSERVABILITY.md): span tracer output directory
+    # (Chrome trace-event JSON + events.jsonl + metrics.json), periodic
+    # metrics-registry snapshot interval in seconds (0 = final-only), and
+    # a jax.profiler capture window around the run
+    trace_dir: Optional[str] = None
+    metrics_every: float = 0.0
+    profile_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input:

@@ -401,8 +401,11 @@ class GameTrainingRun:
 
 
 def run_game_training(params) -> GameTrainingRun:
-    """Entry point: config load, log file, fault-drill arming, and the
-    preemption handler lifecycle around the actual training body."""
+    """Entry point: config load, log file, fault-drill arming, the
+    observability envelope (tracer + metrics dumper + profiler window),
+    and the preemption handler lifecycle around the actual training
+    body."""
+    from photon_ml_tpu import obs
     from photon_ml_tpu.resilience import GracefulShutdown, arm_from_env
 
     params = load_params(params, GameDriverParams)
@@ -421,8 +424,20 @@ def run_game_training(params) -> GameTrainingRun:
     shutdown = GracefulShutdown(logger)
     if params.graceful_shutdown:
         shutdown.install()
+    # metrics.json lands in trace_dir when tracing, else next to
+    # log-message.txt when periodic snapshots were asked for
+    metrics_path = None
+    if params.trace_dir is None and params.metrics_every > 0:
+        metrics_path = os.path.join(params.output_dir, "metrics.json")
     try:
-        return _run_game_training(params, logger, shutdown)
+        with obs.observe(
+            trace_dir=params.trace_dir,
+            metrics_path=metrics_path,
+            metrics_every=params.metrics_every,
+            profile_dir=params.profile_dir,
+            process_name="photon_ml_tpu.game_train",
+        ):
+            return _run_game_training(params, logger, shutdown)
     finally:
         shutdown.uninstall()
         logger.close()
@@ -988,6 +1003,20 @@ def main(argv=None) -> None:
     )
     p.add_argument("--config", required=True, help="JSON GameDriverParams")
     p.add_argument("--overwrite", action="store_true", default=None)
+    p.add_argument(
+        "--trace-dir", default=None,
+        help="emit a Chrome trace-event JSON + events.jsonl + metrics.json "
+        "under this directory (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--metrics-every", type=float, default=None,
+        help="seconds between periodic metrics.json registry snapshots "
+        "(0 = final snapshot only)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of the run here",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1003,6 +1032,12 @@ def main(argv=None) -> None:
         base = json.load(f)
     if args.overwrite is not None:
         base["overwrite"] = args.overwrite
+    if args.trace_dir is not None:
+        base["trace_dir"] = args.trace_dir
+    if args.metrics_every is not None:
+        base["metrics_every"] = args.metrics_every
+    if args.profile_dir is not None:
+        base["profile_dir"] = args.profile_dir
     run_game_training(base)
 
 

@@ -16,6 +16,8 @@ Protocol (one JSON object per line):
      "entities": {"userId": "u123"}, "offset": 0.0}
         -> {"score": 1.234}
     {"cmd": "stats"}    -> latency/QPS/bucket snapshot (serving/stats.py)
+    {"cmd": "metrics"}  -> {"prometheus": "<text exposition>"} — the full
+                           metrics registry (docs/OBSERVABILITY.md)
     {"cmd": "version"}  -> {"version": "<current model version>"}
     {"cmd": "reload", "path": "<export dir>"} -> {"reloaded": "<version>"}
 
@@ -121,6 +123,18 @@ def serve_lines(
                 try:
                     if cmd == "stats":
                         reply_now((stats or batcher.stats).snapshot())
+                    elif cmd == "metrics":
+                        # Prometheus text exposition of the serving
+                        # registry PLUS the process-default registry
+                        # (solver/io/resilience counters), so one scrape
+                        # sees the whole process (docs/OBSERVABILITY.md)
+                        from photon_ml_tpu import obs
+
+                        st = stats or batcher.stats
+                        text = st.registry.to_prometheus()
+                        if st.registry is not obs.registry():
+                            text += obs.registry().to_prometheus()
+                        reply_now({"prometheus": text})
                     elif cmd == "version":
                         reply_now({"version": registry.version()})
                     elif cmd == "reload":

@@ -161,8 +161,27 @@ class GLMTrainingRun:
 
 
 def run_glm_training(params) -> GLMTrainingRun:
+    """Entry point: config load + the observability envelope (span
+    tracer, periodic metrics snapshots, profiler window) around the
+    actual driver body."""
+    from photon_ml_tpu import obs
+
     params = load_params(params, GLMDriverParams)
     params.validate()
+    metrics_path = None
+    if params.trace_dir is None and params.metrics_every > 0:
+        metrics_path = os.path.join(params.output_dir, "metrics.json")
+    with obs.observe(
+        trace_dir=params.trace_dir,
+        metrics_path=metrics_path,
+        metrics_every=params.metrics_every,
+        profile_dir=params.profile_dir,
+        process_name="photon_ml_tpu.train",
+    ):
+        return _run_glm_training(params)
+
+
+def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
     prepare_output_dir(params.output_dir, params.overwrite)
     tracker = StageTracker()
     logger = PhotonLogger(
@@ -499,6 +518,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--profile", action="store_true", default=None)
     p.add_argument("--debug-nans", action="store_true", default=None)
+    p.add_argument(
+        "--trace-dir", default=None,
+        help="emit a Chrome trace-event JSON + events.jsonl + metrics.json "
+        "under this directory (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--metrics-every", type=float, default=None,
+        help="seconds between periodic metrics.json registry snapshots "
+        "(0 = final snapshot only)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of the WHOLE run here "
+        "(--profile captures only the train phase)",
+    )
     return p
 
 

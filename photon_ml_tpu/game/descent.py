@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.core.tasks import TaskType
 from photon_ml_tpu.ops import metrics as metrics_mod
 from photon_ml_tpu.solvers.common import ConvergenceReason
@@ -114,6 +115,25 @@ def _history_record(
             for r, c in zip(*np.unique(reasons, return_counts=True))
         },
     )
+
+
+def _record_update_metrics(rec: CoordinateUpdateRecord) -> None:
+    """Feed one materialized update record into the process metrics
+    registry (docs/OBSERVABILITY.md taxonomy). Called at materialize()
+    time — after the batched device->host drain — so the hot loop's
+    deferred-stats pipelining is untouched."""
+    reg = obs.registry()
+    reg.inc("game.updates")
+    reg.inc("game.solver_iterations", rec.solver_iterations)
+    reg.set_gauge("game.objective", rec.objective)
+    if rec.validation_metric is not None:
+        reg.set_gauge("game.validation_metric", rec.validation_metric)
+    if rec.seconds is not None:
+        reg.observe("game.update_ms", rec.seconds * 1e3)
+    if rec.event == "recovered":
+        reg.inc("resilience.rollbacks")
+    elif rec.event == "frozen":
+        reg.inc("resilience.frozen_coordinates")
 
 
 def _normalize_fuse_passes(fp):
@@ -507,18 +527,18 @@ class CoordinateDescent:
                     )
                 else:
                     reason, iterations = tr
-                history.append(
-                    _history_record(
-                        p["iteration"],
-                        p["coordinate"],
-                        obj,
-                        reason,
-                        iterations,
-                        p["seconds"],
-                        p["validation_metric"],
-                        p.get("event"),
-                    )
+                rec = _history_record(
+                    p["iteration"],
+                    p["coordinate"],
+                    obj,
+                    reason,
+                    iterations,
+                    p["seconds"],
+                    p["validation_metric"],
+                    p.get("event"),
                 )
+                history.append(rec)
+                _record_update_metrics(rec)
             pending.clear()
 
         # the fused path needs the FULL trace-safe surface, not just
@@ -562,8 +582,16 @@ class CoordinateDescent:
                 frozen=sorted(frozen),
             )
 
+        # count XLA backend compiles for the duration of the run: the
+        # steady-state zero-recompile invariant of the cached pass/step
+        # programs is only provable if something counts actual compiles
+        # (obs.compile_events; idempotent global listener)
+        obs.install_compile_listener()
         stopped = False
         for it in range(start_it, num_iterations):
+            tracer = obs.get_tracer()
+            pass_t0 = time.perf_counter()
+            pass_ts = tracer.now_us() if tracer is not None else 0.0
             if use_fused:
                 t0 = time.perf_counter()
                 params_in = {n: model.params[n] for n in names}
@@ -573,6 +601,24 @@ class CoordinateDescent:
                 )
                 model.params.update(params_out)
                 seconds = time.perf_counter() - t0
+                if tracer is not None:
+                    # the fused pass is ONE indivisible dispatch, so the
+                    # per-coordinate spans share the pass window; args
+                    # mark them fused so nobody reads the duration as a
+                    # per-coordinate cost (same contract as the history
+                    # records' first-record-only `seconds`)
+                    for name in names:
+                        tracer.add_span(
+                            "game.update",
+                            pass_ts,
+                            seconds * 1e6,
+                            cat="game",
+                            args={
+                                "coordinate": name,
+                                "iteration": it,
+                                "fused": True,
+                            },
+                        )
                 for i, (name, obj, tr) in enumerate(
                     zip(names, objs, trackers)
                 ):
@@ -595,86 +641,82 @@ class CoordinateDescent:
                 for name in names:
                     if name in frozen:
                         continue
-                    t0 = time.perf_counter()
-                    key, sub = jax.random.split(key)
-                    p, tr, s, obj = fns[name](
-                        states,
-                        self.labels,
-                        self.base_offsets,
-                        self.weights,
-                        {n: model.params[n] for n in names},
-                        scores,
-                        sub,
-                    )
-                    model.params[name] = p
-                    scores = {**scores, name: s}
-                    seconds = time.perf_counter() - t0
-                    vmetric = (
-                        float(validation_fn(model))
-                        if validation_fn is not None
-                        else None
-                    )
-                    pending.append(
-                        {
-                            "iteration": it,
-                            "coordinate": name,
-                            "objective": obj,
-                            "seconds": seconds,
-                            "validation_metric": vmetric,
-                            "result": self.coordinates[name].wrap_tracker(
-                                tr
-                            ),
-                        }
-                    )
+                    with obs.span(
+                        "game.update", cat="game",
+                        coordinate=name, iteration=it,
+                    ):
+                        t0 = time.perf_counter()
+                        key, sub = jax.random.split(key)
+                        p, tr, s, obj = fns[name](
+                            states,
+                            self.labels,
+                            self.base_offsets,
+                            self.weights,
+                            {n: model.params[n] for n in names},
+                            scores,
+                            sub,
+                        )
+                        model.params[name] = p
+                        scores = {**scores, name: s}
+                        seconds = time.perf_counter() - t0
+                        vmetric = (
+                            float(validation_fn(model))
+                            if validation_fn is not None
+                            else None
+                        )
+                        pending.append(
+                            {
+                                "iteration": it,
+                                "coordinate": name,
+                                "objective": obj,
+                                "seconds": seconds,
+                                "validation_metric": vmetric,
+                                "result": self.coordinates[
+                                    name
+                                ].wrap_tracker(tr),
+                            }
+                        )
             else:
                 for name in names:
                     if name in frozen:
                         continue
-                    t0 = time.perf_counter()
-                    coord = self.coordinates[name]
-                    total = sum(scores.values())
-                    partial = total - scores[name]
-
-                    def _attempt(prev_p, residual, sub):
-                        if hasattr(coord, "update_and_score"):
-                            p, r, s = coord.update_and_score(
-                                prev_p, residual, sub
-                            )
-                        else:
-                            p, r = coord.update(prev_p, residual, sub)
-                            s = coord.score(p)
-                        # fault site: corrupt-mode poisons the accepted
-                        # update with non-finites — the drill for the
-                        # divergence guard (and, unguarded, for the
-                        # one-NaN-poisons-the-run failure mode)
-                        if _faults.fire("descent.update", key=name).corrupt:
-                            p = jax.tree_util.tree_map(
-                                lambda a: jnp.full_like(a, jnp.nan), p
-                            )
-                            s = jnp.full_like(s, jnp.nan)
-                        return p, r, s
-
-                    key, sub = jax.random.split(key)
-                    params, result, new_scores = _attempt(
-                        model.params[name], partial, sub
+                    update_span = obs.span(
+                        "game.update", cat="game",
+                        coordinate=name, iteration=it,
                     )
-                    event = None
-                    if divergence_guard:
-                        cand_scores = {**scores, name: new_scores}
-                        cand_params = {**model.params, name: params}
-                        obj_host = float(
-                            self._full_objective(cand_scores, cand_params)
+                    with update_span:
+                        t0 = time.perf_counter()
+                        coord = self.coordinates[name]
+                        total = sum(scores.values())
+                        partial = total - scores[name]
+
+                        def _attempt(prev_p, residual, sub):
+                            if hasattr(coord, "update_and_score"):
+                                p, r, s = coord.update_and_score(
+                                    prev_p, residual, sub
+                                )
+                            else:
+                                p, r = coord.update(prev_p, residual, sub)
+                                s = coord.score(p)
+                            # fault site: corrupt-mode poisons the accepted
+                            # update with non-finites — the drill for the
+                            # divergence guard (and, unguarded, for the
+                            # one-NaN-poisons-the-run failure mode)
+                            if _faults.fire(
+                                "descent.update", key=name
+                            ).corrupt:
+                                p = jax.tree_util.tree_map(
+                                    lambda a: jnp.full_like(a, jnp.nan), p
+                                )
+                                s = jnp.full_like(s, jnp.nan)
+                            return p, r, s
+
+                        key, sub = jax.random.split(key)
+                        params, result, new_scores = _attempt(
+                            model.params[name], partial, sub
                         )
-                        if not np.isfinite(obj_host):
-                            # rollback to the pre-update state and retry
-                            # once against a DAMPED residual (half the
-                            # partial score): overshoot-driven overflow
-                            # gets a gentler target, injected faults get a
-                            # second probe
-                            key, sub = jax.random.split(key)
-                            params, result, new_scores = _attempt(
-                                model.params[name], partial * 0.5, sub
-                            )
+                        event = None
+                        if divergence_guard:
                             cand_scores = {**scores, name: new_scores}
                             cand_params = {**model.params, name: params}
                             obj_host = float(
@@ -682,47 +724,92 @@ class CoordinateDescent:
                                     cand_scores, cand_params
                                 )
                             )
-                            if np.isfinite(obj_host):
-                                event = "recovered"
-                            else:
-                                # graceful degradation: keep the last
-                                # finite state, exclude the coordinate
-                                # from further passes, keep training the
-                                # rest (the record's objective is the
-                                # retained finite state; event="frozen"
-                                # marks the failure)
-                                frozen.add(name)
-                                event = "frozen"
-                                params = model.params[name]
-                                new_scores = scores[name]
-                    model.params[name] = params
-                    scores[name] = new_scores
+                            if not np.isfinite(obj_host):
+                                # rollback to the pre-update state and retry
+                                # once against a DAMPED residual (half the
+                                # partial score): overshoot-driven overflow
+                                # gets a gentler target, injected faults get
+                                # a second probe
+                                obs.emit_event(
+                                    "resilience.rollback",
+                                    cat="resilience",
+                                    coordinate=name,
+                                    iteration=it,
+                                )
+                                key, sub = jax.random.split(key)
+                                params, result, new_scores = _attempt(
+                                    model.params[name], partial * 0.5, sub
+                                )
+                                cand_scores = {**scores, name: new_scores}
+                                cand_params = {
+                                    **model.params, name: params
+                                }
+                                obj_host = float(
+                                    self._full_objective(
+                                        cand_scores, cand_params
+                                    )
+                                )
+                                if np.isfinite(obj_host):
+                                    event = "recovered"
+                                else:
+                                    # graceful degradation: keep the last
+                                    # finite state, exclude the coordinate
+                                    # from further passes, keep training the
+                                    # rest (the record's objective is the
+                                    # retained finite state; event="frozen"
+                                    # marks the failure)
+                                    frozen.add(name)
+                                    event = "frozen"
+                                    params = model.params[name]
+                                    new_scores = scores[name]
+                                    obs.emit_event(
+                                        "resilience.freeze",
+                                        cat="resilience",
+                                        coordinate=name,
+                                        iteration=it,
+                                    )
+                                update_span.set(event=event)
+                        model.params[name] = params
+                        scores[name] = new_scores
 
-                    obj = self._full_objective(scores, model.params)
-                    # seconds measures host dispatch+update wall time; with
-                    # deferred stats the device may still be draining
-                    seconds = time.perf_counter() - t0
-                    vmetric = (
-                        float(validation_fn(model))
-                        if validation_fn is not None
-                        else None
-                    )
-                    pending.append(
-                        {
-                            "iteration": it,
-                            "coordinate": name,
-                            "objective": obj,
-                            "seconds": seconds,
-                            "validation_metric": vmetric,
-                            "event": event,
-                            # the result object is kept whole: reading
-                            # .reason/.iterations on a
-                            # RandomEffectUpdateSummary materializes device
-                            # buffers, which must not happen until
-                            # materialize()
-                            "result": result,
-                        }
-                    )
+                        obj = self._full_objective(scores, model.params)
+                        # seconds measures host dispatch+update wall time;
+                        # with deferred stats the device may still be
+                        # draining
+                        seconds = time.perf_counter() - t0
+                        vmetric = (
+                            float(validation_fn(model))
+                            if validation_fn is not None
+                            else None
+                        )
+                        pending.append(
+                            {
+                                "iteration": it,
+                                "coordinate": name,
+                                "objective": obj,
+                                "seconds": seconds,
+                                "validation_metric": vmetric,
+                                "event": event,
+                                # the result object is kept whole: reading
+                                # .reason/.iterations on a
+                                # RandomEffectUpdateSummary materializes
+                                # device buffers, which must not happen
+                                # until materialize()
+                                "result": result,
+                            }
+                        )
+            pass_seconds = time.perf_counter() - pass_t0
+            if tracer is not None:
+                tracer.add_span(
+                    "game.pass",
+                    pass_ts,
+                    pass_seconds * 1e6,
+                    cat="game",
+                    args={"iteration": it, "coordinates": len(names)},
+                )
+            _reg = obs.registry()
+            _reg.inc("game.passes")
+            _reg.observe("game.pass_ms", pass_seconds * 1e3)
             saved = False
             if (
                 checkpoint_dir is not None

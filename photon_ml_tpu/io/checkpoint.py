@@ -41,12 +41,27 @@ import hashlib
 import json
 import os
 import shutil
+import time
 import zipfile
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.resilience import faults, retry
+
+
+def _dir_bytes(directory: str) -> int:
+    """Total payload bytes of one step directory (flat layout)."""
+    total = 0
+    try:
+        for name in os.listdir(directory):
+            path = os.path.join(directory, name)
+            if os.path.isfile(path):
+                total += os.path.getsize(path)
+    except OSError:
+        pass  # metrics must never fail a save that already succeeded
+    return total
 
 _STEP_PREFIX = "step-"
 _DATA_FILES = ("arrays.npz",)
@@ -172,8 +187,17 @@ def save_checkpoint(
         if os.path.exists(old):
             shutil.rmtree(old)
 
-    retry.retry_call(
-        _write, retries=retries, logger=logger, label=f"checkpoint step {step}"
+    t0 = time.perf_counter()
+    with obs.span("io.checkpoint.save", cat="io", step=step):
+        retry.retry_call(
+            _write, retries=retries, logger=logger,
+            label=f"checkpoint step {step}",
+        )
+    reg = obs.registry()
+    reg.inc("io.checkpoint.saves")
+    reg.inc("io.checkpoint.bytes_written", _dir_bytes(final))
+    reg.observe(
+        "io.checkpoint.save_ms", (time.perf_counter() - t0) * 1e3
     )
     # prune all but the newest `keep` steps
     steps = sorted(_list_steps(directory))
@@ -204,6 +228,7 @@ def _load_step(directory: str, step: int) -> TrainingCheckpoint:
     :class:`CheckpointCorrupted` on any defect (truncated/unparseable
     manifest, missing data file, digest mismatch, missing npz key)."""
     d = os.path.join(directory, f"{_STEP_PREFIX}{step}")
+    t0 = time.perf_counter()
     faults.fire("checkpoint.load")
     try:
         with open(os.path.join(d, "manifest.json")) as f:
@@ -239,6 +264,12 @@ def _load_step(directory: str, step: int) -> TrainingCheckpoint:
                 )
             else:
                 params[name] = arrays[f"param/{name}"]
+        reg = obs.registry()
+        reg.inc("io.checkpoint.loads")
+        reg.inc("io.checkpoint.bytes_read", _dir_bytes(d))
+        reg.observe(
+            "io.checkpoint.load_ms", (time.perf_counter() - t0) * 1e3
+        )
         return TrainingCheckpoint(
             step=manifest["step"],
             params=params,

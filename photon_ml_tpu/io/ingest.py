@@ -13,28 +13,50 @@ container — the representation for the reference's >200k-feature regime
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
 from photon_ml_tpu.resilience import faults as _faults
 from photon_ml_tpu.resilience import retry as _retry
 
 
-def _resilient_read(fn, *args, label: str, logger=None, **kwargs):
+def _resilient_read(fn, *args, label: str, logger=None, paths=None, **kwargs):
     """Run one input-read with the ``ingest.read`` fault site armed and
     transient ``OSError`` retried (backoff; resilience.retry). A flaky
     network filesystem — or an injected fault drill — costs a retry, not
     the run. Non-I/O errors (bad schema, bad records) propagate
-    immediately."""
+    immediately.
+
+    ``paths`` (the files this read covers) feeds the obs layer:
+    ``io.ingest.files`` / ``io.ingest.bytes_read`` counters and a
+    ``io.ingest.read_ms`` latency histogram, plus a span on the active
+    tracer — ingest is the first wall a cold training run hits, so it
+    must be visible in the same instrument as the solves."""
 
     def attempt():
         _faults.fire("ingest.read")
         return fn(*args, **kwargs)
 
-    return _retry.retry_call(attempt, retries=3, label=label, logger=logger)
+    t0 = time.perf_counter()
+    with obs.span("io.ingest.read", cat="io", label=label):
+        out = _retry.retry_call(
+            attempt, retries=3, label=label, logger=logger
+        )
+    reg = obs.registry()
+    reg.observe("io.ingest.read_ms", (time.perf_counter() - t0) * 1e3)
+    for p in paths or ():
+        reg.inc("io.ingest.files")
+        try:
+            reg.inc("io.ingest.bytes_read", os.path.getsize(p))
+        except OSError:
+            pass  # metrics must never fail a read that succeeded
+    return out
 
 
 # Avro field-name sets (``avro/FieldNamesType.scala:20``): the driver flag
@@ -447,7 +469,9 @@ class IngestSource:
 
             recs: List[dict] = []
             for f in self.files:
-                _, r = _resilient_read(read_avro_file, f, label=f"read {f}")
+                _, r = _resilient_read(
+                    read_avro_file, f, label=f"read {f}", paths=[f]
+                )
                 recs.extend(r)
             self._check_nonempty(len(recs))
             self._records = normalize_field_names(recs, self.field_names)
@@ -466,6 +490,7 @@ class IngestSource:
                 label_field=self.label_field,
                 allow_null_labels=allow_null_labels,
                 label=f"native read {self.files}",
+                paths=self.files,
             )
         except native.UnsupportedSchema:
             return None
@@ -607,6 +632,7 @@ class IngestSource:
                     label_field=self.label_field,
                     allow_null_labels=allow_null_labels,
                     label=f"native read {path}",
+                    paths=[path],
                 )
             except native.UnsupportedSchema as e:
                 raise RuntimeError(

@@ -28,6 +28,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.core.normalization import (
     NormalizationContext,
     NormalizationType,
@@ -287,6 +288,28 @@ def _build_solver_cached(config: GLMTrainingConfig):
     return solve, variances
 
 
+def _record_solve_metrics(config: GLMTrainingConfig, result) -> None:
+    """Route a completed solve to its solver module's metric recorder —
+    the dispatch mirrors ``_build_solver_cached``'s solver selection
+    (L1/elastic-net means the LBFGS enum actually ran OWL-QN)."""
+    if config.regularization.reg_type in ("L1", "ELASTIC_NET"):
+        from photon_ml_tpu.solvers.lbfgs import record_solve_metrics
+
+        record_solve_metrics(result, owlqn=True)
+    elif config.optimizer == OptimizerType.TRON:
+        from photon_ml_tpu.solvers.tron import record_solve_metrics
+
+        record_solve_metrics(result)
+    elif config.optimizer == OptimizerType.LBFGS:
+        from photon_ml_tpu.solvers.lbfgs import record_solve_metrics
+
+        record_solve_metrics(result)
+    else:
+        from photon_ml_tpu.solvers.common import record_solver_metrics
+
+        record_solver_metrics(config.optimizer.name.lower(), result)
+
+
 _summarize_jit = jax.jit(summarize_features)
 
 
@@ -344,7 +367,20 @@ def train_glm(
 
     by_lambda = {}
     for lam in sorted(config.reg_weights, reverse=True):
-        result = solve(w, jnp.asarray(lam, dtype), batch, norm)
+        with obs.span(
+            "glm.solve",
+            cat="solver",
+            optimizer=config.optimizer.name,
+            reg_weight=float(lam),
+        ) as sp:
+            result = solve(w, jnp.asarray(lam, dtype), batch, norm)
+            if obs.get_tracer() is not None:
+                # device-time attribution + per-solve iteration counters.
+                # Both synchronize, so they run ONLY under an active
+                # tracer: the disabled path must keep pipelined solves
+                # (bench.py) free of inserted host syncs.
+                sp.sync(result.w)
+                _record_solve_metrics(config, result)
         w = result.w  # warm start for the next (smaller) lambda
         if config.track_models and result.w_history is not None:
             # snapshots leave the solver in normalized space; de-normalize

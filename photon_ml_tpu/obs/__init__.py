@@ -1,0 +1,162 @@
+"""Unified observability: structured tracing, metrics registry, profiling.
+
+ONE instrument threaded through every layer (docs/OBSERVABILITY.md):
+
+- :mod:`.trace`   — nestable thread-safe spans; Chrome trace-event JSON
+  (Perfetto-loadable) + structured JSONL event log; near-zero cost when
+  disabled (``benchmarks/obs_overhead.py`` gates <5%).
+- :mod:`.metrics` — named counters/gauges/histograms; JSON snapshots
+  (``metrics.json``) and Prometheus text exposition (``cli/serve.py``).
+- :mod:`.compile_events` — ``jax.monitoring`` backend-compile counter
+  (promoted from ``serving/stats.py``), feeding ``xla.compiles``.
+
+Drivers enable all of it in one place::
+
+    with obs.observe(trace_dir=..., metrics_path=..., metrics_every=30,
+                     profile_dir=...):
+        ...
+
+which installs the tracer, starts a periodic registry dumper, and opens a
+``jax.profiler`` capture window; everything tears down (final metrics
+dump, trace export) on exit. Hot paths call ``obs.span(...)`` /
+``obs.emit_event(...)`` / ``obs.registry()`` unconditionally — disabled
+mode costs one global read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional
+
+from photon_ml_tpu.obs.compile_events import (
+    install_compile_listener,
+    xla_compile_events,
+)
+from photon_ml_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    LatencyHistogram,
+    MetricsRegistry,
+    registry,
+    set_registry,
+)
+from photon_ml_tpu.obs.trace import (
+    Span,
+    Tracer,
+    emit_event,
+    get_tracer,
+    set_tracer,
+    span,
+    trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "registry",
+    "set_registry",
+    "Span",
+    "Tracer",
+    "emit_event",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "trace",
+    "install_compile_listener",
+    "xla_compile_events",
+    "MetricsDumper",
+    "observe",
+]
+
+
+class MetricsDumper:
+    """Background thread writing periodic registry snapshots to a JSON
+    file (the ``--metrics-every`` surface). Daemonized and event-driven so
+    ``stop()`` returns promptly instead of waiting out the interval; a
+    final dump on stop means the file always reflects the completed run.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        every_s: float,
+        reg: Optional[MetricsRegistry] = None,
+    ):
+        self.path = path
+        self.every_s = every_s
+        self._registry = reg if reg is not None else registry()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.every_s):
+            try:
+                self._registry.dump(self.path)
+            except OSError:
+                pass  # a full disk must not kill the training loop
+
+    def start(self) -> "MetricsDumper":
+        if self.every_s > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="obs-metrics-dumper", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._registry.dump(self.path)
+
+
+@contextlib.contextmanager
+def observe(
+    trace_dir: Optional[str] = None,
+    metrics_path: Optional[str] = None,
+    metrics_every: float = 0.0,
+    profile_dir: Optional[str] = None,
+    process_name: str = "photon_ml_tpu",
+):
+    """Driver-level enable-everything context.
+
+    - ``trace_dir``: install the span tracer; ``trace.json`` +
+      ``events.jsonl`` land there on exit. Also installs the compile
+      listener so recompiles show up in the timeline and registry.
+    - ``metrics_path`` (+ ``metrics_every`` seconds): periodic default-
+      registry snapshots; a final snapshot is always written on exit.
+      With only ``trace_dir`` set, ``metrics.json`` defaults into it.
+    - ``profile_dir``: a ``jax.profiler`` capture window around the block
+      (TensorBoard/Perfetto-loadable device profile — the deep tool under
+      the span timeline).
+
+    All-None is a no-op: drivers wrap their body unconditionally and let
+    flags decide.
+    """
+    if metrics_path is None and trace_dir is not None:
+        metrics_path = os.path.join(trace_dir, "metrics.json")
+    dumper = None
+    with contextlib.ExitStack() as stack:
+        if trace_dir is not None:
+            install_compile_listener()
+            stack.enter_context(trace(trace_dir, process_name=process_name))
+        if profile_dir is not None:
+            import jax
+
+            os.makedirs(profile_dir, exist_ok=True)
+            stack.enter_context(jax.profiler.trace(profile_dir))
+        if metrics_path is not None:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(metrics_path)), exist_ok=True
+            )
+            dumper = MetricsDumper(metrics_path, metrics_every).start()
+        try:
+            yield
+        finally:
+            if dumper is not None:
+                dumper.stop()

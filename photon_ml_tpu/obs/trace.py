@@ -1,0 +1,370 @@
+"""Structured span tracing: Chrome trace events + JSONL event log.
+
+The reference's only timing instrument is ``Driver.scala:124-149`` — ad-hoc
+elapsed-millis log lines per phase. That tells you *that* a GAME pass took
+9 seconds, never *where* they went (solver iterations vs recompiles vs
+host<->device transfer). This module is the process-wide replacement: a
+thread-safe span tracer whose output loads directly into Perfetto /
+``chrome://tracing`` (trace-event JSON) and doubles as a structured JSONL
+event log written next to the run's ``log-message.txt``.
+
+Design constraints, in priority order:
+
+1. **Near-zero disabled overhead.** Training hot loops call
+   :func:`span` unconditionally; with no tracer installed the call is one
+   module-global read plus returning a shared no-op singleton — no
+   allocation, no lock, no branch in the caller. ``benchmarks/obs_overhead.py``
+   gates this (<5% on a smoke GAME run, enabled vs disabled).
+2. **Thread-safe.** The serving micro-batcher and stats flushers span from
+   worker threads; events append under one lock and carry the recording
+   thread id so Perfetto lays them out per-track.
+3. **No jax dependency.** Pure stdlib — the tracer must be importable from
+   CPU-only subprocesses (bench baselines) and before backend selection.
+
+Usage::
+
+    from photon_ml_tpu import obs
+
+    with obs.trace("out/trace"):            # install for the block
+        with obs.span("train", combo=0):    # nestable, thread-safe
+            ...
+        obs.emit_event("retry", label="read part-0.avro", attempt=2)
+    # -> out/trace/trace.json (Perfetto) + out/trace/events.jsonl
+
+Device-time attribution: wall-clock spans lie on an async runtime — the
+dispatch returns before the device finishes. ``span(...).sync(arrays)``
+calls ``jax.block_until_ready`` on the value and annotates the span with
+the blocked time, splitting host dispatch from device completion.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "trace",
+    "span",
+    "emit_event",
+    "get_tracer",
+    "set_tracer",
+]
+
+EVENTS_FILENAME = "events.jsonl"
+TRACE_FILENAME = "trace.json"
+
+
+class Tracer:
+    """Collects trace events and streams them to a JSONL log.
+
+    ``_FLUSH_EVERY`` bounds the unflushed-span window (see
+    :meth:`_log_jsonl`).
+
+    Timestamps are microseconds since the tracer's epoch
+    (``perf_counter_ns`` based — monotonic, immune to wall-clock steps),
+    which is what the Chrome trace-event format's ``ts`` field wants.
+    ``export()`` writes the accumulated events, sorted by ``ts``, as a
+    ``{"traceEvents": [...]}`` document loadable in Perfetto.
+    """
+
+    _FLUSH_EVERY = 64
+
+    def __init__(
+        self,
+        trace_dir: Optional[str] = None,
+        process_name: str = "photon_ml_tpu",
+    ):
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._epoch_ns = time.perf_counter_ns()
+        self._epoch_unix = time.time()
+        self._pid = os.getpid()
+        self.trace_dir = trace_dir
+        self._jsonl: Optional[io.TextIOBase] = None
+        self._jsonl_pending = 0
+        if trace_dir is not None:
+            os.makedirs(trace_dir, exist_ok=True)
+            self._jsonl = open(
+                os.path.join(trace_dir, EVENTS_FILENAME),
+                "a",
+                encoding="utf-8",
+            )
+        # process metadata event (names the track in Perfetto)
+        self._events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": self._pid,
+                "tid": 0,
+                "ts": 0,
+                "args": {"name": process_name},
+            }
+        )
+
+    # -- clock --------------------------------------------------------------
+
+    def now_us(self) -> float:
+        """Microseconds since this tracer's epoch (monotonic)."""
+        return (time.perf_counter_ns() - self._epoch_ns) / 1e3
+
+    def _wall(self, ts_us: float) -> float:
+        """Unix seconds for a tracer timestamp (JSONL human anchor)."""
+        return self._epoch_unix + ts_us / 1e6
+
+    # -- recording ----------------------------------------------------------
+
+    def _log_jsonl(self, record: Dict[str, Any], flush: bool = False) -> None:
+        """Append one JSONL record. Span records are flushed every
+        ``_FLUSH_EVERY`` writes (a crash loses at most a handful of
+        timing lines); instant events — faults, retries, preemptions —
+        flush immediately, since they exist to survive the crash that
+        follows them."""
+        if self._jsonl is None or self._jsonl.closed:
+            return
+        self._jsonl.write(json.dumps(record, sort_keys=True) + "\n")
+        self._jsonl_pending += 1
+        if flush or self._jsonl_pending >= self._FLUSH_EVERY:
+            self._jsonl.flush()
+            self._jsonl_pending = 0
+
+    def add_span(
+        self,
+        name: str,
+        ts_us: float,
+        dur_us: float,
+        cat: str = "app",
+        tid: Optional[int] = None,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record a complete ('X') event with an explicit window — the
+        retro-emission hook for work whose per-piece timing is only known
+        after a fused dispatch returns."""
+        ev = {
+            "ph": "X",
+            "name": name,
+            "cat": cat,
+            "pid": self._pid,
+            "tid": tid if tid is not None else threading.get_ident(),
+            "ts": round(ts_us, 3),
+            "dur": round(max(dur_us, 0.0), 3),
+            "args": args or {},
+        }
+        with self._lock:
+            self._events.append(ev)
+            self._log_jsonl(
+                {
+                    "kind": "span",
+                    "name": name,
+                    "cat": cat,
+                    "time_unix": round(self._wall(ts_us), 6),
+                    "duration_ms": round(max(dur_us, 0.0) / 1e3, 6),
+                    **(args or {}),
+                }
+            )
+
+    def add_instant(
+        self,
+        name: str,
+        cat: str = "event",
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        ts = self.now_us()
+        ev = {
+            "ph": "i",
+            "s": "t",  # thread-scoped instant
+            "name": name,
+            "cat": cat,
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "ts": round(ts, 3),
+            "args": args or {},
+        }
+        with self._lock:
+            self._events.append(ev)
+            self._log_jsonl(
+                {
+                    "kind": "event",
+                    "name": name,
+                    "cat": cat,
+                    "time_unix": round(self._wall(ts), 6),
+                    **(args or {}),
+                },
+                flush=True,
+            )
+
+    # -- readout ------------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome trace-event JSON (sorted by ``ts`` so readers
+        that assume emission order see monotone timestamps). Returns the
+        path written, or None when there is nowhere to write."""
+        if path is None:
+            if self.trace_dir is None:
+                return None
+            path = os.path.join(self.trace_dir, TRACE_FILENAME)
+        with self._lock:
+            events = sorted(self._events, key=lambda e: (e["ts"], -e.get("dur", 0)))
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {"epoch_unix": self._epoch_unix},
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        return path
+
+    def close(self) -> None:
+        if self._jsonl is not None and not self._jsonl.closed:
+            self._jsonl.close()
+
+
+# ---------------------------------------------------------------------------
+# Active-tracer plumbing
+# ---------------------------------------------------------------------------
+
+# ONE process-global active tracer (like logging's root logger): training,
+# serving, and resilience all emit into the same timeline, which is the
+# point of a *unified* instrument. Deliberately not thread-local — worker
+# threads must land on the main timeline.
+_active: Optional[Tracer] = None
+_install_lock = threading.Lock()
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _active
+
+
+def set_tracer(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    """Install ``tracer`` as the process-wide destination (None disables).
+    Returns the previous tracer so callers can restore it."""
+    global _active
+    with _install_lock:
+        prev = _active
+        _active = tracer
+    return prev
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str], process_name: str = "photon_ml_tpu"):
+    """Install a :class:`Tracer` writing under ``trace_dir`` for the
+    block; export ``trace.json`` and close the JSONL log on exit. With
+    ``trace_dir=None`` the block runs untraced (flag-plumbing
+    convenience: ``with trace(args.trace_dir): ...``)."""
+    if trace_dir is None:
+        yield None
+        return
+    tracer = Tracer(trace_dir, process_name=process_name)
+    prev = set_tracer(tracer)
+    try:
+        yield tracer
+    finally:
+        set_tracer(prev)
+        tracer.export()
+        tracer.close()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+class _NullSpan:
+    """The disabled-mode singleton: every method is a no-op. Shared and
+    stateless so ``span()`` allocates nothing when tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def sync(self, value):
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """A live span: records a complete event on ``__exit__``.
+
+    ``set(**attrs)`` attaches arguments (visible in Perfetto's args pane
+    and in the JSONL record). ``sync(value)`` blocks until the device
+    work producing ``value`` is done and annotates the span with the
+    blocked milliseconds — wall time alone cannot split an async
+    dispatch from device completion. A span that exits via an exception
+    is recorded with ``error=True``; where the time went is most valuable
+    exactly when the phase died (same contract as ``timed()``).
+    """
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: Tracer, name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = tracer.now_us()
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        if exc_type is not None:
+            self.args["error"] = True
+        t1 = self._tracer.now_us()
+        self._tracer.add_span(
+            self.name, self._t0, t1 - self._t0, cat=self.cat, args=self.args
+        )
+        return False
+
+    def set(self, **attrs) -> None:
+        self.args.update(attrs)
+
+    def sync(self, value):
+        """``jax.block_until_ready(value)``, annotating the span with the
+        blocked time (``device_wait_ms``) — the device-time attribution
+        seam. Imports jax lazily so the tracer stays stdlib-only."""
+        import jax
+
+        t0 = self._tracer.now_us()
+        out = jax.block_until_ready(value)
+        self.args["device_wait_ms"] = round(
+            (self._tracer.now_us() - t0) / 1e3, 4
+        )
+        return out
+
+
+def span(name: str, cat: str = "app", **attrs):
+    """Open a span on the active tracer (context manager). Disabled mode
+    returns a shared no-op singleton — the unconditional-call contract
+    every hot loop relies on."""
+    tracer = _active
+    if tracer is None:
+        return _NULL_SPAN
+    return Span(tracer, name, cat, attrs)
+
+
+def emit_event(name: str, cat: str = "event", **fields) -> None:
+    """Record an instantaneous structured event (retry fired, fault
+    injected, rollback, preemption…) on the active tracer; no-op when
+    tracing is off. Fields must be JSON-serializable."""
+    tracer = _active
+    if tracer is not None:
+        tracer.add_instant(name, cat=cat, args=fields)

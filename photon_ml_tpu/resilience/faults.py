@@ -60,6 +60,26 @@ KNOWN_SITES = (
 MODES = ("raise", "corrupt", "delay")
 
 
+def _note_injection(
+    site: str, mode: str, call: int, key: Optional[str]
+) -> None:
+    """Record a triggered injection in the obs layer. Import is deferred:
+    the registry/tracer are only touched when a drill actually fires, so
+    unarmed production probes stay one dict lookup."""
+    from photon_ml_tpu import obs
+
+    obs.registry().inc("resilience.faults_injected")
+    obs.registry().inc(f"resilience.faults_injected.{site}")
+    obs.emit_event(
+        "resilience.fault_injected",
+        cat="resilience",
+        site=site,
+        mode=mode,
+        call=call,
+        key=key,
+    )
+
+
 class InjectedFault(OSError):
     """Raised by an armed ``raise``-mode fault. Subclasses OSError so the
     retry layer classifies it as transient I/O — injected crashes exercise
@@ -139,7 +159,10 @@ class FaultInjector:
     def fire(self, site: str, key: Optional[str] = None) -> FaultAction:
         """Probe ``site``: increments its counter, raises / sleeps for
         armed raise/delay specs, and returns whether the site should
-        corrupt its payload. No armed specs -> one dict lookup."""
+        corrupt its payload. No armed specs -> one dict lookup. Every
+        TRIGGERED spec lands in the obs layer (counter + instant event):
+        a drill whose injections aren't visible in the trace can't be
+        told apart from a drill that never fired."""
         specs = self._specs.get(site)
         if not specs:
             return FaultAction()
@@ -149,6 +172,7 @@ class FaultInjector:
         for spec in specs:
             if not spec.triggers(call, key):
                 continue
+            _note_injection(site, spec.mode, call, key)
             if spec.mode == "raise":
                 raise InjectedFault(site, call)
             if spec.mode == "delay":

@@ -16,6 +16,8 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from photon_ml_tpu import obs
+
 DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError,)
 
 
@@ -86,7 +88,24 @@ def retry_call(
             if sleep is None or (
                 deadline is not None and elapsed + sleep > deadline
             ):
+                obs.registry().inc("resilience.retry_exhausted")
+                obs.emit_event(
+                    "resilience.retry_exhausted",
+                    cat="resilience",
+                    label=label,
+                    attempts=attempt,
+                    elapsed_s=round(elapsed, 3),
+                )
                 raise RetryBudgetExceeded(label, attempt, elapsed) from e
+            obs.registry().inc("resilience.retries")
+            obs.emit_event(
+                "resilience.retry",
+                cat="resilience",
+                label=label,
+                attempt=attempt,
+                error=repr(e),
+                sleep_s=round(sleep, 3),
+            )
             if logger is not None:
                 logger.warn(
                     f"{label}: attempt {attempt} failed ({e!r}); "

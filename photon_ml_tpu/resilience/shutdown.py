@@ -54,6 +54,16 @@ class GracefulShutdown:
         first = not self._event.is_set()
         self._event.set()
         if first:
+            # obs note BEFORE draining: may run in signal-handler context,
+            # and both calls are non-blocking (counter inc + list append)
+            from photon_ml_tpu import obs
+
+            obs.registry().inc("resilience.preemptions")
+            obs.emit_event(
+                "resilience.preemption_requested",
+                cat="resilience",
+                signum=signum,
+            )
             self.drain()
 
     # -- drain hooks -------------------------------------------------------
@@ -133,6 +143,14 @@ def write_preempted_marker(
     path = os.path.join(checkpoint_dir, PREEMPTED_MARKER)
     with open(path, "w") as f:
         json.dump({"step": step, "signal": signum}, f)
+    from photon_ml_tpu import obs
+
+    obs.emit_event(
+        "resilience.preempted_marker_written",
+        cat="resilience",
+        step=step,
+        signum=signum,
+    )
     return path
 
 

@@ -2,138 +2,42 @@
 
 The online engine's contract is "steady-state traffic never recompiles and
 tail latency is bounded" — both are claims about *distributions*, so the
-subsystem carries its own measurement: log-spaced latency histograms with
-p50/p95/p99 readout, queue-wait vs device-call split, micro-batch occupancy,
-bucket hit/miss counters, and an XLA compile counter fed straight from
-``jax.monitoring`` (the same event stream the zero-recompile test asserts
-on). Everything is lock-guarded and snapshot-able as plain JSON for the
-``cli/serve`` stats endpoint and ``benchmarks/serving_lab.py``.
+subsystem carries its own measurement. Since the unified observability
+layer landed, the primitives live in :mod:`photon_ml_tpu.obs`:
+``LatencyHistogram`` and the ``jax.monitoring`` compile listener are
+re-exported from here for compatibility, and :class:`ServingStats` is a
+thin aggregation over a :class:`~photon_ml_tpu.obs.MetricsRegistry` —
+same lock discipline, same ``snapshot()`` schema (byte-for-byte: the
+``cli/serve`` stats endpoint and ``benchmarks/serving_lab.py`` parse it),
+but every counter is now also a named registry metric, so one Prometheus
+scrape / ``metrics.json`` dump sees serving next to training and
+resilience.
 """
 
 from __future__ import annotations
 
 import collections
 import json
-import math
 import threading
 import time
 from typing import Dict, Optional
 
-# ---------------------------------------------------------------------------
-# XLA compile events (jax.monitoring)
-# ---------------------------------------------------------------------------
+# promoted to obs/ (PR 3); re-exported so existing imports keep working
+from photon_ml_tpu.obs.compile_events import (  # noqa: F401
+    install_compile_listener,
+    xla_compile_events,
+)
+from photon_ml_tpu.obs.metrics import (  # noqa: F401
+    LatencyHistogram,
+    MetricsRegistry,
+)
 
-# every backend compile fires this duration event exactly once (jax 0.4.x);
-# tracing-only events are deliberately excluded — a cache-hit retrace that
-# does not reach XLA costs microseconds, a backend compile costs seconds
-_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-
-_compile_lock = threading.Lock()
-_compile_events = 0
-_listener_installed = False
-
-
-def _on_event_duration(name: str, _secs: float, **_kw) -> None:
-    global _compile_events
-    if name == _COMPILE_EVENT:
-        with _compile_lock:
-            _compile_events += 1
-
-
-def install_compile_listener() -> None:
-    """Idempotently register the jax.monitoring listener that feeds
-    :func:`xla_compile_events`. Listener registration is global and
-    permanent in jax, so this installs exactly once per process."""
-    global _listener_installed
-    with _compile_lock:
-        if _listener_installed:
-            return
-        _listener_installed = True
-    import jax.monitoring
-
-    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
-
-
-def xla_compile_events() -> int:
-    """Process-wide count of XLA backend compiles observed since
-    :func:`install_compile_listener` — the ground truth the engine's own
-    per-instance ``compile_count`` is cross-checked against in tests."""
-    with _compile_lock:
-        return _compile_events
-
-
-# ---------------------------------------------------------------------------
-# Latency histogram
-# ---------------------------------------------------------------------------
-
-
-class LatencyHistogram:
-    """Log-spaced latency histogram (milliseconds) with quantile readout.
-
-    Fixed geometric bucket edges keep recording O(1) and lock-cheap; the
-    quantile interpolates within the winning bucket, so resolution is the
-    edge ratio (~12% at the default 64 bins over 1e-3..6e4 ms) — plenty
-    for p99 dashboards, and bounded memory regardless of request count.
-    NOT thread-safe on its own; :class:`ServingStats` holds the lock.
-    """
-
-    def __init__(
-        self, lo_ms: float = 1e-3, hi_ms: float = 6e4, bins: int = 64
-    ):
-        self._lo = math.log(lo_ms)
-        self._span = math.log(hi_ms) - self._lo
-        self._bins = bins
-        self.counts = [0] * (bins + 2)  # + underflow/overflow
-        self.count = 0
-        self.sum_ms = 0.0
-        self.max_ms = 0.0
-
-    def _edge(self, i: int) -> float:
-        return math.exp(self._lo + self._span * i / self._bins)
-
-    def record(self, ms: float) -> None:
-        self.count += 1
-        self.sum_ms += ms
-        if ms > self.max_ms:
-            self.max_ms = ms
-        if ms <= 0:
-            b = 0
-        else:
-            f = (math.log(ms) - self._lo) / self._span
-            b = min(max(int(f * self._bins) + 1, 0), self._bins + 1)
-        self.counts[b] += 1
-
-    def quantile(self, q: float) -> float:
-        """q in [0, 1] -> latency in ms (0.0 when empty)."""
-        if self.count == 0:
-            return 0.0
-        target = q * self.count
-        seen = 0
-        for b, c in enumerate(self.counts):
-            seen += c
-            if seen >= target and c > 0:
-                if b == 0:
-                    return self._edge(0)
-                if b == self._bins + 1:
-                    return self.max_ms
-                # geometric midpoint of the winning bucket
-                return math.sqrt(self._edge(b - 1) * self._edge(b))
-        return self.max_ms
-
-    def snapshot(self) -> Dict[str, float]:
-        return {
-            "count": self.count,
-            "mean_ms": self.sum_ms / self.count if self.count else 0.0,
-            "p50_ms": round(self.quantile(0.50), 4),
-            "p95_ms": round(self.quantile(0.95), 4),
-            "p99_ms": round(self.quantile(0.99), 4),
-            "max_ms": round(self.max_ms, 4),
-        }
-
-
-# ---------------------------------------------------------------------------
-# Aggregate serving stats
-# ---------------------------------------------------------------------------
+__all__ = [
+    "LatencyHistogram",
+    "ServingStats",
+    "install_compile_listener",
+    "xla_compile_events",
+]
 
 
 class ServingStats:
@@ -143,33 +47,62 @@ class ServingStats:
     - ``device_ms``: per-micro-batch device call (featurize + dispatch).
     - occupancy: rows per micro-batch (how well coalescing works).
     - buckets: padded-size hit/miss counters; a miss is a NEW compile.
+
+    Backed by a :class:`MetricsRegistry` under the ``serving.`` prefix
+    (pass ``registry=`` to share one; default is a private instance so
+    two engines in one process don't cross-count). Counter attributes
+    (``requests``, ``batches``, …) remain readable exactly as before.
     """
 
-    def __init__(self, qps_window: int = 4096):
+    _COUNTERS = (
+        "requests",
+        "batches",
+        "rejected",
+        "errors",
+        "compile_count",
+        "bucket_hits",
+        "bucket_misses",
+        "reloads",
+        "occupancy_sum",
+    )
+
+    def __init__(
+        self,
+        qps_window: int = 4096,
+        registry: Optional[MetricsRegistry] = None,
+    ):
         self._lock = threading.Lock()
+        self.registry = registry if registry is not None else MetricsRegistry()
         self.started = time.monotonic()
-        self.requests = 0
-        self.batches = 0
-        self.rejected = 0  # backpressure: bounded queue was full
-        self.errors = 0
-        self.compile_count = 0
-        self.bucket_hits = 0
-        self.bucket_misses = 0
-        self.reloads = 0
-        self.occupancy_sum = 0
+        for name in self._COUNTERS:
+            self.registry.counter(f"serving.{name}")
+        self.request_ms = self.registry.histogram("serving.request_ms")
+        self.device_ms = self.registry.histogram("serving.device_ms")
+        # per-bucket row counts keyed by padded size; kept as a host dict
+        # (dynamic keys) and mirrored into `serving.bucket.<size>` counters
         self.bucket_counts: Dict[int, int] = collections.Counter()
-        self.request_ms = LatencyHistogram()
-        self.device_ms = LatencyHistogram()
         self._recent = collections.deque(maxlen=qps_window)
+
+    def __getattr__(self, name: str):
+        # counter attributes read through to the registry (the pre-obs
+        # surface: tests and the lab assert on stats.batches etc.)
+        if name in ServingStats._COUNTERS:
+            return self.registry.counter(f"serving.{name}").value
+        raise AttributeError(
+            f"{type(self).__name__!s} has no attribute {name!r}"
+        )
+
+    def _inc(self, name: str, amount: float = 1.0) -> None:
+        self.registry.counter(f"serving.{name}").inc(amount)
 
     # -- recording ---------------------------------------------------------
 
     def record_batch(self, size: int, device_s: float) -> None:
         now = time.monotonic()
         with self._lock:
-            self.batches += 1
-            self.requests += size
-            self.occupancy_sum += size
+            self._inc("batches")
+            self._inc("requests", size)
+            self._inc("occupancy_sum", size)
             self.device_ms.record(device_s * 1e3)
             self._recent.extend([now] * size)
 
@@ -180,26 +113,24 @@ class ServingStats:
     def record_bucket(self, bucket: int, hit: bool) -> None:
         with self._lock:
             self.bucket_counts[bucket] += 1
-            if hit:
-                self.bucket_hits += 1
-            else:
-                self.bucket_misses += 1
+            self._inc(f"bucket.{bucket}")
+            self._inc("bucket_hits" if hit else "bucket_misses")
 
     def record_compile(self) -> None:
         with self._lock:
-            self.compile_count += 1
+            self._inc("compile_count")
 
     def record_rejected(self) -> None:
         with self._lock:
-            self.rejected += 1
+            self._inc("rejected")
 
     def record_error(self) -> None:
         with self._lock:
-            self.errors += 1
+            self._inc("errors")
 
     def record_reload(self) -> None:
         with self._lock:
-            self.reloads += 1
+            self._inc("reloads")
 
     # -- readout -----------------------------------------------------------
 
@@ -217,23 +148,25 @@ class ServingStats:
     def snapshot(self) -> dict:
         qps = self.qps()
         with self._lock:
+            requests = self.requests
+            batches = self.batches
             return {
                 "uptime_s": round(time.monotonic() - self.started, 3),
-                "requests": self.requests,
-                "batches": self.batches,
-                "rejected": self.rejected,
-                "errors": self.errors,
-                "reloads": self.reloads,
+                "requests": int(requests),
+                "batches": int(batches),
+                "rejected": int(self.rejected),
+                "errors": int(self.errors),
+                "reloads": int(self.reloads),
                 "qps": round(qps, 2),
                 "batch_occupancy_mean": (
-                    self.occupancy_sum / self.batches if self.batches else 0.0
+                    self.occupancy_sum / batches if batches else 0.0
                 ),
                 "buckets": {
                     str(k): v for k, v in sorted(self.bucket_counts.items())
                 },
-                "bucket_hits": self.bucket_hits,
-                "bucket_misses": self.bucket_misses,
-                "compile_count": self.compile_count,
+                "bucket_hits": int(self.bucket_hits),
+                "bucket_misses": int(self.bucket_misses),
+                "compile_count": int(self.compile_count),
                 "request_latency": self.request_ms.snapshot(),
                 "device_latency": self.device_ms.snapshot(),
             }

@@ -23,6 +23,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.core.types import _pytree_dataclass
 
@@ -99,6 +100,33 @@ class SolverResult:
     # (ModelTracker); entries at index > iterations are unwritten zeros
     # and must be masked by callers like the values buffer
     w_history: Optional[jax.Array] = None
+
+
+def record_solver_metrics(prefix: str, result: "SolverResult", registry=None) -> None:
+    """Feed one completed solve's counters into the metrics registry
+    under ``solver.<prefix>.*`` plus the cross-optimizer aggregate
+    ``solver.iterations`` (docs/OBSERVABILITY.md).
+
+    Materializes the result's iteration counters — a device->host fetch —
+    so call sites must gate on observability being enabled: the disabled
+    path cannot afford a sync inserted between pipelined solves
+    (bench.py's pipelined-solve measurement depends on that)."""
+    from photon_ml_tpu import obs
+
+    reg = registry if registry is not None else obs.registry()
+    iters = float(np.asarray(result.iterations))
+    reg.inc(f"solver.{prefix}.solves")
+    reg.inc(f"solver.{prefix}.iterations", iters)
+    reg.inc("solver.iterations", iters)
+    if result.cg_iterations is not None:
+        reg.inc(
+            f"solver.{prefix}.cg_iterations",
+            float(np.asarray(result.cg_iterations)),
+        )
+    if result.evals is not None:
+        reg.inc(
+            f"solver.{prefix}.evals", float(np.asarray(result.evals))
+        )
 
 
 def project_to_hypercube(

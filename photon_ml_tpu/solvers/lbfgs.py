@@ -460,3 +460,15 @@ def minimize_owlqn(
         w_history=final.w_history if config.track_models else None,
         evals=final.evals,
     )
+
+
+def record_solve_metrics(
+    result: SolverResult, registry=None, owlqn: bool = False
+) -> None:
+    """L-BFGS / OWL-QN counters into the obs registry:
+    ``solver.<lbfgs|owlqn>.iterations`` plus ``.evals`` (value+grad
+    passes == full design reads, the pass-cost ceiling basis). Host-side
+    and synchronizing; callers gate on observability being enabled."""
+    from photon_ml_tpu.solvers.common import record_solver_metrics
+
+    record_solver_metrics("owlqn" if owlqn else "lbfgs", result, registry)

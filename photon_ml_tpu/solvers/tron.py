@@ -322,3 +322,13 @@ def minimize_tron(
         cg_iterations=final.cg_total,
         w_history=final.w_history if config.track_models else None,
     )
+
+
+def record_solve_metrics(result: SolverResult, registry=None) -> None:
+    """TRON counters into the obs registry: ``solver.tron.iterations``
+    (outer trust-region steps) and ``solver.tron.cg_iterations`` (inner
+    CG == Hessian-vector products — the FLOP-accounting basis). Host-side
+    and synchronizing; callers gate on observability being enabled."""
+    from photon_ml_tpu.solvers.common import record_solver_metrics
+
+    record_solver_metrics("tron", result, registry)

@@ -5,18 +5,45 @@ SLF4J logger writing to an HDFS file because grid log ingestion was
 unreliable; the durable artifact (a ``log-message.txt`` next to the models)
 is the part users depend on, so that contract is kept: every driver run
 leaves its full log in the output directory. Also carries the reference's
-phase-timing habit (``Driver.scala:124-149``) as a ``timed`` context.
+phase-timing habit (``Driver.scala:124-149``) as a ``timed`` context —
+which now additionally emits a span to the active tracer
+(:mod:`photon_ml_tpu.obs`), so every existing ``timed()`` call site lands
+in the Perfetto timeline for free.
+
+``PHOTON_LOG_LEVEL`` (env) overrides the constructed level — an operator
+can turn a production run's logging down (or a drill's up) without
+touching driver configs.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import sys
 import time
 from typing import Optional, TextIO
 
 _LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "ERROR": 40}
+
+ENV_LEVEL_VAR = "PHOTON_LOG_LEVEL"
+
+
+def _resolve_level(level: str) -> int:
+    """Constructor level, unless ``PHOTON_LOG_LEVEL`` overrides it. An
+    unknown env value is reported once and ignored — a typo in a launch
+    script must not crash the driver it was meant to quiet."""
+    env = os.environ.get(ENV_LEVEL_VAR)
+    if env:
+        name = env.strip().upper()
+        if name in _LEVELS:
+            return _LEVELS[name]
+        print(
+            f"{ENV_LEVEL_VAR}={env!r} is not one of {sorted(_LEVELS)}; "
+            f"using {level!r}",
+            file=sys.stderr,
+        )
+    return _LEVELS[level.upper()]
 
 
 class PhotonLogger:
@@ -25,6 +52,10 @@ class PhotonLogger:
     ``PhotonLogger(path)`` opens ``path`` for append; pass ``None`` for
     console-only. Level filtering mirrors the reference's
     ``setLogLevel`` (debug default in the drivers, ``Driver.scala:532``).
+    With ``jsonl=True`` the file side writes one structured record per
+    line (``{"ts": unix, "level": ..., "msg": ...}``) instead of the
+    human-formatted text — machine-ingestable without a line parser; the
+    console side stays human-formatted either way.
     """
 
     def __init__(
@@ -32,18 +63,24 @@ class PhotonLogger:
         path: Optional[str] = None,
         level: str = "DEBUG",
         stream: Optional[TextIO] = None,
+        jsonl: bool = False,
     ):
-        self.level = _LEVELS[level.upper()]
+        self.level = _resolve_level(level)
         self.stream = stream if stream is not None else sys.stderr
+        self.jsonl = jsonl
         self._file = None
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self._file = open(path, "a")
+            # explicit utf-8: the durable artifact must not depend on the
+            # host locale (a POSIX-C grid node would otherwise write ASCII
+            # and die on the first non-ASCII feature name in a message)
+            self._file = open(path, "a", encoding="utf-8")
 
     def _emit(self, level: str, msg: str) -> None:
         if _LEVELS[level] < self.level:
             return
-        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        now = time.time()
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
         line = f"{stamp} [{level}] {msg}"
         # a closed stream/file must not turn a log call into a ValueError —
         # shutdown paths log AFTER teardown started (e.g. a timed() phase
@@ -52,7 +89,15 @@ class PhotonLogger:
         if not getattr(self.stream, "closed", False):
             print(line, file=self.stream)
         if self._file is not None and not self._file.closed:
-            self._file.write(line + "\n")
+            if self.jsonl:
+                self._file.write(
+                    json.dumps(
+                        {"ts": round(now, 6), "level": level, "msg": msg}
+                    )
+                    + "\n"
+                )
+            else:
+                self._file.write(line + "\n")
             self._file.flush()
 
     def debug(self, msg: str) -> None:
@@ -81,13 +126,18 @@ class PhotonLogger:
 
 @contextlib.contextmanager
 def timed(logger: Optional[PhotonLogger], label: str):
-    """Log the wall-clock of a phase (``Driver.scala:232-291`` timing).
-    Failed phases still report their duration — where the time went is
-    most valuable exactly when the phase died."""
+    """Log the wall-clock of a phase (``Driver.scala:232-291`` timing)
+    AND emit a span to the active tracer, so every phase a driver already
+    times shows up in the unified trace. Failed phases still report their
+    duration — where the time went is most valuable exactly when the
+    phase died."""
+    from photon_ml_tpu.obs import span as _span
+
     t0 = time.perf_counter()
     ok = True
     try:
-        yield
+        with _span(label, cat="phase"):
+            yield
     except BaseException:
         ok = False
         raise

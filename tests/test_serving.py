@@ -742,3 +742,28 @@ class TestServingLab:
         # the printed line is the parseable BENCH record
         line = capsys.readouterr().out.strip().splitlines()[-1]
         assert json.loads(line)["metric"] == "serving_p99_ms"
+
+
+class TestMetricsCommand:
+    def test_metrics_cmd_returns_prometheus_text(self, rng, tmp_path):
+        """The serve protocol's observability surface: {"cmd": "metrics"}
+        exposes the serving registry (plus the process default) in
+        Prometheus text format, without touching the stats snapshot
+        schema existing consumers parse."""
+        from photon_ml_tpu.cli.serve import serve_lines
+
+        root = _save_disk_model(str(tmp_path / "m"), rng)
+        reg = ModelRegistry(warmup_max_batch=8)
+        reg.load(root)
+        batcher = MicroBatcher(reg.score, max_wait_ms=0.5, stats=reg.stats)
+        lines = [
+            json.dumps({"features": {"uf0": 1.0}}),
+            json.dumps({"cmd": "metrics"}),
+        ]
+        out = StringIO()
+        serve_lines(iter(lines), out, batcher, reg, reg.stats)
+        batcher.drain()
+        replies = [json.loads(s) for s in out.getvalue().splitlines()]
+        text = replies[1]["prometheus"]
+        assert "# TYPE photon_serving_requests counter" in text
+        assert "photon_serving_request_ms_count" in text

@@ -508,3 +508,43 @@ class TestDebugHarness:
             }
         )
         assert os.path.isdir(os.path.join(out, "profile"))
+
+
+class TestCachedSolveZeroRecompile:
+    """The training-side analog of serving's zero-recompile guarantee
+    (docs/OBSERVABILITY.md): ``_build_solver`` caches ONE jitted solve
+    per config shape with reg weights as traced arguments, so a second
+    train_glm at a new lambda — the lambda path, GAME CD rounds,
+    bootstrap replicas — must reach steady state without a single new
+    XLA backend compile."""
+
+    def test_repeat_solves_do_not_recompile(self, rng):
+        from photon_ml_tpu.obs import (
+            install_compile_listener,
+            xla_compile_events,
+        )
+
+        install_compile_listener()
+        x, y = make_logistic_data(rng, n=400, d=8, intercept=False)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float64)
+
+        def cfg(lam):
+            return GLMTrainingConfig(
+                task=TaskType.LOGISTIC_REGRESSION,
+                optimizer=OptimizerType.TRON,
+                regularization=RegularizationContext("L2"),
+                reg_weights=(lam,),
+                tolerance=1e-8,
+                max_iters=30,
+            )
+
+        (warm,) = train_glm(batch, cfg(2.0))  # compile + warm
+        np.asarray(warm.model.coefficients.means)
+        before = xla_compile_events()
+        for lam in (1.0, 0.5, 0.25):
+            (tm,) = train_glm(batch, cfg(lam))
+            np.asarray(tm.model.coefficients.means)
+        assert xla_compile_events() == before, (
+            "cached-solve path recompiled in steady state: reg weights "
+            "must ride as traced arguments, never trace-time constants"
+        )
