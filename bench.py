@@ -81,12 +81,11 @@ def _phase(label, fn, *args, **kwargs):
         _PHASE_S[label] = round(time.perf_counter() - t0, 3)
 
 
-# TPU v5e peak dense matmul throughput (bf16), FLOP/s
-PEAK_FLOPS = 197e12
-# TPU v5e HBM bandwidth, bytes/s — the relevant roofline for GLM solves
-# (each objective pass streams the design matrix; arithmetic intensity is
-# ~2 FLOP/byte, far below the ~240 FLOP/byte compute-bound knee)
-PEAK_HBM_BPS = 819e9
+# The TPU v5e roofline constants (peak bf16 matmul FLOP/s, HBM bytes/s)
+# moved into the shared cost book (photon_ml_tpu.obs.xla_cost) in the
+# device-observability PR: bench, training spans, and serving all divide
+# by the SAME peaks. Imported lazily inside the benches — this module
+# must stay importable before backend selection (--cpu).
 
 
 def _dense_click_data(n, n_test, d, seed=42):
@@ -207,6 +206,40 @@ def bench_glm_dense():
     ones = jnp.ones((n,), jnp.float32)
     batch = LabeledBatch(xd, yd, jnp.zeros((n,), jnp.float32), ones, ones)
 
+    # ONE objective pass's cost record from the shared cost book (XLA's
+    # own FLOPs + bytes for the fused value/grad — the 2-matmul unit of
+    # the solver pass counts below). The analytic fallbacks reproduce
+    # the former hand arithmetic (4nd FLOPs; two bf16 design reads) on
+    # backends without a cost analysis, so MFU/hbm_util stay comparable
+    # across rounds either way.
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.ops.objective import GLMObjective
+
+    _obj_cost = GLMObjective(
+        loss=loss_for_task(TaskType.LOGISTIC_REGRESSION), l2_weight=lam
+    )
+    pass_rec = obs.cost_book().record(
+        "glm.objective_pass",
+        jax.jit(lambda w_, b_: _obj_cost.value_and_grad(w_, b_)).lower(
+            jnp.zeros((d,), jnp.float32), batch
+        ),
+        bucket=f"{n}x{d}",
+        analytic_flops=4.0 * n * d,
+        analytic_bytes=2.0 * x_bf16.nbytes,
+        # roofline traffic = two bf16 design reads per pass (margins +
+        # backprojection): XLA's static count includes bf16->f32
+        # convert materializations the fused matmul never pays, and the
+        # HBM ceiling must be judged on real traffic
+        roofline_bytes=2.0 * x_bf16.nbytes,
+    )
+    log(
+        f"cost book glm.objective_pass[{n}x{d}]: "
+        f"{pass_rec.flops / 1e9:.2f} GFLOP, "
+        f"{(pass_rec.bytes_accessed or 0) / 1e9:.2f} GB accessed/pass "
+        f"({pass_rec.source})"
+    )
+
     def config(lam_):
         return GLMTrainingConfig(
             task=TaskType.LOGISTIC_REGRESSION,
@@ -233,12 +266,14 @@ def bench_glm_dense():
         dt = time.perf_counter() - t0
         iters = int(tm.result.iterations)
         cg = int(tm.result.cg_iterations)
-        # fused value/grad = 2 matmuls (margins + backproject) = 4nd
-        # FLOPs; each CG Hessian-vector product is 2 matmuls (the CG's
-        # curvature weights ride the acceptance evaluation — the vgc path
-        # in solvers/tron.py — so no extra setup pass). +1 initial vgc.
-        passes = iters + 1 + cg  # in 2-matmul (one-design-pass) units
-        fl = passes * 4.0 * n * d
+        # counted design passes in the cost record's unit (one fused
+        # value/grad = 2 matmuls; each CG Hessian-vector product rides
+        # the vgc acceptance path) — solvers.common.design_passes, the
+        # SAME accounting traced solves attach to their spans
+        from photon_ml_tpu.solvers import design_passes
+
+        passes = design_passes(tm.result)
+        fl = passes * pass_rec.flops
         auc = float(
             area_under_roc_curve(
                 jnp.asarray(yte),
@@ -280,21 +315,20 @@ def bench_glm_dense():
     tpu_s = max(pipe_total - rtt_probe["rtt_ms"] / 1e3, 1e-9) / k_pipe
     # FLOP numerator from the SAME solves the time denominator measures
     # (different lambdas can take different iteration/CG counts)
-    pipe_passes = [
-        int(tm_.result.iterations) + 1 + int(tm_.result.cg_iterations)
-        for tm_ in pipe
-    ]
+    pipe_passes = [design_passes(tm_.result) for tm_ in pipe]
     passes_per_solve = float(np.mean(pipe_passes))
-    pipe_fl = passes_per_solve * 4.0 * n * d
     log(
         f"pipelined {k_pipe} solves: {pipe_total:.3f}s total "
         f"(rtt {rtt_probe['rtt_ms']:.0f} ms) -> {tpu_s:.4f}s/solve device "
         f"({passes_per_solve:.1f} passes/solve)"
     )
-    mfu = pipe_fl / tpu_s / PEAK_FLOPS
-    # each pass reads the bf16 design twice (margins + backprojection)
-    hbm_bytes = passes_per_solve * 2.0 * x_bf16.nbytes
-    hbm_util = hbm_bytes / tpu_s / PEAK_HBM_BPS
+    # MFU / HBM utilization from the shared cost book: counted passes x
+    # the pass record's FLOPs/bytes over device time, against the ONE
+    # set of roofline peaks (obs.xla_cost) traced training spans use
+    hw = pass_rec.achieved(tpu_s, passes=passes_per_solve)
+    pipe_fl = hw.get("flops", 0.0)
+    mfu = hw.get("mfu", 0.0)
+    hbm_util = hw.get("hbm_util", 0.0)
 
     from sklearn.linear_model import LogisticRegression
 
@@ -1126,9 +1160,6 @@ def bench_sparse_feature_scaling(print_json=False):
     On real chips (b)+(c) are what linear scaling in d follows from: the
     per-pass irregular-access cost is proportional to per-device stored
     slots, which the curve shows dividing by F."""
-    import re
-    from collections import Counter
-
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1147,7 +1178,11 @@ def bench_sparse_feature_scaling(print_json=False):
         feature_sharded_train_glm,
         make_feature_mesh,
     )
-    from photon_ml_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+    from photon_ml_tpu.parallel.mesh import (
+        DATA_AXIS,
+        FEATURE_AXIS,
+        set_mesh,
+    )
 
     n, d, nnz = 60_000, 120_000, 32
     rng = np.random.default_rng(13)
@@ -1192,21 +1227,23 @@ def bench_sparse_feature_scaling(print_json=False):
         )
         pb = dataclasses.replace(batch, features=placed)
         obj = GLMObjective(loss=LOGISTIC_LOSS, l2_weight=1.0)
-        with jax.set_mesh(mesh):
+        # compat wrapper: newer jax exposes jax.set_mesh, 0.4.x spells
+        # it jax.sharding.use_mesh / set_mesh — parallel.mesh bridges
+        with set_mesh(mesh):
             comp = (
                 jax.jit(lambda w, b: obj.value_and_grad(w, b))
                 .lower(w0, pb)
                 .compile()
             )
-        ma = comp.memory_analysis()
-        colls = Counter(
-            m.split("-start")[0]
-            for m in re.findall(
-                r"\b(all-reduce(?:-start)?|all-gather(?:-start)?|"
-                r"all-to-all|reduce-scatter|collective-permute)\b",
-                comp.as_text(),
-            )
+        # per-device footprint + collective counts via the shared cost
+        # book (memory_analysis + the generalized collective regex that
+        # used to be inlined right here — obs.xla_cost.count_collectives)
+        from photon_ml_tpu import obs
+
+        rec = obs.cost_book().record(
+            "sparse.objective_pass", comp, bucket=f"F{f_shards}"
         )
+        colls = rec.collectives
         t0 = time.perf_counter()
         (tm,) = feature_sharded_train_glm(batch, cfg, mesh)
         w_sol = np.asarray(tm.model.coefficients.means)
@@ -1218,9 +1255,9 @@ def bench_sparse_feature_scaling(print_json=False):
         out[str(f_shards)] = {
             "wall_s": round(wall, 3),
             "per_device_arg_mb": round(
-                ma.argument_size_in_bytes / 1e6, 2
+                (rec.argument_bytes or 0) / 1e6, 2
             ),
-            "per_device_temp_mb": round(ma.temp_size_in_bytes / 1e6, 2),
+            "per_device_temp_mb": round((rec.temp_bytes or 0) / 1e6, 2),
             "per_device_coef_kb": round(d_block / f_shards * 4 / 1e3, 1),
             "per_device_slots_m": round(per_dev_slots / 1e6, 3),
             "collectives": dict(colls),
@@ -1325,6 +1362,13 @@ def main():
     parser.add_argument(
         "--sparse-only", action="store_true",
         help="run only the sparse benchmark (iteration aid)",
+    )
+    parser.add_argument(
+        "--sentinel", action="store_true",
+        help="after printing the record, gate it against the repo's "
+        "BENCH_r*.json history (benchmarks/regression_sentinel.py "
+        "semantics; exit nonzero on regression). Also enabled by "
+        "PHOTON_BENCH_SENTINEL=1.",
     )
     args = parser.parse_args()
     if args.cpu:
@@ -1444,22 +1488,51 @@ def main():
         extra["ingest_vs_python_codec"] = round(ingest["speedup"], 1)
     # where the bench run's own wall clock went + the final metrics
     # registry (solver iteration counters, ingest/checkpoint bytes,
-    # recompiles when the compile listener was installed)
+    # recompiles when the compile listener was installed) + the XLA
+    # cost book every MFU/HBM/collective number above came from
     from photon_ml_tpu import obs
 
     extra["phase_s"] = dict(_PHASE_S)
     extra["metrics"] = obs.registry().snapshot()
-    print(
-        json.dumps(
-            {
-                "metric": "logreg_1Mx256_tron_wallclock",
-                "value": round(glm["tpu_s"], 4),
-                "unit": "s",
-                "vs_baseline": round(glm["cpu_s"] / glm["tpu_s"], 3),
-                "extra": extra,
-            }
+    extra["cost_book"] = obs.cost_book().snapshot()
+    record = {
+        "metric": "logreg_1Mx256_tron_wallclock",
+        "value": round(glm["tpu_s"], 4),
+        "unit": "s",
+        "vs_baseline": round(glm["cpu_s"] / glm["tpu_s"], 3),
+        "extra": extra,
+    }
+    print(json.dumps(record))
+    if args.sentinel or os.environ.get("PHOTON_BENCH_SENTINEL"):
+        # opt-in regression gate: the record just produced vs the
+        # committed BENCH history (same fit as the standalone
+        # benchmarks/regression_sentinel.py — median + MAD-widened
+        # band, direction-aware)
+        import glob
+
+        from photon_ml_tpu.obs.sentinel import run_sentinel
+
+        hist = sorted(
+            glob.glob(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_r*.json",
+                )
+            )
         )
-    )
+        regs, baselines, n_hist = run_sentinel(hist, record)
+        if regs:
+            for r in regs:
+                log(f"SENTINEL REGRESSION: {r.describe()}")
+            log(
+                f"sentinel: {len(regs)}/{len(baselines)} tracked "
+                f"metrics regressed vs {n_hist} history records"
+            )
+            sys.exit(1)
+        log(
+            f"sentinel: {len(baselines)} tracked metrics within "
+            f"tolerance vs {n_hist} history records"
+        )
 
 
 if __name__ == "__main__":

@@ -4,10 +4,18 @@ The unified tracer's contract is "near-zero overhead when disabled, small
 when enabled" (docs/OBSERVABILITY.md). This micro-benchmark makes the
 second half enforceable: it runs the SAME smoke GAME coordinate-descent
 workload with observability disabled and with the full envelope enabled
-(span tracer + JSONL event log + metrics registry dumps), compares medians
-of repeated measurements, and EXITS NONZERO when the enabled/disabled
-ratio exceeds the threshold — wire it into CI and a chatty span added to
-the hot loop fails the build instead of silently taxing every run.
+(span tracer + JSONL event log + metrics registry dumps + XLA cost
+attribution on every coordinate dispatch + the HBM sampler installed),
+compares medians of repeated measurements, and EXITS NONZERO when the
+enabled/disabled ratio exceeds the threshold — wire it into CI and a
+chatty span added to the hot loop fails the build instead of silently
+taxing every run.
+
+Cost attribution lowers each dispatch program once per CD instance
+(cached; the min-of-repeats excludes that one-time trace like it
+excludes compile). The HBM sampler is a no-op on hosts whose devices
+report no memory stats — which includes this gate's CPU environment —
+so its enabled-mode price here is one probe per envelope.
 
 Also reports the raw disabled-mode ``span()`` call cost (the
 unconditional-call contract: one global read + a shared no-op singleton).

@@ -97,6 +97,9 @@ class GLMDriverParams:
     trace_dir: Optional[str] = None
     metrics_every: float = 0.0
     profile_dir: Optional[str] = None
+    # live HBM telemetry sample interval (seconds) while tracing; 0
+    # disables. No-op on platforms without device.memory_stats()
+    hbm_every: float = 0.5
 
     def validate(self) -> None:
         if not self.train_input:
@@ -268,6 +271,9 @@ class GameDriverParams:
     trace_dir: Optional[str] = None
     metrics_every: float = 0.0
     profile_dir: Optional[str] = None
+    # live HBM telemetry sample interval (seconds) while tracing; 0
+    # disables. No-op on platforms without device.memory_stats()
+    hbm_every: float = 0.5
 
     def validate(self) -> None:
         if not self.train_input:

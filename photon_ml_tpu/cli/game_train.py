@@ -98,6 +98,14 @@ def _validate_multiprocess_params(params: GameDriverParams) -> None:
             "validate_input (validation rows would need the same entity "
             "partitioning; score offline with cli.score)"
         )
+    if params.initial_model_dir:
+        problems.append(
+            "initial_model_dir (warm start: the loaded RE tables are "
+            "remapped by POSITION into each process's local entity "
+            "vocabulary before globalization, so coefficients would "
+            "silently attach to the wrong entities; warm-start a "
+            "single-process run or export per-partition models)"
+        )
     if params.sparse_shards:
         problems.append("sparse_shards (the projected-sparse RE path is "
                         "per-process host work)")
@@ -119,6 +127,27 @@ def _validate_multiprocess_params(params: GameDriverParams) -> None:
             "multi-process GAME training does not support: "
             + "; ".join(problems)
         )
+
+
+def _ordered_entity_ids(re_key: str, vocab: dict) -> list:
+    """One process's entity vocabulary, ordered by local index, for the
+    string allgather that globalizes it. Ids must ALREADY be str: a
+    silent str() coercion here would re-key the globalized vocabulary
+    with different key types than a single-process run (int id 7 ->
+    "7"), breaking warm-start/scoring lookups that carry the original
+    type — so non-str ids fail loudly instead."""
+    ordered = [None] * len(vocab)
+    for raw, i in vocab.items():
+        if not isinstance(raw, str):
+            raise ValueError(
+                f"random effect {re_key!r}: entity id {raw!r} is "
+                f"{type(raw).__name__}, not str — multi-process GAME "
+                "requires string entity ids (coerce them at ingest, "
+                "before the vocabulary is built, so every process and "
+                "every artifact agrees on key types)"
+            )
+        ordered[i] = raw
+    return ordered
 
 
 def _pad_game_data(data: GameData, n_target: int) -> GameData:
@@ -435,6 +464,7 @@ def run_game_training(params) -> GameTrainingRun:
             metrics_path=metrics_path,
             metrics_every=params.metrics_every,
             profile_dir=params.profile_dir,
+            hbm_every_s=params.hbm_every,
             process_name="photon_ml_tpu.game_train",
         ):
             return _run_game_training(params, logger, shutdown)
@@ -561,10 +591,7 @@ def _run_game_training(
             # process p is entity_base_p + local index
             for k in sorted(entity_vocabs):
                 vocab = entity_vocabs[k]
-                ordered = [None] * len(vocab)
-                for raw, i in vocab.items():
-                    ordered[i] = str(raw)
-                all_raw = allgather_strings(ordered)
+                all_raw = allgather_strings(_ordered_entity_ids(k, vocab))
                 if len(set(all_raw)) != len(all_raw):
                     from collections import Counter
 
@@ -1017,6 +1044,11 @@ def main(argv=None) -> None:
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the run here",
     )
+    p.add_argument(
+        "--hbm-every", type=float, default=None,
+        help="seconds between live HBM counter-track samples while "
+        "tracing (0 disables; no-op without device memory stats)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1038,6 +1070,8 @@ def main(argv=None) -> None:
         base["metrics_every"] = args.metrics_every
     if args.profile_dir is not None:
         base["profile_dir"] = args.profile_dir
+    if args.hbm_every is not None:
+        base["hbm_every"] = args.hbm_every
     run_game_training(base)
 
 

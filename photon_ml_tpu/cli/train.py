@@ -176,6 +176,7 @@ def run_glm_training(params) -> GLMTrainingRun:
         metrics_path=metrics_path,
         metrics_every=params.metrics_every,
         profile_dir=params.profile_dir,
+        hbm_every_s=params.hbm_every,
         process_name="photon_ml_tpu.train",
     ):
         return _run_glm_training(params)
@@ -532,6 +533,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the WHOLE run here "
         "(--profile captures only the train phase)",
+    )
+    p.add_argument(
+        "--hbm-every", type=float, default=None,
+        help="seconds between live HBM counter-track samples while "
+        "tracing (0 disables; no-op without device memory stats)",
     )
     return p
 

@@ -375,7 +375,15 @@ class FixedEffectCoordinate:
         scalar keeps the DEFAULT float width (f64 under x64) so fused
         modes see the exact same lambda the plain loop casts from the
         config float — a forced f32 here would silently perturb
-        non-representable lambdas (e.g. 0.1) in float64 runs."""
+        non-representable lambdas (e.g. 0.1) in float64 runs.
+
+        SAME-OBJECT CONTRACT: every leaf that does not depend on
+        ``reg_weight`` must be returned as the IDENTICAL array object
+        on every call (here: ``self.batch``/perm attributes, never
+        copies). ``run_grid`` discovers broadcastable leaves by object
+        identity across two probe calls; a fresh-but-equal object is
+        stacked once per combo instead of broadcast — n_combo x the
+        leaf's HBM (run_grid warns when a large leaf trips this)."""
         return (
             self.batch,
             self._row_perm,
@@ -691,7 +699,13 @@ class RandomEffectCoordinate:
         reference's ``GLMOptimizationConfiguration`` grid; a coordinate
         built with CUSTOM per-entity weights refuses (silently
         discarding them would break run_grid's sequential-equivalence
-        guarantee)."""
+        guarantee).
+
+        SAME-OBJECT CONTRACT (see the fixed-effect counterpart): only
+        the freshly-built per-entity weight vector may vary per call;
+        the design buckets, row features, entity indices, and offsets
+        must be the SAME objects every time so run_grid broadcasts them
+        instead of stacking n_combo copies of the dataset."""
         if not getattr(self, "_uniform_reg", True):
             raise ValueError(
                 "grid sweeps replace the coordinate's shared reg weight; "

@@ -348,6 +348,27 @@ class CoordinateDescent:
         return self._chunk_fns, states
 
 
+    def _pass_cost(self, label: str, lower_thunk):
+        """Cost-book record for one dispatch program (a chunked
+        coordinate step or the fused whole-pass), lazily lowered via
+        ``lower_thunk`` and cached on the instance — the lowering is a
+        re-trace, so it runs once per (CD, program) and only when a
+        tracer asked for attribution. Analysis uses the LOWERED stage:
+        no backend compile, so the run's zero-recompile invariants
+        (``xla.compiles``) are untouched. Returns None when the program
+        cannot be analyzed; attribution is best-effort."""
+        cache = getattr(self, "_pass_cost_records", None)
+        if cache is None:
+            cache = self._pass_cost_records = {}
+        if label not in cache:
+            try:
+                cache[label] = obs.cost_book().record(
+                    "game.update", lower_thunk(), bucket=label
+                )
+            except Exception:
+                cache[label] = None
+        return cache[label]
+
     def run(
         self,
         num_iterations: int,
@@ -592,10 +613,24 @@ class CoordinateDescent:
             tracer = obs.get_tracer()
             pass_t0 = time.perf_counter()
             pass_ts = tracer.now_us() if tracer is not None else 0.0
+            pass_recs = []  # cost records of this pass's dispatches
             if use_fused:
-                t0 = time.perf_counter()
                 params_in = {n: model.params[n] for n in names}
                 fused = self._fused_pass_fn()
+                if tracer is not None:
+                    fstates = {
+                        n: self.coordinates[n].fused_state() for n in names
+                    }
+                    pass_recs.append(
+                        self._pass_cost(
+                            "fused",
+                            lambda: self._fused_pass.lower(
+                                fstates, self.labels, self.base_offsets,
+                                self.weights, params_in, scores, key,
+                            ),
+                        )
+                    )
+                t0 = time.perf_counter()
                 params_out, scores, key, objs, trackers = fused(
                     params_in, scores, key
                 )
@@ -644,21 +679,42 @@ class CoordinateDescent:
                     with obs.span(
                         "game.update", cat="game",
                         coordinate=name, iteration=it,
-                    ):
-                        t0 = time.perf_counter()
+                    ) as upd_span:
                         key, sub = jax.random.split(key)
+                        params_in = {n: model.params[n] for n in names}
+                        rec = None
+                        if tracer is not None:
+                            rec = self._pass_cost(
+                                name,
+                                lambda: fns[name].lower(
+                                    states, self.labels,
+                                    self.base_offsets, self.weights,
+                                    params_in, scores, sub,
+                                ),
+                            )
+                            pass_recs.append(rec)
+                        t0 = time.perf_counter()
                         p, tr, s, obj = fns[name](
                             states,
                             self.labels,
                             self.base_offsets,
                             self.weights,
-                            {n: model.params[n] for n in names},
+                            params_in,
                             scores,
                             sub,
                         )
                         model.params[name] = p
                         scores = {**scores, name: s}
                         seconds = time.perf_counter() - t0
+                        # wall of the (async) dispatch window — flagged
+                        # so nobody reads chunked-mode MFU as synced
+                        # device time (the deferred-stats pipelining
+                        # must not gain a block_until_ready here)
+                        if rec is not None:
+                            obs.annotate_span(
+                                upd_span, rec, seconds=seconds
+                            )
+                            upd_span.set(timing="wall")
                         vmetric = (
                             float(validation_fn(model))
                             if validation_fn is not None
@@ -800,13 +856,41 @@ class CoordinateDescent:
                         )
             pass_seconds = time.perf_counter() - pass_t0
             if tracer is not None:
+                pass_args = {"iteration": it, "coordinates": len(names)}
+                # hardware attribution of the WHOLE pass: the sum of
+                # this pass's dispatch cost records (one fused program,
+                # or one per chunked coordinate update) over the pass
+                # wall — live MFU for coordinate passes in the trace
+                flops = sum(
+                    r.flops for r in pass_recs
+                    if r is not None and r.flops
+                )
+                bytes_acc = sum(
+                    r.bytes_accessed for r in pass_recs
+                    if r is not None and r.bytes_accessed
+                )
+                if flops or bytes_acc:
+                    from photon_ml_tpu.obs.xla_cost import CostRecord
+
+                    pass_args["timing"] = "wall"
+                    pass_args.update(
+                        CostRecord(
+                            name="game.pass",
+                            bucket="",
+                            flops=flops or None,
+                            bytes_accessed=bytes_acc or None,
+                        ).achieved(pass_seconds)
+                    )
                 tracer.add_span(
                     "game.pass",
                     pass_ts,
                     pass_seconds * 1e6,
                     cat="game",
-                    args={"iteration": it, "coordinates": len(names)},
+                    args=pass_args,
                 )
+                # live HBM counter-track sample at the pass boundary
+                # (graceful no-op where memory_stats is unsupported)
+                obs.sample_hbm()
             _reg = obs.registry()
             _reg.inc("game.passes")
             _reg.observe("game.pass_ms", pass_seconds * 1e3)
@@ -850,6 +934,11 @@ class CoordinateDescent:
             self.coordinates[n].score(model.params[n])
             for n in self.coordinates
         )
+
+
+# stacked-leaf audit threshold: below this a broadcast miss costs noise;
+# above it the grid multiplies a real buffer (designs, row features)
+_GRID_STACK_WARN_BYTES = 1 << 20
 
 
 def run_grid(
@@ -910,13 +999,44 @@ def run_grid(
     axes = jax.tree_util.tree_map(
         lambda a, b: None if a is b else 0, probe_a, probe_b
     )
-    states = jax.tree_util.tree_map(
-        lambda *leaves: (
-            leaves[0]
-            if all(l is leaves[0] for l in leaves)
-            else jnp.stack(leaves)
-        ),
-        *per_combo,
+
+    # Same-OBJECT contract (``FixedEffectCoordinate.fused_state_for_reg``
+    # documents it): combo-invariant leaves must come back as the
+    # identical array object on every call so the identity test above
+    # broadcasts them. A coordinate that rebuilds an invariant leaf per
+    # call still trains CORRECTLY — but the leaf gets stacked n_combo
+    # times, multiplying its footprint by the grid size. Detect the
+    # miss for leaves where that costs real memory (value-equal across
+    # the first two combos yet not the same object) and warn loudly
+    # instead of silently burning HBM.
+    def _stack_with_audit(path, *leaves):
+        if all(l is leaves[0] for l in leaves):
+            return leaves[0]
+        stacked = jnp.stack(leaves)
+        if (
+            getattr(stacked, "nbytes", 0) >= _GRID_STACK_WARN_BYTES
+            and np.array_equal(
+                np.asarray(leaves[0]), np.asarray(leaves[1])
+            )
+        ):
+            import warnings
+
+            leaf_name = jax.tree_util.keystr(path)
+            warnings.warn(
+                f"run_grid: leaf {leaf_name} ({stacked.nbytes / 1e6:.1f}"
+                f" MB stacked) is value-identical across combos but was "
+                "returned as a fresh object by fused_state_for_reg, so "
+                "it is stacked x{} instead of broadcast — return the "
+                "SAME array object for combo-invariant leaves".format(
+                    n_combo
+                ),
+                RuntimeWarning,
+                stacklevel=3,
+            )
+        return stacked
+
+    states = jax.tree_util.tree_map_with_path(
+        _stack_with_audit, *per_combo
     )
     vfns = {
         n: jax.vmap(fns[n], in_axes=(axes, None, None, None, 0, 0, None))

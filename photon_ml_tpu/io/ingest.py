@@ -671,8 +671,12 @@ class IngestSource:
         # dynamic_update_slice: a jnp.concatenate would hold every chunk
         # AND the output alive at once (2x device HBM — defeating the
         # scaling this path exists for); donation writes each chunk into
-        # the target and frees it, so the device peak is the dataset
-        # plus ONE chunk.
+        # the target and frees it. Chunk lists are consumed
+        # DESTRUCTIVELY (pop + per-field release below): holding every
+        # deposited chunk alive until the last field assembled put the
+        # true peak back at ~2x the dataset — each chunk's device buffer
+        # must become collectible the moment its deposit is enqueued, so
+        # the device peak is the dataset plus ONE in-flight chunk.
         import functools
 
         @functools.partial(jax.jit, donate_argnums=(0,))
@@ -685,21 +689,34 @@ class IngestSource:
             shape = (total,) if width is None else (total, width)
             buf = jnp.zeros(shape, chunks[0].dtype)
             off = 0
-            for c in chunks:
+            while chunks:
+                c = chunks.pop(0)
                 # off rides as a traced scalar: one compile per chunk
                 # SHAPE, not per offset
                 buf = _deposit(buf, c, jnp.asarray(off, jnp.int32))
                 off += c.shape[0]
+                del c  # last host reference; the device buffer frees
             return buf
 
-        features = assemble(dev_feats, d)
-        batch = LabeledBatch.create(
-            features,
-            assemble(dev_labels),
-            offsets=assemble(dev_offsets),
-            weights=assemble(dev_weights),
-            dtype=out_dtype,
-        )
+        # hbm_watermark: on HBM-bearing platforms the assembly peak
+        # lands in hbm.io.ingest.assemble.* gauges + an hbm.watermark
+        # event, making the dataset-plus-one-chunk contract observable
+        with obs.hbm_watermark("io.ingest.assemble"):
+            features = assemble(dev_feats, d)
+            dev_feats = None  # the widest field: drop before the next
+            labels = assemble(dev_labels)
+            dev_labels = None
+            offsets = assemble(dev_offsets)
+            dev_offsets = None
+            weights = assemble(dev_weights)
+            dev_weights = None
+            batch = LabeledBatch.create(
+                features,
+                labels,
+                offsets=offsets,
+                weights=weights,
+                dtype=out_dtype,
+            )
         uids = np.concatenate(uids_parts)
         present = np.concatenate(present_parts)
         return batch, uids, present

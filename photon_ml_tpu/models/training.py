@@ -22,8 +22,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import threading
+import time
 from functools import lru_cache
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -310,6 +312,64 @@ def _record_solve_metrics(config: GLMTrainingConfig, result) -> None:
         record_solver_metrics(config.optimizer.name.lower(), result)
 
 
+# One objective-pass cost-book record per (solver-config kind, batch
+# geometry): the per-span MFU numerator unit, scaled by the solve's
+# counted design passes (``solvers.common.design_passes``). The lowering
+# re-traces the objective — cheap next to a solve, but not free — so it
+# runs ONLY under an active tracer and exactly once per key; analysis
+# happens on the LOWERED stage (no backend compile, so the xla.compiles
+# zero-recompile invariants are untouched).
+_pass_cost_lock = threading.Lock()
+_pass_cost_cache: Dict[tuple, object] = {}
+
+
+def _leaf_key(tree) -> tuple:
+    return tuple(
+        (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+        for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _objective_pass_cost(config: GLMTrainingConfig, batch, norm):
+    """Cost record of ONE fused value/grad pass over ``batch`` (the
+    2-matmul unit of ``design_passes``), from the shared cost book.
+    Returns None when the objective cannot be analyzed — attribution is
+    best-effort and must never fail a solve."""
+    key = (
+        dataclasses.replace(config, reg_weights=(0.0,)),
+        _leaf_key(batch),
+        _leaf_key(norm),
+    )
+    with _pass_cost_lock:
+        if key in _pass_cost_cache:
+            return _pass_cost_cache[key]
+    rec = None
+    try:
+        import numpy as np
+
+        loss = loss_for_task(config.task)
+        obj = GLMObjective(
+            loss=loss, normalization=norm, l2_weight=1.0
+        )
+        d = batch.num_features
+        n = int(np.shape(batch.labels)[0])
+        w0 = jax.ShapeDtypeStruct((d,), solve_dtype(batch))
+        lowered = jax.jit(
+            lambda w, b: obj.value_and_grad(w, b)
+        ).lower(w0, batch)
+        rec = obs.cost_book().record(
+            "glm.objective_pass",
+            lowered,
+            bucket=f"{n}x{d}",
+            analytic_flops=4.0 * n * d,
+        )
+    except Exception:
+        rec = None
+    with _pass_cost_lock:
+        _pass_cost_cache[key] = rec
+    return rec
+
+
 _summarize_jit = jax.jit(summarize_features)
 
 
@@ -373,6 +433,7 @@ def train_glm(
             optimizer=config.optimizer.name,
             reg_weight=float(lam),
         ) as sp:
+            t0 = time.perf_counter()
             result = solve(w, jnp.asarray(lam, dtype), batch, norm)
             if obs.get_tracer() is not None:
                 # device-time attribution + per-solve iteration counters.
@@ -381,6 +442,18 @@ def train_glm(
                 # (bench.py) free of inserted host syncs.
                 sp.sync(result.w)
                 _record_solve_metrics(config, result)
+                # live hardware attribution: counted design passes x the
+                # cost book's per-pass FLOPs/bytes over the synchronized
+                # dispatch-to-done window -> flops / achieved_tflops /
+                # mfu / bytes_per_s span args (docs/OBSERVABILITY.md)
+                from photon_ml_tpu.solvers.common import design_passes
+
+                obs.annotate_span(
+                    sp,
+                    _objective_pass_cost(config, batch, norm),
+                    seconds=time.perf_counter() - t0,
+                    passes=design_passes(result),
+                )
         w = result.w  # warm start for the next (smaller) lambda
         if config.track_models and result.w_history is not None:
             # snapshots leave the solver in normalized space; de-normalize

@@ -34,6 +34,14 @@ from photon_ml_tpu.obs.compile_events import (
     install_compile_listener,
     xla_compile_events,
 )
+from photon_ml_tpu.obs.device import (
+    HbmSampler,
+    HbmWatermark,
+    hbm_supported,
+    hbm_watermark,
+    read_memory_stats,
+    sample_hbm,
+)
 from photon_ml_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -50,6 +58,14 @@ from photon_ml_tpu.obs.trace import (
     set_tracer,
     span,
     trace,
+)
+from photon_ml_tpu.obs.xla_cost import (
+    CostBook,
+    CostRecord,
+    annotate_span,
+    cost_book,
+    count_collectives,
+    set_cost_book,
 )
 
 __all__ = [
@@ -68,6 +84,18 @@ __all__ = [
     "trace",
     "install_compile_listener",
     "xla_compile_events",
+    "CostBook",
+    "CostRecord",
+    "annotate_span",
+    "cost_book",
+    "count_collectives",
+    "set_cost_book",
+    "HbmSampler",
+    "HbmWatermark",
+    "hbm_supported",
+    "hbm_watermark",
+    "read_memory_stats",
+    "sample_hbm",
     "MetricsDumper",
     "observe",
 ]
@@ -121,13 +149,17 @@ def observe(
     metrics_path: Optional[str] = None,
     metrics_every: float = 0.0,
     profile_dir: Optional[str] = None,
+    hbm_every_s: float = 0.5,
     process_name: str = "photon_ml_tpu",
 ):
     """Driver-level enable-everything context.
 
     - ``trace_dir``: install the span tracer; ``trace.json`` +
       ``events.jsonl`` land there on exit. Also installs the compile
-      listener so recompiles show up in the timeline and registry.
+      listener so recompiles show up in the timeline and registry, and —
+      on platforms whose devices report ``memory_stats()`` — a live HBM
+      sampler emitting counter tracks every ``hbm_every_s`` seconds
+      (0 disables; unsupported platforms cost one probe).
     - ``metrics_path`` (+ ``metrics_every`` seconds): periodic default-
       registry snapshots; a final snapshot is always written on exit.
       With only ``trace_dir`` set, ``metrics.json`` defaults into it.
@@ -141,10 +173,12 @@ def observe(
     if metrics_path is None and trace_dir is not None:
         metrics_path = os.path.join(trace_dir, "metrics.json")
     dumper = None
+    hbm = None
     with contextlib.ExitStack() as stack:
         if trace_dir is not None:
             install_compile_listener()
             stack.enter_context(trace(trace_dir, process_name=process_name))
+            hbm = HbmSampler(hbm_every_s).start()
         if profile_dir is not None:
             import jax
 
@@ -158,5 +192,7 @@ def observe(
         try:
             yield
         finally:
+            if hbm is not None:
+                hbm.stop()
             if dumper is not None:
                 dumper.stop()

@@ -1,0 +1,261 @@
+"""Bench regression sentinel: machine-checked perf baselines.
+
+Five BENCH records exist (``BENCH_r01..r05``) with order-of-magnitude
+swings between rounds (GAME CD 1.19 -> 10.1 iters/s), yet nothing
+machine-checks that the NEXT change doesn't silently give those wins
+back — the records were write-only history. This module turns them into
+a gate:
+
+- :func:`flatten_record` maps one parsed BENCH record (the
+  ``{"metric", "value", ..., "extra": {...}}`` JSON line) to flat dotted
+  numeric metrics.
+- :func:`metric_direction` classifies each metric as higher-is-better
+  (throughput, speedup ratios, MFU, AUC), lower-is-better (wall clocks,
+  per-device footprints, collective counts), or untracked (environment
+  noise: tunnel RTT, phase walls, registry dumps — regressions there are
+  not code regressions).
+- :func:`fit_baselines` fits a noise-tolerant baseline per metric over
+  the history: median plus a tolerance band widened by the metric's own
+  historical dispersion (MAD-scaled), so a metric that legitimately
+  swings across rounds gets a wide band instead of a false alarm, while
+  a historically-stable metric is held tight.
+- :func:`check_record` compares a current record against the baselines
+  and returns the regressions (direction-aware). New metrics and missing
+  metrics are tolerated — growth must not be penalized.
+
+``benchmarks/regression_sentinel.py`` is the CLI (standalone / CI);
+``bench.py --sentinel`` runs the same check on the record it just
+produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import statistics
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "flatten_record",
+    "metric_direction",
+    "Baseline",
+    "Regression",
+    "fit_baselines",
+    "check_record",
+    "load_bench_record",
+    "run_sentinel",
+]
+
+# Defaults tuned on the real BENCH_r01..r05 history: every metric of r05
+# passes against the r01..r04 baseline, while a uniform 30% degradation
+# of r05's tracked throughput/wall metrics is flagged.
+DEFAULT_TOLERANCE = 0.25
+DEFAULT_MAD_K = 4.0
+DEFAULT_MIN_SAMPLES = 2
+
+HIGHER_IS_BETTER = 1
+LOWER_IS_BETTER = -1
+UNTRACKED = 0
+
+# First match wins; order: untracked overrides, then higher, then lower.
+_DIRECTION_RULES = (
+    # environment / identity noise, not code performance
+    (re.compile(r"(^|\.)rtt_ms"), UNTRACKED),
+    (re.compile(r"dense_wall_incl_rtt_s$"), UNTRACKED),
+    (re.compile(r"max_dw"), UNTRACKED),
+    (re.compile(r"transfer_gb$"), UNTRACKED),
+    (re.compile(r"(^|\.)phase_s\."), UNTRACKED),
+    (re.compile(r"(^|\.)metrics\."), UNTRACKED),
+    (re.compile(r"(^|\.)cost_book\."), UNTRACKED),
+    (re.compile(r"predicted_over_observed$"), UNTRACKED),
+    # bigger is better
+    (
+        re.compile(
+            r"(vs_baseline|vs_cpu|vs_sklearn|vs_python_codec|vs_ell"
+            r"|speedup)$"
+        ),
+        HIGHER_IS_BETTER,
+    ),
+    (re.compile(r"(iters_per_s|rec_per_s|per_s)$"), HIGHER_IS_BETTER),
+    (re.compile(r"(^|\.)mfu$"), HIGHER_IS_BETTER),
+    (re.compile(r"hbm_util$"), HIGHER_IS_BETTER),
+    (re.compile(r"achieved_tflops$"), HIGHER_IS_BETTER),
+    (re.compile(r"auc"), HIGHER_IS_BETTER),
+    # smaller is better
+    (re.compile(r"(_s|_ms|_mb|_kb|_m)$"), LOWER_IS_BETTER),
+    (re.compile(r"(^|\.)passes$"), LOWER_IS_BETTER),
+    (re.compile(r"^value$"), LOWER_IS_BETTER),
+    (re.compile(r"collectives\."), LOWER_IS_BETTER),
+    (re.compile(r"bytes"), LOWER_IS_BETTER),
+)
+
+
+def metric_direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 untracked."""
+    for pattern, direction in _DIRECTION_RULES:
+        if pattern.search(name):
+            return direction
+    return UNTRACKED
+
+
+def flatten_record(parsed: dict) -> Dict[str, float]:
+    """Parsed BENCH record -> flat ``{dotted.metric: float}``. ``value``
+    keeps its name; ``extra`` flattens recursively; non-numeric leaves
+    (metric name, unit, strings) are dropped. Booleans are excluded —
+    ``True`` is not a measurement."""
+    out: Dict[str, float] = {}
+
+    def walk(prefix: str, obj) -> None:
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+            out[prefix] = float(obj)
+
+    if "value" in parsed:
+        walk("value", parsed["value"])
+    walk("extra", parsed.get("extra") or {})
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Baseline:
+    """Per-metric fitted baseline: history median plus a relative
+    tolerance band (``tol``), direction-aware."""
+
+    metric: str
+    median: float
+    tol: float
+    direction: int
+    n_samples: int
+
+    def bound(self) -> float:
+        """The worst still-acceptable value."""
+        if self.direction == HIGHER_IS_BETTER:
+            return self.median * (1.0 - self.tol)
+        return self.median * (1.0 + self.tol)
+
+
+@dataclasses.dataclass(frozen=True)
+class Regression:
+    metric: str
+    current: float
+    baseline: Baseline
+
+    def describe(self) -> str:
+        arrow = (
+            "below" if self.baseline.direction == HIGHER_IS_BETTER else "above"
+        )
+        return (
+            f"{self.metric}: {self.current:g} is {arrow} the tolerated "
+            f"bound {self.baseline.bound():g} (median {self.baseline.median:g}"
+            f" over {self.baseline.n_samples} records, band "
+            f"±{self.baseline.tol:.0%})"
+        )
+
+
+def fit_baselines(
+    history: Sequence[Dict[str, float]],
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+    tolerance: float = DEFAULT_TOLERANCE,
+    mad_k: float = DEFAULT_MAD_K,
+) -> Dict[str, Baseline]:
+    """Fit per-metric baselines over flattened history records.
+
+    The band is ``max(tolerance, mad_k * MAD/|median|)``: the floor
+    absorbs run-to-run noise every metric has; the MAD term widens the
+    band for metrics whose own history swings (a metric that moved 10x
+    between rounds cannot honestly gate a 30% change). Metrics seen in
+    fewer than ``min_samples`` records, with a ~zero median, or
+    classified untracked get no baseline.
+    """
+    samples: Dict[str, List[float]] = {}
+    for rec in history:
+        for name, value in rec.items():
+            samples.setdefault(name, []).append(value)
+    out: Dict[str, Baseline] = {}
+    for name, vals in samples.items():
+        direction = metric_direction(name)
+        if direction == UNTRACKED or len(vals) < min_samples:
+            continue
+        med = statistics.median(vals)
+        if abs(med) < 1e-12:
+            continue  # relative bands are meaningless at zero
+        mad = statistics.median(abs(v - med) for v in vals)
+        tol = max(tolerance, mad_k * mad / abs(med))
+        out[name] = Baseline(
+            metric=name,
+            median=med,
+            tol=tol,
+            direction=direction,
+            n_samples=len(vals),
+        )
+    return out
+
+
+def check_record(
+    current: Dict[str, float], baselines: Dict[str, Baseline]
+) -> List[Regression]:
+    """Regressions of ``current`` vs fitted baselines, worst first.
+    Metrics absent from either side are tolerated (renames and new
+    instrumentation must not fail the gate)."""
+    regs: List[Regression] = []
+    for name, base in baselines.items():
+        cur = current.get(name)
+        if cur is None:
+            continue
+        if base.direction == HIGHER_IS_BETTER:
+            bad = cur < base.bound()
+        else:
+            bad = cur > base.bound()
+        if bad:
+            regs.append(Regression(metric=name, current=cur, baseline=base))
+    regs.sort(
+        key=lambda r: -(
+            abs(r.current - r.baseline.median) / abs(r.baseline.median)
+        )
+    )
+    return regs
+
+
+def load_bench_record(path: str) -> Optional[dict]:
+    """The ``parsed`` record of one BENCH_*.json file (None when the
+    round failed or the file predates the parsed field)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        if doc.get("rc", 0) == 0:
+            return doc["parsed"]
+        return None
+    # a bare record (bench.py's own output) is accepted as-is
+    if isinstance(doc, dict) and "extra" in doc:
+        return doc
+    return None
+
+
+def run_sentinel(
+    history_paths: Sequence[str],
+    current: dict,
+    min_samples: int = DEFAULT_MIN_SAMPLES,
+    tolerance: float = DEFAULT_TOLERANCE,
+    mad_k: float = DEFAULT_MAD_K,
+):
+    """History files + a current parsed record -> (regressions,
+    fitted baselines, n_history_records)."""
+    history = []
+    for p in history_paths:
+        rec = load_bench_record(p)
+        if rec is not None:
+            history.append(flatten_record(rec))
+    baselines = fit_baselines(
+        history,
+        min_samples=min_samples,
+        tolerance=tolerance,
+        mad_k=mad_k,
+    )
+    regs = check_record(flatten_record(current), baselines)
+    return regs, baselines, len(history)

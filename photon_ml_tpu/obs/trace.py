@@ -199,6 +199,35 @@ class Tracer:
                 flush=True,
             )
 
+    def add_counter(
+        self, name: str, values: Dict[str, float]
+    ) -> None:
+        """Record a Chrome counter-track sample ('C' event): Perfetto
+        renders successive samples of the same ``name`` as a stacked
+        area graph under the timeline — the HBM telemetry surface
+        (``obs.device``). Samples are periodic and bulky, so the JSONL
+        mirror rides the batched span flush, not the instant-event
+        immediate flush."""
+        ev = {
+            "ph": "C",
+            "name": name,
+            "cat": "counter",
+            "pid": self._pid,
+            "tid": 0,
+            "ts": round(self.now_us(), 3),
+            "args": dict(values),
+        }
+        with self._lock:
+            self._events.append(ev)
+            self._log_jsonl(
+                {
+                    "kind": "counter",
+                    "name": name,
+                    "time_unix": round(self._wall(ev["ts"]), 6),
+                    **values,
+                }
+            )
+
     # -- readout ------------------------------------------------------------
 
     def events(self) -> List[Dict[str, Any]]:

@@ -38,11 +38,14 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu import obs
 
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.game.scoring import (
@@ -292,6 +295,13 @@ class ScoringEngine:
         if prior is compiled:
             self.compile_count += 1
             self.stats.record_compile()
+            # cost-book the fresh executable (FLOPs, footprint,
+            # collectives) keyed by bucket — per-bucket score spans read
+            # this back for live MFU attribution; the analyses run on an
+            # already-compiled object, so recording costs attribute reads
+            obs.cost_book().record(
+                "serving.score", compiled, bucket=str(bucket)
+            )
         self.stats.record_bucket(bucket, hit=False)
         return prior
 
@@ -327,8 +337,12 @@ class ScoringEngine:
             buckets = warmup_buckets(
                 max_batch or self.max_bucket, self.min_bucket
             )
-        for b in buckets:
-            self._ensure_compiled(int(b))
+        # watermark the warmup: AOT-compiling the bucket ladder is the
+        # engine's HBM commitment point (one executable + workspace per
+        # bucket) — regressions here show as hbm.serving.warmup.* gauges
+        with obs.hbm_watermark("serving.warmup"):
+            for b in buckets:
+                self._ensure_compiled(int(b))
         return list(buckets)
 
     # -- featurization (host-side, numpy only: no tracing on this path) ----
@@ -421,7 +435,20 @@ class ScoringEngine:
         compiled = self._ensure_compiled(
             bucket, {s: feats_p[s].shape[1] for s in self._used_shards}
         )
-        out = np.asarray(compiled(self._params, feats_p, ents_p))[:n]
+        with obs.span(
+            "serving.score", cat="serving", bucket=bucket, rows=n
+        ) as sp:
+            t0 = time.perf_counter()
+            out = np.asarray(compiled(self._params, feats_p, ents_p))[:n]
+            if obs.get_tracer() is not None:
+                # the np.asarray above already synchronized, so the
+                # window is true dispatch-to-done device time; annotate
+                # live MFU for this score bucket from the cost book
+                obs.annotate_span(
+                    sp,
+                    obs.cost_book().lookup("serving.score", str(bucket)),
+                    seconds=time.perf_counter() - t0,
+                )
         if offsets is not None:
             out = out + np.asarray(offsets, out.dtype)
         return out

@@ -15,6 +15,7 @@ from photon_ml_tpu.solvers.common import (
     ConvergenceReason,
     SolverConfig,
     SolverResult,
+    design_passes,
     project_to_hypercube,
 )
 from photon_ml_tpu.solvers.lbfgs import minimize_lbfgs, minimize_owlqn
@@ -25,6 +26,7 @@ __all__ = [
     "ConvergenceReason",
     "SolverConfig",
     "SolverResult",
+    "design_passes",
     "project_to_hypercube",
     "minimize_lbfgs",
     "minimize_owlqn",

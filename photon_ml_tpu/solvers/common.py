@@ -102,6 +102,27 @@ class SolverResult:
     w_history: Optional[jax.Array] = None
 
 
+def design_passes(result: "SolverResult") -> float:
+    """Counted full design passes of one completed solve, in the
+    2-matmul (one value/grad-equivalent) unit every FLOP accounting in
+    the repo uses — bench.py's pipelined-MFU numerator and the cost
+    book's per-span attribution share THIS function so they cannot
+    drift. TRON: iterations + 1 initial vgc + CG Hessian-vector
+    products (the curvature weights ride the acceptance evaluation, so
+    no extra setup pass). First-order solvers: tracked value/grad
+    evaluations. Fallback (exotic results): iterations + 1.
+    Materializes device scalars — callers gate on observability."""
+    if result.cg_iterations is not None:
+        return (
+            float(np.asarray(result.iterations))
+            + 1.0
+            + float(np.asarray(result.cg_iterations))
+        )
+    if result.evals is not None:
+        return float(np.asarray(result.evals))
+    return float(np.asarray(result.iterations)) + 1.0
+
+
 def record_solver_metrics(prefix: str, result: "SolverResult", registry=None) -> None:
     """Feed one completed solve's counters into the metrics registry
     under ``solver.<prefix>.*`` plus the cross-optimizer aggregate

@@ -1209,3 +1209,54 @@ class TestMeshShardedDriver:
                     "mesh_shape": {"entity": 2},
                 }
             )
+
+
+class TestMultiprocessValidation:
+    """Unit drills for the multi-process GAME parameter gate — these
+    never spawn processes, they exercise the validation surface."""
+
+    def _params(self, tmp_path, **over):
+        from photon_ml_tpu.cli.config import GameDriverParams, load_params
+
+        base = game_params(
+            "train", None, "gs", "us", str(tmp_path / "out"), **over
+        )
+        # the gate's supported surface: no validation rows, num_buckets=1
+        base["validate_input"] = []
+        for spec in base["coordinates"].values():
+            spec["num_buckets"] = 1
+        return load_params(base, GameDriverParams)
+
+    def test_supported_surface_passes(self, tmp_path):
+        from photon_ml_tpu.cli.game_train import (
+            _validate_multiprocess_params,
+        )
+
+        _validate_multiprocess_params(self._params(tmp_path))
+
+    def test_warm_start_rejected(self, tmp_path):
+        """Warm start remaps RE tables by POSITION into each process's
+        local entity vocabulary — coefficients would silently attach to
+        the wrong entities. The gate must fail loudly."""
+        from photon_ml_tpu.cli.game_train import (
+            _validate_multiprocess_params,
+        )
+
+        params = self._params(
+            tmp_path, initial_model_dir=str(tmp_path / "prev")
+        )
+        with pytest.raises(ValueError, match="initial_model_dir"):
+            _validate_multiprocess_params(params)
+
+    def test_non_string_entity_ids_rejected_at_globalization(self):
+        """The entity-vocabulary globalization must refuse non-str ids
+        instead of silently str()-coercing them (which would re-key the
+        global vocab with different types than single-process runs)."""
+        from photon_ml_tpu.cli.game_train import _ordered_entity_ids
+
+        assert _ordered_entity_ids("userId", {"u1": 1, "u0": 0}) == [
+            "u0",
+            "u1",
+        ]
+        with pytest.raises(ValueError, match="not str"):
+            _ordered_entity_ids("userId", {7: 0, "u1": 1})

@@ -168,6 +168,70 @@ class TestLabeledBatch:
             atol=1e-10,
         )
 
+    def test_streamed_assembly_hbm_watermark(self, tmp_path, monkeypatch):
+        """The streamed assembly is bracketed by the new HBM telemetry:
+        an ``hbm.watermark`` event labeled ``io.ingest.assemble`` (plus
+        peak/delta gauges) lands whenever the platform reports memory
+        stats — scripted here, since CPU reports none — making the
+        dataset-plus-one-chunk peak contract of the destructive chunk
+        consumption observable instead of assumed."""
+        import json as _json
+        import os as _os
+
+        from photon_ml_tpu import obs
+        from photon_ml_tpu.obs import device as device_mod
+        from photon_ml_tpu.obs.metrics import MetricsRegistry
+
+        calls = {"n": 0}
+
+        def fake_stats(device=None):
+            calls["n"] += 1
+            return {
+                "bytes_in_use": 1000 * calls["n"],
+                "peak_bytes_in_use": 1000 * calls["n"],
+            }
+
+        monkeypatch.setattr(device_mod, "read_memory_stats", fake_stats)
+
+        paths = []
+        for i, n in enumerate([80, 50]):
+            recs = _records(n, seed=30 + i)
+            p = str(tmp_path / f"part-{i}.avro")
+            write_avro_file(p, TRAINING_EXAMPLE_SCHEMA, recs)
+            paths.append(p)
+        vocab = FeatureVocabulary(
+            [f"f{i}\x01t" for i in range(200)], add_intercept=True
+        )
+        reg = MetricsRegistry()
+        prev = obs.set_registry(reg)
+        tdir = str(tmp_path / "trace")
+        try:
+            with obs.trace(tdir):
+                batch, _, _ = IngestSource(paths).labeled_batch_streamed(
+                    vocab
+                )
+        finally:
+            obs.set_registry(prev)
+        assert batch.num_features == 201
+        events = [
+            _json.loads(line)
+            for line in open(_os.path.join(tdir, "events.jsonl"))
+        ]
+        marks = [
+            e
+            for e in events
+            if e.get("name") == "hbm.watermark"
+            and e.get("label") == "io.ingest.assemble"
+        ]
+        assert len(marks) == 1
+        assert marks[0]["peak_bytes"] > 0
+        assert marks[0]["delta_bytes"] == (
+            marks[0]["after_bytes"] - marks[0]["before_bytes"]
+        )
+        gauges = reg.snapshot()["gauges"]
+        assert "hbm.io.ingest.assemble.peak_bytes" in gauges
+        assert "hbm.io.ingest.assemble.delta_bytes" in gauges
+
     def test_tiny_vocab(self, tmp_path):
         """Vocabulary blobs short enough for std::string SSO — regression
         for the in-place Vocab construction (a moved SSO string dangles

@@ -790,3 +790,519 @@ class TestDriverSurfacing:
         assert c["io.ingest.bytes_read"] > 0
         assert c["io.checkpoint.bytes_written"] > 0
         assert c["game.passes"] == n_iter
+
+# ---------------------------------------------------------------------------
+# XLA cost book
+# ---------------------------------------------------------------------------
+
+
+class TestCostBook:
+    def test_compiled_matmul_record(self):
+        """XLA-measured FLOPs of a known matmul (2mnk), compiled-only
+        memory fields, lookup/snapshot round trip."""
+        import jax
+
+        from photon_ml_tpu.obs.xla_cost import CostBook
+
+        m = 64
+        comp = (
+            jax.jit(lambda a, b: a @ b)
+            .lower(
+                jnp.zeros((m, m), jnp.float32),
+                jnp.zeros((m, m), jnp.float32),
+            )
+            .compile()
+        )
+        book = CostBook()
+        reg = MetricsRegistry()
+        rec = book.record("drill.mm", comp, bucket="64", registry=reg)
+        assert rec.flops == 2.0 * m * m * m
+        assert rec.source == "compiled"
+        assert rec.argument_bytes == 2 * m * m * 4
+        assert rec.collectives == {}
+        assert book.lookup("drill.mm", "64") is rec
+        assert book.lookup("drill.mm", "128") is None
+        snap = book.snapshot()
+        assert snap["drill.mm.64"]["flops"] == rec.flops
+        assert reg.snapshot()["gauges"]["xla.cost.drill.mm.64.flops"] == (
+            rec.flops
+        )
+
+    def test_sharded_objective_collectives_match_former_regex(
+        self, devices
+    ):
+        """The cost book's collective counts on a feature-sharded
+        objective pass must equal what bench.py's former inline regex
+        found in the same HLO — the generalization cannot drift from
+        the accounting the BENCH history was built with."""
+        import dataclasses as _dc
+        import re as _re
+        from collections import Counter as _Counter
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.core.types import LabeledBatch
+        from photon_ml_tpu.obs.xla_cost import CostBook
+        from photon_ml_tpu.ops import sparse as sparse_ops
+        from photon_ml_tpu.ops.losses import LOGISTIC_LOSS
+        from photon_ml_tpu.ops.objective import GLMObjective
+        from photon_ml_tpu.parallel import make_feature_mesh
+        from photon_ml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            FEATURE_AXIS,
+            set_mesh,
+        )
+
+        n, d, nnz, f_shards = 512, 1024, 8, 4
+        rng = np.random.default_rng(3)
+        rows = np.repeat(np.arange(n), nnz)
+        cols = rng.integers(0, d, size=n * nnz)
+        vals = rng.standard_normal(n * nnz).astype(np.float32)
+        sf = sparse_ops.from_coo(rows, cols, vals, n, d, dtype=jnp.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        batch = LabeledBatch.create(sf, y, dtype=jnp.float32)
+        mesh = make_feature_mesh(1, f_shards)
+        blocked = sparse_ops.shard_columns(batch.features, f_shards)
+        spec = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS, None))
+        placed = sparse_ops.FeatureShardedSparse(
+            indices=jax.device_put(blocked.indices, spec),
+            values=jax.device_put(blocked.values, spec),
+            d_shard=blocked.d_shard,
+            d_orig=blocked.d_orig,
+        )
+        w0 = jax.device_put(
+            jnp.zeros((f_shards * blocked.d_shard,), jnp.float32),
+            NamedSharding(mesh, P(FEATURE_AXIS)),
+        )
+        pb = _dc.replace(batch, features=placed)
+        obj = GLMObjective(loss=LOGISTIC_LOSS, l2_weight=1.0)
+        with set_mesh(mesh):
+            comp = (
+                jax.jit(lambda w, b: obj.value_and_grad(w, b))
+                .lower(w0, pb)
+                .compile()
+            )
+        rec = CostBook().record(
+            "drill.sharded_pass", comp, bucket=f"F{f_shards}"
+        )
+        # bench.py's former inline regex, verbatim
+        former = _Counter(
+            m.split("-start")[0]
+            for m in _re.findall(
+                r"\b(all-reduce(?:-start)?|all-gather(?:-start)?|"
+                r"all-to-all|reduce-scatter|collective-permute)\b",
+                comp.as_text(),
+            )
+        )
+        assert rec.collectives == dict(former)
+        # the sharded margin reduction must actually be there
+        assert rec.collectives.get("all-reduce", 0) >= 1
+        # per-device memory fields come straight from memory_analysis
+        ma = comp.memory_analysis()
+        assert rec.argument_bytes == int(ma.argument_size_in_bytes)
+        assert rec.temp_bytes == int(ma.temp_size_in_bytes)
+
+    def test_per_span_mfu_within_10pct_of_hand_computed(self, tmp_path):
+        """annotate_span arithmetic: MFU/achieved_tflops on the span
+        must match flops*passes/seconds against the shared peaks."""
+        from photon_ml_tpu.obs.xla_cost import (
+            PEAK_FLOPS,
+            PEAK_HBM_BPS,
+            CostBook,
+        )
+
+        book = CostBook()
+        rec = book.record(
+            "drill.analytic",
+            None,
+            bucket="b",
+            analytic_flops=4.0e9,
+            analytic_bytes=2.0e9,
+            registry=MetricsRegistry(),
+        )
+        assert rec.source == "analytic"
+        seconds, passes = 0.25, 23.0
+        with obs.trace(str(tmp_path / "t")) as tracer:
+            with obs.span("drill.solve") as sp:
+                obs.annotate_span(sp, rec, seconds=seconds, passes=passes)
+        ev = [e for e in tracer.events() if e["ph"] == "X"][0]
+        hand_mfu = 4.0e9 * passes / seconds / PEAK_FLOPS
+        hand_tflops = 4.0e9 * passes / seconds / 1e12
+        hand_bps = 2.0e9 * passes / seconds
+        assert abs(ev["args"]["mfu"] - hand_mfu) <= 0.1 * hand_mfu
+        assert (
+            abs(ev["args"]["achieved_tflops"] - hand_tflops)
+            <= 0.1 * hand_tflops
+        )
+        assert abs(ev["args"]["bytes_per_s"] - hand_bps) <= 0.1 * hand_bps
+        assert (
+            abs(ev["args"]["hbm_util"] - hand_bps / PEAK_HBM_BPS)
+            <= 0.1 * hand_bps / PEAK_HBM_BPS
+        )
+
+    def test_glm_solve_span_mfu_matches_counted_passes(self, tmp_path):
+        """Traced train_glm spans carry flops == design_passes x the
+        cost book's per-pass FLOPs, and MFU consistent with the span's
+        own window to within 10% (hand-recomputed from the record)."""
+        from photon_ml_tpu.models import (
+            GLMTrainingConfig,
+            OptimizerType,
+            TaskType,
+            train_glm,
+        )
+        from photon_ml_tpu.obs.xla_cost import PEAK_FLOPS
+        from photon_ml_tpu.ops import RegularizationContext
+        from photon_ml_tpu.core.types import LabeledBatch
+        from photon_ml_tpu.solvers import design_passes
+
+        rng = np.random.default_rng(11)
+        n, d = 4096, 32
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        batch = LabeledBatch.create(x, y, dtype=jnp.float32)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0,),
+            max_iters=5,
+            track_states=False,
+        )
+        book = obs.CostBook()
+        prev = obs.set_cost_book(book)
+        try:
+            with obs.trace(str(tmp_path / "t")) as tracer:
+                (tm,) = train_glm(batch, cfg)
+        finally:
+            obs.set_cost_book(prev)
+        rec = book.lookup("glm.objective_pass", f"{n}x{d}")
+        assert rec is not None and rec.flops is not None
+        spans = [
+            e for e in tracer.events() if e.get("name") == "glm.solve"
+        ]
+        assert len(spans) == 1
+        args = spans[0]["args"]
+        passes = design_passes(tm.result)
+        assert args["flops"] == pytest.approx(rec.flops * passes, rel=1e-6)
+        # MFU == flops / window / peak for the window the span measured
+        window_s = args["flops"] / (args["achieved_tflops"] * 1e12)
+        hand_mfu = args["flops"] / window_s / PEAK_FLOPS
+        assert args["mfu"] == pytest.approx(hand_mfu, rel=0.1)
+
+    def test_game_pass_spans_carry_attribution(self, rng, tmp_path):
+        """Chunked-mode GAME runs annotate game.update and game.pass
+        spans with achieved_tflops/mfu from the cost book."""
+        cd = _build_cd(rng, fuse_passes="coordinate")
+        book = obs.CostBook()
+        prev = obs.set_cost_book(book)
+        try:
+            with obs.trace(str(tmp_path / "t")) as tracer:
+                cd.run(num_iterations=2)
+        finally:
+            obs.set_cost_book(prev)
+        evs = tracer.events()
+        updates = [e for e in evs if e.get("name") == "game.update"]
+        passes = [e for e in evs if e.get("name") == "game.pass"]
+        assert updates and passes
+        for e in updates + passes:
+            assert e["args"]["mfu"] > 0
+            assert e["args"]["achieved_tflops"] > 0
+            assert e["args"]["timing"] == "wall"
+        assert book.lookup("game.update", "fixed") is not None
+        assert book.lookup("game.update", "per-user") is not None
+
+    def test_untraced_run_records_no_cost(self, rng):
+        """Without a tracer the cost book stays empty for GAME runs —
+        the lowering re-trace must never tax an unobserved run."""
+        cd = _build_cd(rng, fuse_passes="coordinate")
+        book = obs.CostBook()
+        prev = obs.set_cost_book(book)
+        try:
+            cd.run(num_iterations=1)
+        finally:
+            obs.set_cost_book(prev)
+        assert book.names() == []
+
+
+# ---------------------------------------------------------------------------
+# HBM telemetry
+# ---------------------------------------------------------------------------
+
+
+def _fake_hbm(monkeypatch, sequence):
+    """Monkeypatch obs.device.read_memory_stats with a scripted device:
+    each call pops the next bytes_in_use (last value repeats)."""
+    from photon_ml_tpu.obs import device as device_mod
+
+    state = {"i": 0}
+
+    def fake(device=None):
+        idx = min(state["i"], len(sequence) - 1)
+        state["i"] += 1
+        b = sequence[idx]
+        return {
+            "bytes_in_use": b,
+            "peak_bytes_in_use": max(sequence[: idx + 1]),
+        }
+
+    monkeypatch.setattr(device_mod, "read_memory_stats", fake)
+    return state
+
+
+class TestHbmTelemetry:
+    def test_unsupported_platform_is_noop(self, tmp_path):
+        """CPU devices report no memory stats: watermark yields
+        supported=False, the sampler starts no thread, sample_hbm
+        returns empty — and nothing lands in registry or trace."""
+        from photon_ml_tpu.obs.device import HbmSampler
+
+        assert obs.read_memory_stats() is None  # this suite runs on CPU
+        reg = MetricsRegistry()
+        prev = obs.set_registry(reg)
+        try:
+            with obs.trace(str(tmp_path / "t")) as tracer:
+                assert obs.sample_hbm() == {}
+                with obs.hbm_watermark("drill") as wm:
+                    pass
+            sampler = HbmSampler(0.01).start()
+            assert sampler._thread is None
+            sampler.stop()
+        finally:
+            obs.set_registry(prev)
+        assert not wm.supported
+        assert wm.peak_bytes is None
+        assert reg.names() == []
+        assert not [
+            e for e in tracer.events() if e["name"].startswith("hbm")
+        ]
+
+    def test_watermark_records_peak_and_delta(self, monkeypatch, tmp_path):
+        _fake_hbm(monkeypatch, [1000, 5000])
+        reg = MetricsRegistry()
+        prev = obs.set_registry(reg)
+        try:
+            with obs.trace(str(tmp_path / "t")) as tracer:
+                with obs.hbm_watermark("drill.phase") as wm:
+                    pass
+        finally:
+            obs.set_registry(prev)
+        assert wm.supported
+        assert wm.before_bytes == 1000
+        assert wm.after_bytes == 5000
+        assert wm.delta_bytes == 4000
+        assert wm.peak_bytes == 5000
+        snap = reg.snapshot()["gauges"]
+        assert snap["hbm.drill.phase.peak_bytes"] == 5000
+        assert snap["hbm.drill.phase.delta_bytes"] == 4000
+        events = [
+            e for e in tracer.events() if e["name"] == "hbm.watermark"
+        ]
+        assert len(events) == 1
+        assert events[0]["args"]["label"] == "drill.phase"
+
+    def test_sample_emits_counter_track(self, monkeypatch, tmp_path):
+        _fake_hbm(monkeypatch, [2048, 4096, 3072])
+        reg = MetricsRegistry()
+        prev = obs.set_registry(reg)
+        try:
+            with obs.trace(str(tmp_path / "t")) as tracer:
+                for _ in range(3):
+                    obs.sample_hbm()
+        finally:
+            obs.set_registry(prev)
+        counters = [e for e in tracer.events() if e["ph"] == "C"]
+        # 8 virtual devices share the faked reader; device 0's track
+        # carries the scripted sequence in order
+        d0 = [e for e in counters if e["name"] == "hbm.d0"]
+        assert [e["args"]["bytes_in_use"] for e in d0[:3]] != []
+        assert reg.snapshot()["gauges"]["hbm.d0.peak_bytes_in_use"] >= 4096
+        # counter events are valid Chrome trace citizens
+        for e in counters:
+            assert set(e) >= {"ph", "name", "pid", "ts", "args"}
+
+    def test_sampler_thread_samples_periodically(self, monkeypatch):
+        from photon_ml_tpu.obs import device as device_mod
+
+        state = _fake_hbm(monkeypatch, [1, 2, 3, 4, 5, 6, 7, 8])
+        reg = MetricsRegistry()
+        sampler = device_mod.HbmSampler(0.01, registry=reg).start()
+        assert sampler._thread is not None
+        import time as _time
+
+        _time.sleep(0.15)
+        sampler.stop()
+        assert sampler._thread is None
+        assert state["i"] > 2  # start probe + periodic + final samples
+        assert "hbm.d0.bytes_in_use" in reg.snapshot()["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# Regression sentinel
+# ---------------------------------------------------------------------------
+
+
+def _bench_record(**overrides):
+    """A synthetic parsed BENCH record with stable metrics."""
+    extra = {
+        "mfu": 0.001,
+        "hbm_util": 0.2,
+        "game_cd_iters_per_s": 10.0,
+        "sparse_zipf_s": 3.5,
+        "rtt_ms": 100.0,
+        "transfer_gb": 0.512,
+    }
+    extra.update(overrides)
+    return {
+        "metric": "drill",
+        "value": 0.13,
+        "unit": "s",
+        "vs_baseline": 20.0,
+        "extra": extra,
+    }
+
+
+class TestSentinel:
+    def _history(self, n=4, jitter=0.02, seed=0):
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            f = 1.0 + float(rng.uniform(-jitter, jitter))
+            out.append(
+                _bench_record(
+                    mfu=0.001 * f,
+                    hbm_util=0.2 * f,
+                    game_cd_iters_per_s=10.0 * f,
+                    sparse_zipf_s=3.5 / f,
+                )
+            )
+        return out
+
+    def test_thirty_pct_regression_flagged(self):
+        from photon_ml_tpu.obs import sentinel as s
+
+        hist = [s.flatten_record(r) for r in self._history()]
+        baselines = s.fit_baselines(hist)
+        degraded = s.flatten_record(
+            _bench_record(
+                mfu=0.0007,  # -30% (higher is better)
+                sparse_zipf_s=4.55,  # +30% (lower is better)
+            )
+        )
+        regs = s.check_record(degraded, baselines)
+        names = {r.metric for r in regs}
+        assert "extra.mfu" in names
+        assert "extra.sparse_zipf_s" in names
+        # the untouched metrics pass
+        assert "extra.game_cd_iters_per_s" not in names
+
+    def test_within_band_noise_passes(self):
+        from photon_ml_tpu.obs import sentinel as s
+
+        hist = [s.flatten_record(r) for r in self._history()]
+        baselines = s.fit_baselines(hist)
+        noisy = s.flatten_record(
+            _bench_record(
+                mfu=0.00092,  # -8%: inside the 25% floor
+                game_cd_iters_per_s=10.9,  # improvement
+                sparse_zipf_s=3.9,  # +11%
+            )
+        )
+        assert s.check_record(noisy, baselines) == []
+
+    def test_new_and_missing_metrics_tolerated(self):
+        from photon_ml_tpu.obs import sentinel as s
+
+        hist = [s.flatten_record(r) for r in self._history()]
+        baselines = s.fit_baselines(hist)
+        current = s.flatten_record(
+            _bench_record(brand_new_iters_per_s=5.0)
+        )
+        del current["extra.hbm_util"]  # metric vanished: tolerated
+        assert s.check_record(current, baselines) == []
+
+    def test_direction_awareness(self):
+        from photon_ml_tpu.obs import sentinel as s
+
+        assert s.metric_direction("extra.mfu") > 0
+        assert s.metric_direction("extra.game_cd_iters_per_s") > 0
+        assert s.metric_direction("vs_baseline") > 0
+        assert s.metric_direction("extra.sparse_zipf_auc_device") > 0
+        assert s.metric_direction("extra.sparse_zipf_s") < 0
+        assert s.metric_direction("value") < 0
+        assert (
+            s.metric_direction(
+                "extra.sparse_fs_scaling.2.collectives.all-reduce"
+            )
+            < 0
+        )
+        # environment noise is untracked
+        assert s.metric_direction("extra.rtt_ms") == 0
+        assert s.metric_direction("extra.rtt_ms_max") == 0
+        assert s.metric_direction("extra.transfer_gb") == 0
+        assert s.metric_direction("extra.phase_s.glm_dense") == 0
+        assert s.metric_direction("extra.metrics.counters.game.passes") == 0
+
+    def test_untracked_metric_regression_ignored(self):
+        from photon_ml_tpu.obs import sentinel as s
+
+        hist = [s.flatten_record(r) for r in self._history()]
+        baselines = s.fit_baselines(hist)
+        current = s.flatten_record(_bench_record(rtt_ms=100000.0))
+        assert s.check_record(current, baselines) == []
+
+    def test_volatile_history_widens_band(self):
+        """A metric that legitimately swung 10x across rounds must not
+        flag a 30% move — the MAD term widens its band."""
+        from photon_ml_tpu.obs import sentinel as s
+
+        hist = [
+            s.flatten_record(_bench_record(game_cd_iters_per_s=v))
+            for v in (1.2, 2.5, 9.8, 10.1)
+        ]
+        baselines = s.fit_baselines(hist)
+        b = baselines["extra.game_cd_iters_per_s"]
+        assert b.tol > 1.0  # band far wider than the 25% floor
+        current = s.flatten_record(_bench_record(game_cd_iters_per_s=4.0))
+        assert "extra.game_cd_iters_per_s" not in {
+            r.metric for r in s.check_record(current, baselines)
+        }
+
+    def test_cli_end_to_end(self, tmp_path):
+        """benchmarks/regression_sentinel.py on synthetic history files:
+        exit 0 on the healthy newest record, nonzero on a degraded one,
+        2 when there is nothing to gate."""
+        import importlib.util
+        import sys as _sys
+
+        spec = importlib.util.spec_from_file_location(
+            "regression_sentinel_drill",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "benchmarks",
+                "regression_sentinel.py",
+            ),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        for i, rec in enumerate(self._history(4)):
+            with open(tmp_path / f"BENCH_r{i:02d}.json", "w") as f:
+                json.dump({"n": i, "rc": 0, "parsed": rec}, f)
+        glob_pat = str(tmp_path / "BENCH_r*.json")
+        assert mod.main(["--history", glob_pat]) == 0
+
+        bad = _bench_record(mfu=0.0006, sparse_zipf_s=5.0)
+        with open(tmp_path / "degraded.json", "w") as f:
+            json.dump(bad, f)  # bare bench.py record form
+        assert (
+            mod.main(
+                ["--history", glob_pat, "--current",
+                 str(tmp_path / "degraded.json")]
+            )
+            == 1
+        )
+        assert (
+            mod.main(["--history", str(tmp_path / "nothing_*.json")]) == 2
+        )
+        _sys.modules.pop("regression_sentinel_drill", None)
