@@ -857,6 +857,16 @@ class CoordinateDescent:
             pass_seconds = time.perf_counter() - pass_t0
             if tracer is not None:
                 pass_args = {"iteration": it, "coordinates": len(names)}
+                # ELL backend the pass's programs traced with — GAME
+                # random-effect batches ride ops.sparse's
+                # PHOTON_SPARSE_KERNEL dispatch with zero call-site
+                # changes, so traces must say which backend they measure
+                try:
+                    from photon_ml_tpu.kernels import kernel_mode
+
+                    pass_args["sparse_kernel"] = kernel_mode()
+                except Exception:
+                    pass
                 # hardware attribution of the WHOLE pass: the sum of
                 # this pass's dispatch cost records (one fused program,
                 # or one per chunked coordinate update) over the pass

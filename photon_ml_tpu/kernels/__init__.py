@@ -1,0 +1,53 @@
+"""Hand-written Pallas kernel suite for the wide-feature sparse path.
+
+``ops/sparse.py`` routes its three ELL contractions here (per
+``PHOTON_SPARSE_KERNEL`` — see :mod:`photon_ml_tpu.kernels.dispatch`),
+and ``GLMObjective`` additionally swaps whole objective passes for the
+fused single-read sweeps in :mod:`photon_ml_tpu.kernels.fused`. Every
+consumer of the sparse containers — ``GLMObjective`` solves, GAME
+random-effect batches, serving, ``HybridFeatures``' cold segments —
+benefits with zero call-site changes. docs/KERNELS.md is the field
+guide.
+"""
+
+from photon_ml_tpu.kernels.dispatch import (
+    ENV_VAR,
+    KERNEL_MODES,
+    design_reads,
+    interpret_mode,
+    kernel_mode,
+    pallas_available,
+    record_kernel_cost,
+    reset_probe_cache,
+    use_pallas,
+)
+from photon_ml_tpu.kernels.ell import (
+    ell_colsum,
+    ell_matvec,
+    ell_rmatvec,
+    ell_scatter_add,
+)
+from photon_ml_tpu.kernels.fused import (
+    fused_hessian_diagonal,
+    fused_hessian_vector,
+    fused_value_grad_curvature,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "KERNEL_MODES",
+    "kernel_mode",
+    "use_pallas",
+    "pallas_available",
+    "interpret_mode",
+    "design_reads",
+    "record_kernel_cost",
+    "reset_probe_cache",
+    "ell_matvec",
+    "ell_rmatvec",
+    "ell_colsum",
+    "ell_scatter_add",
+    "fused_value_grad_curvature",
+    "fused_hessian_vector",
+    "fused_hessian_diagonal",
+]

@@ -1,0 +1,254 @@
+"""Sparse-kernel dispatch: PHOTON_SPARSE_KERNEL={auto,pallas,xla}.
+
+BENCH_r05 pinned the wide-feature GLM loss on XLA's gather/scatter
+lowering of the ELL contractions (``sparse_uniform_vs_sklearn`` 0.39x at
+92% ceiling fit — the pass cost IS the wall clock), so ``ops/sparse.py``
+now routes its ELL kernels through the hand-written Pallas suite in this
+package when that is the better backend. This module is the ONE place
+that decides:
+
+- ``kernel_mode()``: the env knob. ``auto`` (default) selects Pallas on
+  TPU and the existing XLA lowering everywhere else; ``pallas`` forces
+  the Pallas suite (interpret mode off-TPU — how tier-1 proves kernel
+  correctness on CPU); ``xla`` pins today's gather/scatter path.
+- ``use_pallas(...)``: mode x environment x shape eligibility. Pallas is
+  skipped when the coefficient table or accumulator would not fit the
+  VMEM budget (``PHOTON_PALLAS_VMEM_CAP``, default 4 MiB per buffer —
+  row blocks stream, but w and the scatter accumulator are resident),
+  when the batch is degenerate (0 rows/slots), or when a >1-device mesh
+  is active — sharded ELL solves stay on XLA, whose partitioner knows
+  how to split a gather; a Pallas custom call would be replicated.
+- ``pallas_available()``: a cached one-shot probe that builds and runs a
+  tiny kernel on the current backend. ``auto`` consults it, so a Mosaic
+  toolchain that cannot lower the suite (the round-3 lab saw exactly
+  that) degrades to the XLA path instead of failing every solve.
+  ``pallas`` skips the probe — forced means forced, and tests want the
+  real error.
+- ``record_kernel_cost(...)``: every kernel wrapper books its analytic
+  cost profile (FLOPs, bytes, ONE-design-read roofline traffic) into the
+  shared :mod:`photon_ml_tpu.obs.xla_cost` cost book, once per
+  (kernel, shape bucket), so bench MFU/achieved-bytes attribution covers
+  the new executables exactly like the XLA ones.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "ENV_VAR",
+    "VMEM_CAP_ENV",
+    "KERNEL_MODES",
+    "kernel_mode",
+    "pallas_available",
+    "use_pallas",
+    "interpret_mode",
+    "accumulator_fits",
+    "active_mesh_devices",
+    "record_kernel_cost",
+    "design_reads",
+    "reset_probe_cache",
+]
+
+ENV_VAR = "PHOTON_SPARSE_KERNEL"
+VMEM_CAP_ENV = "PHOTON_PALLAS_VMEM_CAP"
+KERNEL_MODES = ("auto", "pallas", "xla")
+
+# Per-buffer VMEM budget for the resident (non-streamed) buffers: the
+# gathered coefficient table and the dense scatter accumulator. 4 MiB
+# holds d = 1M f32 columns and leaves the double-buffered row blocks
+# plenty of a ~16 MiB core (docs/KERNELS.md "Tiling").
+_DEFAULT_VMEM_CAP = 4 << 20
+
+# Design reads per pass, per kernel: the counted-work unit the fused
+# passes exist to shrink. The XLA objective sequence reads the design
+# once per contraction (matvec + rmatvec [+ colsum]); each fused pass
+# reads (indices, values) exactly once.
+_DESIGN_READS = {
+    "ell_matvec": 1,
+    "ell_rmatvec": 1,
+    "ell_colsum": 1,
+    "fused_vgc": 1,
+    "fused_hvp": 1,
+    "fused_hdiag": 1,
+}
+
+_probe_lock = threading.Lock()
+_probe_result: Dict[str, bool] = {}
+
+_record_lock = threading.Lock()
+_recorded = set()
+
+
+def kernel_mode() -> str:
+    """The validated ``PHOTON_SPARSE_KERNEL`` value (default ``auto``)."""
+    mode = os.environ.get(ENV_VAR, "auto").strip().lower() or "auto"
+    if mode not in KERNEL_MODES:
+        raise ValueError(
+            f"{ENV_VAR}={mode!r}: expected one of {KERNEL_MODES}"
+        )
+    return mode
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode everywhere but real TPU hardware — the
+    tier-1 CPU gate proves kernel semantics through the interpreter."""
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_cap() -> int:
+    try:
+        return int(os.environ.get(VMEM_CAP_ENV, _DEFAULT_VMEM_CAP))
+    except ValueError:
+        return _DEFAULT_VMEM_CAP
+
+
+def accumulator_fits(d: int, itemsize: int) -> bool:
+    """Would a (d,)-dense resident buffer (coefficients in, accumulator
+    out) fit the per-buffer VMEM budget? Lane-pads d the way the kernels
+    do before checking."""
+    d_pad = -(-(d + 1) // 128) * 128
+    return d_pad * itemsize <= _vmem_cap()
+
+
+def active_mesh_devices() -> int:
+    """Device count of the active mesh context (1 when none). Sharded
+    solves enter through ``parallel.mesh.set_mesh``; both the 0.4.x
+    ``with mesh:`` form and newer ``jax.set_mesh`` land in thread-local
+    state this reads back, best-effort."""
+    try:
+        from jax._src import mesh as mesh_lib
+
+        env = mesh_lib.thread_resources.env
+        size = getattr(env.physical_mesh, "size", 0)
+        if size and size > 1:
+            return int(size)
+    except Exception:
+        pass
+    try:  # newer jax: abstract mesh context
+        from jax._src import mesh as mesh_lib
+
+        am = mesh_lib.get_abstract_mesh()
+        if am is not None and getattr(am, "size", 0) > 1:
+            return int(am.size)
+    except Exception:
+        pass
+    return 1
+
+
+def _probe() -> bool:
+    """Build + run a tiny representative kernel once per backend; any
+    failure marks Pallas unavailable for ``auto`` until process exit
+    (``reset_probe_cache`` for tests)."""
+    backend = jax.default_backend()
+    with _probe_lock:
+        if backend in _probe_result:
+            return _probe_result[backend]
+    ok = True
+    try:
+        import numpy as np
+
+        from photon_ml_tpu.kernels import ell
+
+        idx = np.array([[0, 2], [1, 3]], np.int32)
+        val = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        w = np.arange(3, dtype=np.float32)
+        out = jax.jit(
+            lambda i, v, ww: ell.ell_matvec(i, v, ww, 3)
+        )(idx, val, w)
+        # row0 = 1*w[0] + 2*w[2] = 4; row1 = 3*w[1] + 4*w[3->pad] = 3
+        np.testing.assert_allclose(
+            np.asarray(out), [4.0, 3.0], rtol=1e-5
+        )
+    except Exception:
+        ok = False
+    with _probe_lock:
+        _probe_result[backend] = ok
+    return ok
+
+
+def pallas_available() -> bool:
+    return _probe()
+
+
+def reset_probe_cache() -> None:
+    """Forget probe results (tests that flip backends/envs)."""
+    with _probe_lock:
+        _probe_result.clear()
+
+
+def use_pallas(
+    d: Optional[int] = None,
+    itemsize: int = 4,
+    n: Optional[int] = None,
+    nnz_per_row: Optional[int] = None,
+) -> bool:
+    """Should the current op take the Pallas path? Trace-time static:
+    mode, backend, probe, mesh context, and shape eligibility."""
+    mode = kernel_mode()
+    if mode == "xla":
+        return False
+    if n is not None and n == 0:
+        return False  # nothing to tile; XLA returns the empty/zero result
+    if nnz_per_row is not None and nnz_per_row == 0:
+        return False
+    if d is not None and not accumulator_fits(d, itemsize):
+        return False
+    if active_mesh_devices() > 1:
+        return False
+    if mode == "pallas":
+        return True
+    return jax.default_backend() == "tpu" and pallas_available()
+
+
+def design_reads(kernel: str) -> int:
+    """Design reads per pass of a kernel in this suite — the counted
+    unit behind the fused passes' >=2-reads-per-iteration saving."""
+    return _DESIGN_READS[kernel]
+
+
+def record_kernel_cost(
+    kernel: str,
+    n: int,
+    k: int,
+    d: int,
+    itemsize: int,
+    flops_per_slot: float = 2.0,
+    extra_bytes: float = 0.0,
+) -> None:
+    """Book one (kernel, shape) cost record into the shared cost book,
+    once per key per process. Called from the kernel wrappers at trace
+    time — host-side and cheap, so it is safe inside jit tracing.
+
+    ``roofline_bytes`` is pinned to ``design_reads(kernel)`` times the
+    stored design bytes (indices + values): the minimal HBM traffic of
+    the pass, which is exactly what the fused kernels reduce and what
+    span-level achieved-bytes/s should be measured against.
+    """
+    key = (kernel, n, k, d, itemsize)
+    with _record_lock:
+        if key in _recorded:
+            return
+        _recorded.add(key)
+    try:
+        from photon_ml_tpu.obs.xla_cost import cost_book
+
+        slots = float(n) * float(k)
+        design_bytes = slots * (4 + itemsize)  # int32 ids + payload
+        reads = design_reads(kernel)
+        cost_book().record(
+            f"kernels.{kernel}",
+            None,
+            bucket=f"{n}x{k}x{d}",
+            analytic_flops=flops_per_slot * slots,
+            analytic_bytes=reads * design_bytes + extra_bytes,
+            roofline_bytes=reads * design_bytes,
+        )
+    except Exception:
+        # observability must never fail the kernel it observes
+        with _record_lock:
+            _recorded.discard(key)

@@ -66,14 +66,23 @@ PEAK_HBM_BPS = 819e9
 # variant collapses onto its base op — async collectives lower as
 # start/done pairs and must not double-count). Formerly inlined at the
 # bench's sparse-scaling measurement; now the one shared definition.
+# Counting matches INSTRUCTIONS (the opcode followed by its operand
+# list), not every textual occurrence: the regex that used to be inlined
+# in bench.py also matched `%all-reduce` OPERAND references in fusion
+# consumers, double-or-more counting each real collective (BENCH_r05's
+# "4 all-reduces" in the F>=2 objective pass were 2 instructions plus
+# their uses). Collective COUNTS therefore drop across the board
+# relative to the r01-r05 history — a counting fix, not a perf change
+# (the sentinel's direction for `collectives.` is lower-is-better, so
+# the fix cannot trip it).
 COLLECTIVE_RE = re.compile(
     r"\b(all-reduce(?:-start)?|all-gather(?:-start)?|"
-    r"all-to-all|reduce-scatter|collective-permute)\b"
+    r"all-to-all|reduce-scatter|collective-permute)\("
 )
 
 
 def count_collectives(hlo_text: str) -> Dict[str, int]:
-    """Collective-op occurrence counts in an (optimized) HLO dump,
+    """Collective-INSTRUCTION counts in an (optimized) HLO dump,
     ``{op_base_name: count}`` with ``-start`` variants folded into the
     base op. Empty dict = no collectives (the single-device case)."""
     counts: Dict[str, int] = {}

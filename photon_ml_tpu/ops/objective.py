@@ -43,7 +43,14 @@ import jax.numpy as jnp
 from photon_ml_tpu.core.normalization import NormalizationContext, no_normalization
 from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.ops.losses import PointwiseLoss
-from photon_ml_tpu.ops.sparse import colsum, matvec, rmatvec
+from photon_ml_tpu.ops.sparse import (
+    colsum,
+    is_feature_sharded,
+    is_sparse,
+    matvec,
+    matvec_and_feature_dots,
+    rmatvec,
+)
 
 
 def _maybe_psum(x, axis_name):
@@ -103,6 +110,13 @@ class GLMObjective:
     l2_weight: float = 0.0
     l1_weight: float = 0.0  # consumed by OWL-QN, NOT added to value/grad here
     axis_name: Optional[str] = None
+    # Feature-sharded designs: ride the scalar feature-space dots (L2
+    # value term, margin shift) on the margins block-sum so one bucketed
+    # all-reduce serves the whole pass (ops.sparse.matvec_and_feature_dots;
+    # BENCH_r05's sparse_fs_scaling 2-device regression). False restores
+    # the one-collective-per-contraction formulation — kept for the
+    # before/after cost-book comparison, not for production use.
+    fuse_feature_reductions: bool = True
 
     @property
     def _has_l2(self) -> bool:
@@ -120,9 +134,20 @@ class GLMObjective:
 
     def _dmargin_dot(self, v: jax.Array, batch: LabeledBatch) -> jax.Array:
         """(d margin / d w) @ v for each row — normalized-feature dot.
-        Dispatches dense (MXU matmul) / sparse ELL (gather kernel)."""
+        Dispatches dense (MXU matmul) / sparse ELL (gather kernel). On
+        feature-sharded designs with whitening shifts, the margin-shift
+        dot rides the margins block-sum (one bucketed all-reduce)."""
         norm = self.normalization
         eff = norm.effective_coefficients(v)
+        if (
+            self.fuse_feature_reductions
+            and norm.shifts is not None
+            and is_feature_sharded(batch.features)
+        ):
+            z0, (ms,) = matvec_and_feature_dots(
+                batch.features, eff, ((norm.shifts, eff),)
+            )
+            return z0 - ms
         return matvec(batch.features, eff) + norm.margin_shift(v)
 
     def _backproject(self, a: jax.Array, batch: LabeledBatch) -> jax.Array:
@@ -159,15 +184,95 @@ class GLMObjective:
         :meth:`hessian_vector_at` needs — TRON's acceptance evaluation
         already computes z at the trial point, so on acceptance the next
         iteration's CG starts with c for free (no separate
-        :meth:`hessian_coefficients` pass)."""
-        z = self.margins(w, batch)
+        :meth:`hessian_coefficients` pass).
+
+        Collectives: the value/grad partials reduce in ONE tuple psum
+        (one collective per pass, not two); on feature-sharded designs
+        the L2 value dot and margin shift additionally ride the margins
+        block-sum (``matvec_and_feature_dots``). On Pallas-eligible ELL
+        designs the whole pass is the single-design-read fused kernel
+        (``kernels.fused_value_grad_curvature``)."""
+        if self._use_fused_kernel(batch.features, w.dtype):
+            return self._value_grad_curvature_fused(w, batch)
+        norm = self.normalization
+        wdot = None
+        if (
+            self.fuse_feature_reductions
+            and is_feature_sharded(batch.features)
+            and (self._has_l2 or norm.shifts is not None)
+        ):
+            eff = norm.effective_coefficients(w)
+            pairs = []
+            if norm.shifts is not None:
+                pairs.append((norm.shifts, eff))
+            if self._has_l2:
+                pairs.append((w, w))
+            z0, dots = matvec_and_feature_dots(batch.features, eff, pairs)
+            if norm.shifts is not None:
+                z0 = z0 - dots[0]
+                dots = dots[1:]
+            z = z0 + batch.offsets
+            if self._has_l2:
+                wdot = dots[0]
+        else:
+            z = self.margins(w, batch)
+            if self._has_l2:
+                wdot = jnp.vdot(w, w)
         ew = batch.effective_weights()
         val = jnp.sum(ew * self.loss.value(z, batch.labels))
         a = ew * self.loss.d1(z, batch.labels)
         grad = self._backproject(a, batch)
         c = ew * self.loss.d2(z, batch.labels)
-        val = _maybe_psum(val, self.axis_name)
-        grad = _maybe_psum(grad, self.axis_name)
+        val, grad = _maybe_psum((val, grad), self.axis_name)
+        if self._has_l2:
+            val = val + 0.5 * self.l2_weight * wdot
+            grad = grad + self.l2_weight * w
+        return val, grad, c
+
+    # -- fused Pallas passes (one design read per pass) ------------------
+
+    def _use_fused_kernel(self, feats, w_dtype) -> bool:
+        """Take the single-read fused Pallas pass? Plain ELL designs
+        only (the hybrid/blocked containers keep their per-segment
+        dispatch through matvec/rmatvec/colsum), under the same
+        mode/backend/VMEM eligibility as the per-op kernels."""
+        from photon_ml_tpu.ops.sparse import _use_pallas_for
+
+        return is_sparse(feats) and _use_pallas_for(feats, w_dtype)
+
+    def _fused_inputs(self, w: jax.Array, batch: LabeledBatch):
+        """(effective coefficients, shift-folded offsets, weights) for
+        the fused kernels: the margin shift is a scalar, so it folds
+        into the per-row offsets outside the kernel."""
+        norm = self.normalization
+        eff = norm.effective_coefficients(w)
+        off = batch.offsets + norm.margin_shift(w)
+        return eff, off, batch.effective_weights()
+
+    def _correct_backprojection(self, g, total_a):
+        """The normalization algebra of :meth:`_backproject` applied to
+        a raw X^T a from a fused kernel, with sum(a) already reduced
+        in-kernel."""
+        norm = self.normalization
+        if norm.factors is not None:
+            g = g * norm.factors
+        if norm.shifts is not None:
+            shift_eff = norm.shifts * (
+                norm.factors if norm.factors is not None else 1.0
+            )
+            g = g - shift_eff * total_a
+        return g
+
+    def _value_grad_curvature_fused(self, w: jax.Array, batch: LabeledBatch):
+        from photon_ml_tpu import kernels
+
+        x = batch.features
+        eff, off, ew = self._fused_inputs(w, batch)
+        val, g, asum, c = kernels.fused_value_grad_curvature(
+            x.indices, x.values, batch.labels, off, ew, eff, x.d, self.loss
+        )
+        grad = self._correct_backprojection(g, asum)
+        val, grad = _maybe_psum((val, grad), self.axis_name)
         if self._has_l2:
             val = val + 0.5 * self.l2_weight * jnp.vdot(w, w)
             grad = grad + self.l2_weight * w
@@ -199,9 +304,24 @@ class GLMObjective:
         self, c: jax.Array, v: jax.Array, batch: LabeledBatch
     ) -> jax.Array:
         """H @ v with the curvature weights ``c`` precomputed by
-        :meth:`hessian_coefficients`."""
-        zv = self._dmargin_dot(v, batch)
-        hv = self._backproject(c * zv, batch)
+        :meth:`hessian_coefficients`. TRON's inner CG loop is almost
+        entirely this call, so on Pallas-eligible ELL designs it takes
+        the fused single-read sweep (``kernels.fused_hessian_vector``:
+        the v-margins gather and the back-projection scatter share one
+        walk of the stored design)."""
+        if self._use_fused_kernel(batch.features, v.dtype):
+            from photon_ml_tpu import kernels
+
+            norm = self.normalization
+            x = batch.features
+            eff_v = norm.effective_coefficients(v)
+            hv0, usum = kernels.fused_hessian_vector(
+                x.indices, x.values, c, eff_v, norm.margin_shift(v), x.d
+            )
+            hv = self._correct_backprojection(hv0, usum)
+        else:
+            zv = self._dmargin_dot(v, batch)
+            hv = self._backproject(c * zv, batch)
         hv = _maybe_psum(hv, self.axis_name)
         if self._has_l2:
             hv = hv + self.l2_weight * v
@@ -212,16 +332,30 @@ class GLMObjective:
         (``TwiceDiffFunction.scala:179-394``, used by
         ``OptimizationProblem.updateCoefficientsVariances``)."""
         norm = self.normalization
-        z = self.margins(w, batch)
-        c = batch.effective_weights() * self.loss.d2(z, batch.labels)  # (n,)
         x = batch.features
-        d_x2 = colsum(x, c, square=True)
-        if norm.shifts is not None:
-            d_x = colsum(x, c)
-            s = norm.shifts
-            diag = d_x2 - 2.0 * s * d_x + s * s * jnp.sum(c)
+        if self._use_fused_kernel(x, w.dtype):
+            from photon_ml_tpu import kernels
+
+            eff, off, ew = self._fused_inputs(w, batch)
+            d_x2, d_x, csum = kernels.fused_hessian_diagonal(
+                x.indices, x.values, batch.labels, off, ew, eff, x.d,
+                self.loss,
+            )
+            if norm.shifts is not None:
+                s = norm.shifts
+                diag = d_x2 - 2.0 * s * d_x + s * s * csum
+            else:
+                diag = d_x2
         else:
-            diag = d_x2
+            z = self.margins(w, batch)
+            c = batch.effective_weights() * self.loss.d2(z, batch.labels)
+            d_x2 = colsum(x, c, square=True)
+            if norm.shifts is not None:
+                d_x = colsum(x, c)
+                s = norm.shifts
+                diag = d_x2 - 2.0 * s * d_x + s * s * jnp.sum(c)
+            else:
+                diag = d_x2
         if norm.factors is not None:
             diag = diag * norm.factors**2
         diag = _maybe_psum(diag, self.axis_name)

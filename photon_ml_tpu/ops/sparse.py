@@ -18,16 +18,29 @@ so ``GLMObjective`` runs unchanged on either representation:
 All are single XLA ops (gather / scatter-add) that shard cleanly over the
 'data' mesh axis: indices/values are row-leading, so batch sharding and the
 psum-reduced partials work exactly as for dense features.
+
+Since PR 5 the three ELL contractions DISPATCH between that XLA lowering
+and the hand-written Pallas suite in ``photon_ml_tpu/kernels/`` (VMEM-
+resident table/accumulator, streamed row blocks — docs/KERNELS.md) per
+``PHOTON_SPARSE_KERNEL={auto,pallas,xla}``: ``auto`` takes Pallas on TPU
+(where XLA's ~90 ms/pass gather/scatter rate was the measured solve
+ceiling, BENCH_r05) and stays bit-for-bit on the XLA path off-TPU;
+``pallas`` forces the suite (interpret mode on CPU — the tier-1 proof);
+``xla`` pins today's lowering. Dispatch happens here so every consumer
+— ``GLMObjective``, GAME random-effect batches, serving scorers, the
+hybrid container's cold segments — switches with zero call-site changes.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from photon_ml_tpu.kernels import dispatch as _kdispatch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,6 +246,18 @@ jax.tree_util.register_pytree_node(
 # -- kernels (dispatch on representation) -----------------------------------
 
 
+def _use_pallas_for(sf: "SparseFeatures", other_dtype) -> bool:
+    """Route this ELL contraction to the Pallas suite? Centralizes the
+    eligibility call so matvec/rmatvec/colsum cannot drift: mode knob,
+    backend/probe, VMEM budget at the contraction's COMPUTE dtype, and
+    degenerate/sharded-batch exclusions (kernels.dispatch)."""
+    n, k = sf.indices.shape[-2], sf.indices.shape[-1]
+    cd = jnp.result_type(sf.values.dtype, other_dtype)
+    return _kdispatch.use_pallas(
+        d=sf.d, itemsize=jnp.dtype(cd).itemsize, n=n, nnz_per_row=k
+    )
+
+
 def is_sparse(x) -> bool:
     return isinstance(x, SparseFeatures)
 
@@ -315,6 +340,10 @@ def matvec(x, w: jax.Array) -> jax.Array:
         return _low_precision_dot(x.dense, w[x.hot_ids]) + cold
     if not is_sparse(x):
         return _low_precision_dot(x, w)
+    if _use_pallas_for(x, w.dtype):
+        from photon_ml_tpu import kernels
+
+        return kernels.ell_matvec(x.indices, x.values, w, x.d)
     gathered = w.at[x.indices].get(mode="fill", fill_value=0.0)
     return jnp.sum(x.values * gathered, axis=-1)
 
@@ -338,6 +367,10 @@ def rmatvec(x, a: jax.Array) -> jax.Array:
         return g.at[x.hot_ids].add(_low_precision_dot(a, x.dense))
     if not is_sparse(x):
         return _low_precision_dot(x.T, a)
+    if _use_pallas_for(x, a.dtype):
+        from photon_ml_tpu import kernels
+
+        return kernels.ell_rmatvec(x.indices, x.values, a, x.d)
     upd = (x.values * a[..., None]).reshape(-1)
     return (
         jnp.zeros((x.d,), upd.dtype)
@@ -361,6 +394,10 @@ def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
     if not is_sparse(x):
         v = x * x if square else x
         return jnp.einsum("n,nd->d", c, v)
+    if _use_pallas_for(x, c.dtype):
+        from photon_ml_tpu import kernels
+
+        return kernels.ell_colsum(x.indices, x.values, c, x.d, square=square)
     v = x.values * x.values if square else x.values
     upd = (v * c[..., None]).reshape(-1)
     return (
@@ -368,6 +405,48 @@ def colsum(x, c: jax.Array, square: bool = False) -> jax.Array:
         .at[x.indices.reshape(-1)]
         .add(upd, mode="drop")
     )
+
+
+def matvec_and_feature_dots(
+    x, w: jax.Array, dot_pairs: Sequence[Tuple[jax.Array, jax.Array]] = ()
+):
+    """``(matvec(x, w), tuple(vdot(u, v) for u, v in dot_pairs))`` with
+    the feature-space dots RIDING THE MARGINS REDUCTION when ``x`` is
+    feature-sharded.
+
+    Under a ('data', 'feature') mesh every feature-space contraction —
+    the (n,) margin block-sum AND each scalar dot over the sharded
+    coefficient space (the L2 value term w.w, the normalization margin
+    shift s.w_eff) — costs one all-reduce, and BENCH_r05's
+    ``sparse_fs_scaling`` showed those per-pass collectives are what
+    broke 2-device scaling (5.32 s @2 vs 3.08 s @1). Here the per-block
+    margin partials (n,) and the per-block scalar partials (1,) each
+    concatenate into ONE (n + P,) payload whose single sharded-axis sum
+    lowers to a single bucketed all-reduce; the XLA partitioner sees one
+    reduction instead of 1 + P.
+
+    For every other representation (nothing sharded to coalesce) the
+    dots are computed directly — ``jnp.vdot`` — and results are
+    bit-identical to the unfused formulation.
+    """
+    if not is_feature_sharded(x) or not dot_pairs:
+        return matvec(x, w), tuple(jnp.vdot(u, v) for u, v in dot_pairs)
+    n = x.indices.shape[-3]
+    w2 = w.reshape(x.num_blocks, x.d_shard)
+    gathered = jax.vmap(  # per-block local gather, as in matvec
+        lambda wf, idxf: wf.at[idxf].get(mode="fill", fill_value=0.0),
+        in_axes=(0, 1),
+        out_axes=1,
+    )(w2, x.indices)
+    zb = jnp.einsum("nfk,nfk->fn", x.values, gathered)  # (F, n) partials
+    cols = [zb]
+    for u, v in dot_pairs:
+        ub = u.reshape(x.num_blocks, x.d_shard)
+        vb = v.reshape(x.num_blocks, x.d_shard)
+        cols.append(jnp.sum(ub * vb, axis=-1, keepdims=True))  # (F, 1)
+    payload = jnp.concatenate(cols, axis=-1)  # (F, n + P), sharded on F
+    total = jnp.sum(payload, axis=0)  # ONE all-reduce of (n + P,)
+    return total[:n], tuple(total[n + i] for i in range(len(dot_pairs)))
 
 
 def pad_rows(x, pad: int):

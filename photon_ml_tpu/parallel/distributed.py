@@ -97,6 +97,20 @@ def feature_sharded_train_glm(
     (``normalization/NormalizationContext.scala:41-151``). Rows pad to
     the 'data' extent; columns added by blocking/padding solve to 0 and
     are dropped from the returned coefficients.
+
+    Collectives (PR 5, the BENCH_r05 ``sparse_fs_scaling`` 2-device
+    regression chase): each objective pass used to pay one all-reduce
+    per feature-space reduction — the (n,) margin block-sum, the L2
+    value dot w.w, the normalization margin shift — so a normalized L2
+    solve paid up to 4 per pass. The objective now coalesces them: all
+    scalar feature-space dots CONCATENATE onto the margin partials and
+    reduce in ONE bucketed all-reduce
+    (``ops.sparse.matvec_and_feature_dots``; on by default via
+    ``GLMObjective.fuse_feature_reductions``), and the value/grad psums
+    of the explicit-collective path fused into one tuple psum. The
+    before/after collective counts are machine-readable in the bench's
+    cost book (``sparse.objective_pass`` vs
+    ``sparse.objective_pass_unfused`` per mesh width F).
     """
     from photon_ml_tpu.ops import sparse as sparse_ops
 

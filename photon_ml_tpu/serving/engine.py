@@ -227,6 +227,16 @@ class ScoringEngine:
         self._compiled: Dict[int, object] = {}
         self._lock = threading.Lock()
         self.compile_count = 0
+        # which ELL backend this engine's executables traced with
+        # (PHOTON_SPARSE_KERNEL dispatch in ops.sparse) — pinned at
+        # construction so score spans attribute kernel provenance even
+        # if the env var changes under a running server
+        try:
+            from photon_ml_tpu.kernels import kernel_mode
+
+            self._sparse_kernel = kernel_mode()
+        except Exception:
+            self._sparse_kernel = "unknown"
 
     # -- construction ------------------------------------------------------
 
@@ -436,7 +446,11 @@ class ScoringEngine:
             bucket, {s: feats_p[s].shape[1] for s in self._used_shards}
         )
         with obs.span(
-            "serving.score", cat="serving", bucket=bucket, rows=n
+            "serving.score",
+            cat="serving",
+            bucket=bucket,
+            rows=n,
+            sparse_kernel=self._sparse_kernel,
         ) as sp:
             t0 = time.perf_counter()
             out = np.asarray(compiled(self._params, feats_p, ents_p))[:n]

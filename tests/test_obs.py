@@ -828,13 +828,17 @@ class TestCostBook:
             rec.flops
         )
 
-    def test_sharded_objective_collectives_match_former_regex(
+    def test_sharded_objective_collectives_vs_former_regex(
         self, devices
     ):
         """The cost book's collective counts on a feature-sharded
-        objective pass must equal what bench.py's former inline regex
-        found in the same HLO — the generalization cannot drift from
-        the accounting the BENCH history was built with."""
+        objective pass, checked against bench.py's former inline regex
+        on the same HLO. Since PR 5 ``count_collectives`` counts
+        INSTRUCTIONS (opcode followed by its operand list) where the
+        former regex also matched ``%all-reduce`` operand REFERENCES in
+        fusion consumers — so the instruction count must never exceed
+        the former count, must find the same op set, and must still see
+        the sharded margin reduction."""
         import dataclasses as _dc
         import re as _re
         from collections import Counter as _Counter
@@ -895,7 +899,11 @@ class TestCostBook:
                 comp.as_text(),
             )
         )
-        assert rec.collectives == dict(former)
+        # instruction counting never exceeds occurrence counting, and
+        # finds exactly the same collective op set
+        assert set(rec.collectives) == set(former)
+        for op, count in rec.collectives.items():
+            assert 1 <= count <= former[op], (op, count, former[op])
         # the sharded margin reduction must actually be there
         assert rec.collectives.get("all-reduce", 0) >= 1
         # per-device memory fields come straight from memory_analysis
