@@ -1266,6 +1266,29 @@ def bench_sparse_feature_scaling(print_json=False):
             bucket=f"F{f_shards}",
         )
         colls_unfused = rec_unfused.collectives
+        # collective profiler (obs.collectives): the in-solve psums have
+        # no per-execution host seam, so wall time is recorded at the
+        # dispatch granularity that CONTAINS them — one blocked
+        # execution of the compiled objective pass per mesh width (best
+        # of 3, first run warms buffer donation). For F=1 the same
+        # measurement is the collective-free baseline; the F>=2 deltas
+        # are the per-pass communication price the ROADMAP item-4
+        # overlap work must hide.
+        pass_walls = []
+        for _ in range(3):
+            tp = time.perf_counter()
+            jax.block_until_ready(comp(w0, pb))
+            pass_walls.append(time.perf_counter() - tp)
+        pass_wall = min(pass_walls)
+        from photon_ml_tpu.obs import collectives as obs_coll
+
+        obs_coll.record_collective(
+            "sparse.objective_pass",
+            mesh_width=f_shards,
+            count=sum(colls.values()) or 1,
+            nbytes=n * 4,  # the (n,) f32 margin-partials payload
+            wall_s=pass_wall,
+        )
         t0 = time.perf_counter()
         (tm,) = feature_sharded_train_glm(batch, cfg, mesh)
         w_sol = np.asarray(tm.model.coefficients.means)
@@ -1284,6 +1307,8 @@ def bench_sparse_feature_scaling(print_json=False):
             "per_device_slots_m": round(per_dev_slots / 1e6, 3),
             "collectives": dict(colls),
             "collectives_unfused": dict(colls_unfused),
+            "collective_count": int(sum(colls.values())),
+            "collective_wall_ms": round(pass_wall * 1e3, 3),
             "max_dw_vs_1dev": round(drift, 8),
         }
         log(
@@ -1292,7 +1317,19 @@ def bench_sparse_feature_scaling(print_json=False):
             f"coef {out[str(f_shards)]['per_device_coef_kb']} KB, "
             f"slots {out[str(f_shards)]['per_device_slots_m']}M, "
             f"collectives {dict(colls)} (unfused: {dict(colls_unfused)}), "
-            f"max|dw|={drift:.1e}"
+            f"pass {pass_wall * 1e3:.1f}ms, max|dw|={drift:.1e}"
+        )
+    # sentinel-gated scaling efficiency (ROADMAP item 4):
+    # wall_1dev / (N * wall_Ndev) — 1.0 is perfect linear scaling; on
+    # this timeshared-CPU stand-in wall stays ~flat so ~1/N is the
+    # honest ceiling. The sentinel holds an absolute floor per width
+    # (obs.sentinel.metric_floor) on top of the history band, so a
+    # future change that re-breaks 2-device scaling fails the gate.
+    wall_1 = out["1"]["wall_s"]
+    for f_str, row in out.items():
+        f = int(f_str)
+        row["scaling_efficiency"] = round(
+            wall_1 / (f * row["wall_s"]), 4
         )
     if print_json:
         print(json.dumps(out))

@@ -5,8 +5,9 @@ when enabled" (docs/OBSERVABILITY.md). This micro-benchmark makes the
 second half enforceable: it runs the SAME smoke GAME coordinate-descent
 workload with observability disabled and with the full envelope enabled
 (span tracer + JSONL event log + metrics registry dumps + XLA cost
-attribution on every coordinate dispatch + the HBM sampler installed),
-compares medians of repeated measurements, and EXITS NONZERO when the
+attribution on every coordinate dispatch + the HBM sampler + the crash
+flight recorder ring riding every span record), compares medians of
+repeated measurements, and EXITS NONZERO when the
 enabled/disabled ratio exceeds the threshold — wire it into CI and a
 chatty span added to the hot loop fails the build instead of silently
 taxing every run.
@@ -139,6 +140,36 @@ def disabled_span_ns(n=200_000):
     return (time.perf_counter_ns() - t0) / n
 
 
+def collective_record_ns(n=50_000):
+    """Cost of one collective-profiler record (count+bytes+wall
+    histogram) into a throwaway registry, nanoseconds — the per-exchange
+    price the allgather/psum seams pay when profiled."""
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    t0 = time.perf_counter_ns()
+    for _ in range(n):
+        obs.record_collective(
+            "bench", mesh_width=8, nbytes=4096, wall_s=1e-4, registry=reg
+        )
+    return (time.perf_counter_ns() - t0) / n
+
+
+def flight_note_ns(n=200_000):
+    """Cost of one flight-recorder ring append, nanoseconds — what every
+    span/event/counter record pays while a recorder is installed (the
+    enabled leg of the gate runs with it on)."""
+    from photon_ml_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=2048)
+    payload = {"kind": "span", "name": "noop", "duration_ms": 0.1}
+    t0 = time.perf_counter_ns()
+    for _ in range(n):
+        rec.note(payload)
+    return (time.perf_counter_ns() - t0) / n
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument(
@@ -172,11 +203,18 @@ def main():
     # short; measure disabled, enabled, disabled and take the best
     # disabled (guards against a one-off slow first block)
     disabled_a = time_run(cd, args.iters, args.repeats, trace=False)
+    # the enabled leg's observe() envelope now also installs the flight
+    # recorder (every span/event rides through its bounded ring), so the
+    # <5% gate covers the PR-6 distributed-observability surfaces too
     enabled = time_run(cd, args.iters, args.repeats, trace=True)
     disabled_b = time_run(cd, args.iters, args.repeats, trace=False)
     disabled = min(disabled_a, disabled_b)
     ratio = enabled / disabled
     span_ns = disabled_span_ns()
+    coll_ns = collective_record_ns()
+    flight_ns = flight_note_ns()
+
+    from photon_ml_tpu.obs.flight import DEFAULT_CAPACITY
 
     record = {
         "metric": "obs_overhead_ratio",
@@ -193,6 +231,9 @@ def main():
             "repeats": args.repeats,
             "shape": shape,
             "disabled_span_ns": round(span_ns, 1),
+            "collective_record_ns": round(coll_ns, 1),
+            "flight_note_ns": round(flight_ns, 1),
+            "flight_records": DEFAULT_CAPACITY,
             "threshold": args.threshold,
         },
     }
@@ -207,7 +248,8 @@ def main():
         return 1
     print(
         f"ok: overhead {ratio:.3f}x (budget {args.threshold:.2f}x); "
-        f"disabled span() costs {span_ns:.0f} ns",
+        f"disabled span() {span_ns:.0f} ns, flight note {flight_ns:.0f} ns, "
+        f"collective record {coll_ns:.0f} ns",
         file=sys.stderr,
     )
     return 0

@@ -100,6 +100,10 @@ class GLMDriverParams:
     # live HBM telemetry sample interval (seconds) while tracing; 0
     # disables. No-op on platforms without device.memory_stats()
     hbm_every: float = 0.5
+    # crash flight recorder (obs.flight): ``flight-<reason>.json`` dumps
+    # land here on preemption / crash. Defaults to trace_dir when
+    # tracing; set explicitly to record flights without a full trace
+    flight_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input:
@@ -274,6 +278,11 @@ class GameDriverParams:
     # live HBM telemetry sample interval (seconds) while tracing; 0
     # disables. No-op on platforms without device.memory_stats()
     hbm_every: float = 0.5
+    # crash flight recorder (obs.flight): ``flight-<reason>.json`` dumps
+    # land here on divergence rollback / preemption / crash. Defaults to
+    # trace_dir when tracing; set explicitly to record flights without a
+    # full trace (a ring-only tracer is installed)
+    flight_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input:

@@ -466,6 +466,7 @@ def run_game_training(params) -> GameTrainingRun:
             profile_dir=params.profile_dir,
             hbm_every_s=params.hbm_every,
             process_name="photon_ml_tpu.game_train",
+            flight_dir=params.flight_dir,
         ):
             return _run_game_training(params, logger, shutdown)
     finally:
@@ -498,6 +499,14 @@ def _run_game_training(
     multi = jax.process_count() > 1
     if multi:
         _validate_multiprocess_params(params)
+        # the runtime usually joined BEFORE the observe() envelope
+        # installed this process's tracer (cli main joins first, by
+        # design), so re-emit the barrier-stamped clock.sync here where
+        # the tracer can record it — the anchor `photon-obs merge`
+        # aligns the per-host shards on
+        from photon_ml_tpu.parallel.multihost import emit_pod_sync
+
+        emit_pod_sync()
 
     # ---- prepare feature maps + dataset ---------------------------------
     with timed(logger, "prepare data"):
@@ -1049,6 +1058,12 @@ def main(argv=None) -> None:
         help="seconds between live HBM counter-track samples while "
         "tracing (0 disables; no-op without device memory stats)",
     )
+    p.add_argument(
+        "--flight-dir", default=None,
+        help="crash flight recorder output directory: flight-<reason>"
+        ".json dumps on divergence/preemption/crash (default: "
+        "--trace-dir)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1072,6 +1087,8 @@ def main(argv=None) -> None:
         base["profile_dir"] = args.profile_dir
     if args.hbm_every is not None:
         base["hbm_every"] = args.hbm_every
+    if args.flight_dir is not None:
+        base["flight_dir"] = args.flight_dir
     run_game_training(base)
 
 

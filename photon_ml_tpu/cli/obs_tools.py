@@ -1,0 +1,167 @@
+"""photon-obs: operator tools for pod-level observability artifacts.
+
+A multi-process run leaves one observability shard per host process —
+``<dir>/trace.json`` + ``events.jsonl`` + ``metrics.json`` — each on its
+own monotonic clock. This CLI folds them into pod-level artifacts:
+
+    # merge per-process shards into one Perfetto-loadable pod trace
+    python -m photon_ml_tpu.cli.obs_tools merge \
+        --out out/pod-trace out/trace-host0 out/trace-host1 ...
+
+``merge`` accepts trace directories or ``trace.json`` paths, aligns the
+per-shard clocks at the barrier-stamped ``clock.sync`` event each shard
+carries (``obs.dist.emit_clock_sync``; fallback: wall-clock epochs),
+rewrites each shard onto its own Perfetto pid track (``host.<i>``), and
+writes:
+
+- ``<out>/trace.json``   — the merged Chrome trace (load in Perfetto),
+- ``<out>/events.jsonl`` — every shard's structured events, host-tagged
+  and time-ordered (when shards carry event logs),
+- ``<out>/metrics.json`` — per-host instruments under ``host.<i>.``
+  prefixes plus ``pod.*`` counter sums (when shards carry snapshots).
+
+Missing / truncated / torn shards are skipped with a warning — merges
+run during post-mortems and must work with whatever survived. Exit 0 on
+success (possibly with warnings), 2 when nothing could be merged.
+
+One BENCH-style JSON summary line goes to stdout; warnings to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Tuple
+
+from photon_ml_tpu.obs import dist as obs_dist
+
+
+def _resolve_shards(args_paths: List[str]) -> List[str]:
+    """Expand CLI operands: a directory stands for its ``trace.json``.
+    Order is preserved (it is the positional process-index fallback)."""
+    out = []
+    for p in args_paths:
+        if os.path.isdir(p):
+            out.append(os.path.join(p, "trace.json"))
+        else:
+            out.append(p)
+    return out
+
+
+def merge_command(args) -> int:
+    paths = _resolve_shards(args.shards)
+    docs: List[Tuple[dict, str]] = []
+    warnings: List[str] = []
+    for path in paths:
+        doc, warn = obs_dist.load_trace_shard(path)
+        if doc is None:
+            warnings.append(warn)
+        else:
+            docs.append((doc, path))
+    if not docs:
+        for w in warnings:
+            print(f"photon-obs: {w}", file=sys.stderr)
+        print("photon-obs: no readable trace shards", file=sys.stderr)
+        return 2
+    merged, info = obs_dist.merge_trace_shards(docs)
+    warnings.extend(info["warnings"])
+
+    os.makedirs(args.out, exist_ok=True)
+    trace_path = os.path.join(args.out, "trace.json")
+    with open(trace_path, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+
+    # events.jsonl: merge whatever shard directories carry one
+    events_written = 0
+    events_paths = []
+    for pos, (doc, label) in enumerate(docs):
+        shard_dir = os.path.dirname(os.path.abspath(label))
+        ev_path = os.path.join(shard_dir, "events.jsonl")
+        if os.path.exists(ev_path):
+            idx = (doc.get("metadata") or {}).get("process_index", pos)
+            events_paths.append((ev_path, int(idx)))
+    if events_paths:
+        records, ev_warns = obs_dist.merge_events_shards(events_paths)
+        warnings.extend(ev_warns)
+        with open(
+            os.path.join(args.out, "events.jsonl"), "w", encoding="utf-8"
+        ) as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        events_written = len(records)
+
+    # metrics.json: host.<i>.-prefixed union + pod.* counter sums
+    metric_snaps = []
+    for pos, (doc, label) in enumerate(docs):
+        shard_dir = os.path.dirname(os.path.abspath(label))
+        m_path = os.path.join(shard_dir, "metrics.json")
+        if not os.path.exists(m_path):
+            continue
+        try:
+            with open(m_path, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            warnings.append(f"{m_path}: skipped ({e})")
+            continue
+        idx = (doc.get("metadata") or {}).get("process_index", pos)
+        metric_snaps.append((snap, int(idx)))
+    if metric_snaps:
+        merged_metrics = obs_dist.merge_metrics_shards(metric_snaps)
+        with open(
+            os.path.join(args.out, "metrics.json"), "w", encoding="utf-8"
+        ) as f:
+            json.dump(merged_metrics, f, indent=2)
+
+    for w in warnings:
+        print(f"photon-obs: warning: {w}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "obs_merge",
+                "value": info["shards"],
+                "unit": "shards",
+                "extra": {
+                    "out": trace_path,
+                    "events": info["events"],
+                    "events_jsonl": events_written,
+                    "metrics_shards": len(metric_snaps),
+                    "duplicates_dropped": info["duplicates_dropped"],
+                    "aligned_by": info["aligned_by"],
+                    "skipped": len(paths) - info["shards"],
+                    "warnings": len(warnings),
+                },
+            }
+        )
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="photon-obs",
+        description="pod-level observability artifact tools",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    mp = sub.add_parser(
+        "merge",
+        help="merge per-process trace shards into one pod trace",
+    )
+    mp.add_argument(
+        "shards",
+        nargs="+",
+        help="per-process trace directories (or trace.json paths)",
+    )
+    mp.add_argument(
+        "--out",
+        required=True,
+        help="output directory for the merged pod artifacts",
+    )
+    mp.set_defaults(func=merge_command)
+    args = p.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,8 @@ Protocol (one JSON object per line):
     {"cmd": "stats"}    -> latency/QPS/bucket snapshot (serving/stats.py)
     {"cmd": "metrics"}  -> {"prometheus": "<text exposition>"} — the full
                            metrics registry (docs/OBSERVABILITY.md)
+    {"cmd": "slo"}      -> rolling-window p99 + error-budget snapshot
+                           (serving.stats.SloTracker; --slo-p99-ms)
     {"cmd": "version"}  -> {"version": "<current model version>"}
     {"cmd": "reload", "path": "<export dir>"} -> {"reloaded": "<version>"}
 
@@ -39,7 +41,7 @@ from typing import Optional
 from photon_ml_tpu.serving.batcher import Backpressure, MicroBatcher
 from photon_ml_tpu.serving.engine import ScoreRequest
 from photon_ml_tpu.serving.registry import ModelRegistry
-from photon_ml_tpu.serving.stats import ServingStats
+from photon_ml_tpu.serving.stats import ServingStats, SloTracker
 
 
 def build_request(obj: dict) -> ScoreRequest:
@@ -135,6 +137,14 @@ def serve_lines(
                         if st.registry is not obs.registry():
                             text += obs.registry().to_prometheus()
                         reply_now({"prometheus": text})
+                    elif cmd == "slo":
+                        slo = getattr(batcher, "slo", None)
+                        if slo is None:
+                            reply_now(
+                                {"error": "no SLO tracker configured"}
+                            )
+                        else:
+                            reply_now(slo.snapshot())
                     elif cmd == "version":
                         reply_now({"version": registry.version()})
                     elif cmd == "reload":
@@ -215,6 +225,19 @@ def main(argv=None) -> None:
         "--dtype", choices=["float32", "float64"], default="float32"
     )
     p.add_argument(
+        "--slo-p99-ms", type=float, default=10.0,
+        help="p99 latency target for the SLO tracker ({'cmd': 'slo'})",
+    )
+    p.add_argument(
+        "--slo-objective", type=float, default=0.99,
+        help="fraction of requests that must meet the target "
+        "(error budget = 1 - objective)",
+    )
+    p.add_argument(
+        "--slo-window-s", type=float, default=60.0,
+        help="rolling SLO window in seconds",
+    )
+    p.add_argument(
         "--no-verify-manifest",
         action="store_true",
         help="serve exports without a sha256 manifest (NOT recommended)",
@@ -239,12 +262,19 @@ def main(argv=None) -> None:
         min_bucket=args.min_bucket,
     )
     registry.load(args.model_dir)
+    slo = SloTracker(
+        target_p99_ms=args.slo_p99_ms,
+        objective=args.slo_objective,
+        window_s=args.slo_window_s,
+        registry=stats.registry,
+    )
     batcher = MicroBatcher(
         registry.score,
         max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms,
         queue_depth=args.queue_depth,
         stats=stats,
+        slo=slo,
     )
     shutdown = GracefulShutdown(logger).install()
     shutdown.register_drain(batcher.begin_drain)

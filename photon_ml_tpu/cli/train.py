@@ -178,6 +178,7 @@ def run_glm_training(params) -> GLMTrainingRun:
         profile_dir=params.profile_dir,
         hbm_every_s=params.hbm_every,
         process_name="photon_ml_tpu.train",
+        flight_dir=params.flight_dir,
     ):
         return _run_glm_training(params)
 
@@ -538,6 +539,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--hbm-every", type=float, default=None,
         help="seconds between live HBM counter-track samples while "
         "tracing (0 disables; no-op without device memory stats)",
+    )
+    p.add_argument(
+        "--flight-dir", default=None,
+        help="crash flight recorder output directory: flight-<reason>"
+        ".json dumps on preemption/crash (default: --trace-dir)",
     )
     return p
 

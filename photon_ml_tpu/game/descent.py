@@ -792,6 +792,11 @@ class CoordinateDescent:
                                     coordinate=name,
                                     iteration=it,
                                 )
+                                # flight recorder: the spans/metrics
+                                # leading INTO the divergence are the
+                                # post-mortem; dump them now, before the
+                                # damped retry perturbs the state
+                                obs.flight_dump("divergence")
                                 key, sub = jax.random.split(key)
                                 params, result, new_scores = _attempt(
                                     model.params[name], partial * 0.5, sub

@@ -30,6 +30,13 @@ import os
 import threading
 from typing import Optional
 
+from photon_ml_tpu.obs import collectives
+from photon_ml_tpu.obs import dist
+from photon_ml_tpu.obs.collectives import (
+    collective_span,
+    note_traced_collective,
+    record_collective,
+)
 from photon_ml_tpu.obs.compile_events import (
     install_compile_listener,
     xla_compile_events,
@@ -50,13 +57,29 @@ from photon_ml_tpu.obs.metrics import (
     registry,
     set_registry,
 )
+from photon_ml_tpu.obs.dist import (
+    emit_clock_sync,
+    host_metric_prefix,
+    merge_trace_shards,
+    process_identity,
+    set_process_identity,
+)
+from photon_ml_tpu.obs.flight import (
+    FlightRecorder,
+    flight_dump,
+    flight_recorder,
+    install_flight_recorder,
+    uninstall_flight_recorder,
+)
 from photon_ml_tpu.obs.trace import (
     Span,
     Tracer,
+    current_span_context,
     emit_event,
     get_tracer,
     set_tracer,
     span,
+    span_context,
     trace,
 )
 from photon_ml_tpu.obs.xla_cost import (
@@ -98,6 +121,27 @@ __all__ = [
     "sample_hbm",
     "MetricsDumper",
     "observe",
+    # distributed observability (obs.dist)
+    "dist",
+    "emit_clock_sync",
+    "host_metric_prefix",
+    "merge_trace_shards",
+    "process_identity",
+    "set_process_identity",
+    # collective profiler (obs.collectives)
+    "collectives",
+    "collective_span",
+    "note_traced_collective",
+    "record_collective",
+    # flight recorder (obs.flight)
+    "FlightRecorder",
+    "flight_dump",
+    "flight_recorder",
+    "install_flight_recorder",
+    "uninstall_flight_recorder",
+    # ambient span context
+    "span_context",
+    "current_span_context",
 ]
 
 
@@ -151,6 +195,8 @@ def observe(
     profile_dir: Optional[str] = None,
     hbm_every_s: float = 0.5,
     process_name: str = "photon_ml_tpu",
+    flight_dir: Optional[str] = None,
+    flight_records: int = 2048,
 ):
     """Driver-level enable-everything context.
 
@@ -159,13 +205,22 @@ def observe(
       listener so recompiles show up in the timeline and registry, and —
       on platforms whose devices report ``memory_stats()`` — a live HBM
       sampler emitting counter tracks every ``hbm_every_s`` seconds
-      (0 disables; unsupported platforms cost one probe).
+      (0 disables; unsupported platforms cost one probe). A
+      ``clock.sync`` event anchors the shard for pod-level merging
+      (``photon-obs merge``; obs.dist).
     - ``metrics_path`` (+ ``metrics_every`` seconds): periodic default-
       registry snapshots; a final snapshot is always written on exit.
       With only ``trace_dir`` set, ``metrics.json`` defaults into it.
     - ``profile_dir``: a ``jax.profiler`` capture window around the block
       (TensorBoard/Perfetto-loadable device profile — the deep tool under
       the span timeline).
+    - ``flight_dir``/``flight_records``: install a crash flight recorder
+      (obs.flight) holding the last ``flight_records`` observations;
+      ``flight-<reason>.json`` dumps land in ``flight_dir`` (default:
+      ``trace_dir``). With ``flight_dir`` set but no ``trace_dir``, a
+      ring-only tracer is installed so spans still feed the recorder
+      without accumulating an unbounded trace. ``flight_records=0``
+      disables.
 
     All-None is a no-op: drivers wrap their body unconditionally and let
     flags decide.
@@ -174,11 +229,35 @@ def observe(
         metrics_path = os.path.join(trace_dir, "metrics.json")
     dumper = None
     hbm = None
+    flight = None
+    installed_tracer = False
     with contextlib.ExitStack() as stack:
         if trace_dir is not None:
             install_compile_listener()
             stack.enter_context(trace(trace_dir, process_name=process_name))
             hbm = HbmSampler(hbm_every_s).start()
+            installed_tracer = True
+        elif flight_dir is not None and flight_records > 0:
+            # ring-only tracer: spans/events route to the flight
+            # recorder, nothing accumulates, nothing is written unless
+            # a dump fires
+            ring_tracer = Tracer(None, process_name=process_name,
+                                 keep_events=False)
+            prev = set_tracer(ring_tracer)
+            stack.callback(set_tracer, prev)
+            installed_tracer = True
+        if (trace_dir is not None or flight_dir is not None) and (
+            flight_records > 0
+        ):
+            flight = install_flight_recorder(
+                capacity=flight_records,
+                flight_dir=flight_dir if flight_dir is not None else trace_dir,
+            )
+            stack.callback(uninstall_flight_recorder)
+        if installed_tracer:
+            # anchor this shard for pod-trace merging (barrier-backed
+            # sync is emitted by parallel.multihost when a pod joins)
+            emit_clock_sync(sync_id="observe-start")
         if profile_dir is not None:
             import jax
 
@@ -191,7 +270,32 @@ def observe(
             dumper = MetricsDumper(metrics_path, metrics_every).start()
         try:
             yield
+        except BaseException as e:
+            # the envelope unwinds BEFORE sys.excepthook runs, so the
+            # crash hook would fire with the recorder already
+            # uninstalled — dump here, while the ring still holds the
+            # spans leading into the crash. GeneratorExit and
+            # SystemExit are deliberate exits, not crashes (signal
+            # paths dump "preemption" from the GracefulShutdown
+            # handler while the recorder is still installed)
+            if flight is not None and not isinstance(
+                e, (GeneratorExit, SystemExit)
+            ):
+                try:
+                    flight.note(
+                        {
+                            "kind": "event",
+                            "name": "crash",
+                            "exception": f"{type(e).__name__}: {e}",
+                        }
+                    )
+                    flight.dump("crash")
+                except Exception:
+                    pass
+            raise
         finally:
+            if flight is not None:
+                flight.sample_metrics()
             if hbm is not None:
                 hbm.stop()
             if dumper is not None:

@@ -1,0 +1,145 @@
+"""Collective profiler: per-reduction timing, bytes, and counts.
+
+BENCH_r05's ``sparse_fs_scaling`` regressed at 2 devices (3.08s -> 5.32s)
+and reached only 1.2x at 8 — and nothing in the tree could say WHERE the
+collective time went: the cost book counts all-reduce instructions per
+executable, but no artifact carried per-reduction wall time, payload
+bytes, or how either scales with mesh width. This module is that
+instrument. Three recording surfaces, all landing in the metrics
+registry under one taxonomy (docs/OBSERVABILITY.md):
+
+- ``collective.<name>.w<W>.count``   — executions (counter)
+- ``collective.<name>.w<W>.bytes``   — cumulative payload bytes (counter)
+- ``collective.<name>.w<W>.wall_ms`` — blocked wall per execution
+  (histogram), present only for host-observable collectives
+
+keyed by reduction name and mesh width ``W``, so the 1-vs-2-vs-8-device
+story is a metric query, not a rerun.
+
+:func:`record_collective` is the primitive; :func:`collective_span`
+brackets a host-level dispatch (a ``process_allgather``, an eager
+shard-mapped psum) with a span + the metrics. In-program collectives —
+psums the XLA partitioner fuses into a jitted solve — have no
+per-execution host seam, so they report through
+:func:`note_traced_collective`: called at TRACE time from the op that
+builds the reduction (``ops.sparse.matvec_and_feature_dots``), it records
+the payload geometry under ``collective.traced.<name>.w<W>.*`` once per
+compilation; callers that know their pass counts (bench.py) multiply.
+Wall time for those lives at the dispatch granularity: ``bench.py``
+times a blocked objective-pass execution per mesh width and records it
+as the ``collective.sparse.objective_pass.w<W>.wall_ms`` proxy.
+
+Everything here is registry writes — cheap, lock-guarded, and always on
+(no tracer required): collective telemetry is exactly what you need from
+the runs you did not think to trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from photon_ml_tpu.obs.metrics import MetricsRegistry
+from photon_ml_tpu.obs.metrics import registry as _registry
+from photon_ml_tpu.obs.trace import span as _span
+
+__all__ = [
+    "collective_metric_key",
+    "record_collective",
+    "collective_span",
+    "note_traced_collective",
+    "tree_bytes",
+]
+
+
+def collective_metric_key(name: str, mesh_width: int) -> str:
+    """``collective.<name>.w<W>`` — the metric-name stem shared by the
+    count/bytes/wall_ms series of one (reduction, mesh width) pair."""
+    return f"collective.{name}.w{int(mesh_width)}"
+
+
+def record_collective(
+    name: str,
+    mesh_width: int = 1,
+    count: float = 1,
+    nbytes: float = 0,
+    wall_s: Optional[float] = None,
+    registry: Optional[MetricsRegistry] = None,
+) -> None:
+    """Record one (or ``count``) executions of a collective: increments
+    the count/bytes counters and, when ``wall_s`` is given, observes the
+    wall histogram. The one write path every profiling surface uses."""
+    reg = registry if registry is not None else _registry()
+    key = collective_metric_key(name, mesh_width)
+    reg.inc(f"{key}.count", count)
+    if nbytes:
+        reg.inc(f"{key}.bytes", float(nbytes))
+    if wall_s is not None:
+        reg.observe(f"{key}.wall_ms", wall_s * 1e3)
+
+
+@contextlib.contextmanager
+def collective_span(
+    name: str,
+    mesh_width: int = 1,
+    nbytes: float = 0,
+    registry: Optional[MetricsRegistry] = None,
+):
+    """Bracket a HOST-OBSERVABLE collective (the call blocks until the
+    exchange completes — ``process_allgather``, an eager shard_map psum)
+    with a ``collective.<name>`` span and the count/bytes/wall metrics.
+    The caller must actually block inside the body; an async dispatch
+    would time the enqueue, not the exchange."""
+    t0 = time.perf_counter()
+    with _span(
+        f"collective.{name}",
+        cat="collective",
+        mesh_width=int(mesh_width),
+        bytes=float(nbytes),
+    ):
+        yield
+    record_collective(
+        name,
+        mesh_width=mesh_width,
+        nbytes=nbytes,
+        wall_s=time.perf_counter() - t0,
+        registry=registry,
+    )
+
+
+def note_traced_collective(
+    name: str,
+    mesh_width: int,
+    nbytes: float,
+    registry: Optional[MetricsRegistry] = None,
+) -> None:
+    """Trace-time note for an IN-PROGRAM collective: the op building a
+    sharded reduction calls this while tracing, recording the payload
+    geometry under ``collective.traced.<name>.w<W>.{count,bytes}`` —
+    once per compilation, zero runtime cost in the compiled program.
+    ``nbytes`` is the per-execution payload (what one all-reduce of the
+    built array moves per device)."""
+    record_collective(
+        f"traced.{name}",
+        mesh_width=mesh_width,
+        nbytes=nbytes,
+        registry=registry,
+    )
+
+
+def tree_bytes(tree) -> int:
+    """Total buffer bytes across a pytree of arrays (payload-size helper
+    for :func:`collective_span` callers). Leaves without ``nbytes``/
+    ``size`` contribute 0."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is None:
+            size = getattr(leaf, "size", None)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+            nb = size * itemsize if size and itemsize else 0
+        total += int(nb)
+    return total

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence
 __all__ = [
     "flatten_record",
     "metric_direction",
+    "metric_floor",
     "Baseline",
     "Regression",
     "fit_baselines",
@@ -77,6 +78,7 @@ _DIRECTION_RULES = (
         ),
         HIGHER_IS_BETTER,
     ),
+    (re.compile(r"scaling_efficiency$"), HIGHER_IS_BETTER),
     (re.compile(r"(iters_per_s|rec_per_s|per_s)$"), HIGHER_IS_BETTER),
     (re.compile(r"(^|\.)mfu$"), HIGHER_IS_BETTER),
     (re.compile(r"hbm_util$"), HIGHER_IS_BETTER),
@@ -97,6 +99,33 @@ def metric_direction(name: str) -> int:
         if pattern.search(name):
             return direction
     return UNTRACKED
+
+
+# Absolute floors: metrics whose minimum acceptable value is known a
+# priori, gated on the CURRENT record alone — no history needed, so the
+# gate binds from the very first record that carries the metric (the
+# MAD band needs >= min_samples history records first). The multi-device
+# scaling efficiency wall_1dev/(N*wall_Ndev) has an honest ceiling of
+# ~1/N on the timeshared-CPU bench host (virtual devices share one
+# core, wall cannot drop); a quarter of that ceiling is the "2-device
+# regression is back" alarm (BENCH_r05's 2-device regression scored
+# 0.29 against a 0.125 floor).
+_FLOOR_RULES = (
+    (
+        re.compile(r"sparse_fs_scaling\.(\d+)\.scaling_efficiency$"),
+        lambda m: 0.25 / int(m.group(1)),
+    ),
+)
+
+
+def metric_floor(name: str) -> Optional[float]:
+    """The absolute floor for ``name``, or None when only the relative
+    history band applies."""
+    for pattern, fn in _FLOOR_RULES:
+        m = pattern.search(name)
+        if m:
+            return fn(m)
+    return None
 
 
 def flatten_record(parsed: dict) -> Dict[str, float]:
@@ -199,8 +228,11 @@ def check_record(
 ) -> List[Regression]:
     """Regressions of ``current`` vs fitted baselines, worst first.
     Metrics absent from either side are tolerated (renames and new
-    instrumentation must not fail the gate)."""
+    instrumentation must not fail the gate). Metrics with an absolute
+    floor (:func:`metric_floor`) are additionally gated against it —
+    history or not."""
     regs: List[Regression] = []
+    flagged = set()
     for name, base in baselines.items():
         cur = current.get(name)
         if cur is None:
@@ -211,6 +243,24 @@ def check_record(
             bad = cur > base.bound()
         if bad:
             regs.append(Regression(metric=name, current=cur, baseline=base))
+            flagged.add(name)
+    for name, cur in current.items():
+        floor = metric_floor(name)
+        if floor is None or name in flagged or cur >= floor:
+            continue
+        regs.append(
+            Regression(
+                metric=name,
+                current=cur,
+                baseline=Baseline(
+                    metric=name,
+                    median=floor,
+                    tol=0.0,
+                    direction=HIGHER_IS_BETTER,
+                    n_samples=0,
+                ),
+            )
+        )
     regs.sort(
         key=lambda r: -(
             abs(r.current - r.baseline.median) / abs(r.baseline.median)

@@ -39,6 +39,7 @@ the blocked time, splitting host dispatch from device completion.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import io
 import json
@@ -52,6 +53,8 @@ __all__ = [
     "Tracer",
     "trace",
     "span",
+    "span_context",
+    "current_span_context",
     "emit_event",
     "get_tracer",
     "set_tracer",
@@ -80,15 +83,36 @@ class Tracer:
         self,
         trace_dir: Optional[str] = None,
         process_name: str = "photon_ml_tpu",
+        keep_events: bool = True,
     ):
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
+        # ring-only mode (keep_events=False): route spans/events to the
+        # flight recorder and JSONL without accumulating the in-memory
+        # trace — the long-lived-process shape (obs.observe's
+        # flight-without-trace envelope) where an unbounded event list
+        # would be a leak
+        self._keep_events = keep_events
         self._epoch_ns = time.perf_counter_ns()
         self._epoch_unix = time.time()
-        self._pid = os.getpid()
+        # flight-recorder hook: a FlightRecorder (obs.flight) notes every
+        # span/instant/counter record into its bounded ring
+        self.recorder = None
+        # pod identity (obs.dist): in a multi-process run the Chrome pid
+        # IS the process index — per-host events land on distinct
+        # Perfetto pid tracks and merge without rewriting
+        from photon_ml_tpu.obs import dist as _dist
+
+        self.process_index, self.process_count = _dist.process_identity()
+        if self.process_count > 1:
+            self._pid = self.process_index
+            process_name = f"{process_name} host.{self.process_index}"
+        else:
+            self._pid = os.getpid()
         self.trace_dir = trace_dir
         self._jsonl: Optional[io.TextIOBase] = None
         self._jsonl_pending = 0
+        self._atexit_registered = False
         if trace_dir is not None:
             os.makedirs(trace_dir, exist_ok=True)
             self._jsonl = open(
@@ -96,7 +120,14 @@ class Tracer:
                 "a",
                 encoding="utf-8",
             )
-        # process metadata event (names the track in Perfetto)
+            # clean-exit guard: a tracer installed WITHOUT the trace()
+            # context manager (drivers that set_tracer directly, or a
+            # process that exits mid-envelope) still flushes its
+            # buffered span records and exports the trace — the
+            # up-to-63-spans flush loss-window otherwise
+            atexit.register(self._atexit_close)
+            self._atexit_registered = True
+        # process metadata events (name + stable ordering in Perfetto)
         self._events.append(
             {
                 "ph": "M",
@@ -107,6 +138,17 @@ class Tracer:
                 "args": {"name": process_name},
             }
         )
+        if self.process_count > 1:
+            self._events.append(
+                {
+                    "ph": "M",
+                    "name": "process_sort_index",
+                    "pid": self._pid,
+                    "tid": 0,
+                    "ts": 0,
+                    "args": {"sort_index": self.process_index},
+                }
+            )
 
     # -- clock --------------------------------------------------------------
 
@@ -123,11 +165,17 @@ class Tracer:
     def _log_jsonl(self, record: Dict[str, Any], flush: bool = False) -> None:
         """Append one JSONL record. Span records are flushed every
         ``_FLUSH_EVERY`` writes (a crash loses at most a handful of
-        timing lines); instant events — faults, retries, preemptions —
-        flush immediately, since they exist to survive the crash that
+        timing lines — the flight recorder's ring covers that window);
+        instant events — faults, retries, preemptions — flush
+        immediately, since they exist to survive the crash that
         follows them."""
+        rec = self.recorder
+        if rec is not None:
+            rec.note(record)
         if self._jsonl is None or self._jsonl.closed:
             return
+        if self.process_count > 1:
+            record = {"host": self.process_index, **record}
         self._jsonl.write(json.dumps(record, sort_keys=True) + "\n")
         self._jsonl_pending += 1
         if flush or self._jsonl_pending >= self._FLUSH_EVERY:
@@ -157,7 +205,8 @@ class Tracer:
             "args": args or {},
         }
         with self._lock:
-            self._events.append(ev)
+            if self._keep_events:
+                self._events.append(ev)
             self._log_jsonl(
                 {
                     "kind": "span",
@@ -187,7 +236,8 @@ class Tracer:
             "args": args or {},
         }
         with self._lock:
-            self._events.append(ev)
+            if self._keep_events:
+                self._events.append(ev)
             self._log_jsonl(
                 {
                     "kind": "event",
@@ -218,7 +268,8 @@ class Tracer:
             "args": dict(values),
         }
         with self._lock:
-            self._events.append(ev)
+            if self._keep_events:
+                self._events.append(ev)
             self._log_jsonl(
                 {
                     "kind": "counter",
@@ -247,15 +298,44 @@ class Tracer:
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "metadata": {"epoch_unix": self._epoch_unix},
+            "metadata": {
+                "epoch_unix": self._epoch_unix,
+                "process_index": self.process_index,
+                "process_count": self.process_count,
+            },
         }
         with open(path, "w", encoding="utf-8") as f:
             json.dump(doc, f)
         return path
 
+    def flush(self) -> None:
+        """Force the buffered JSONL span records to disk. Called from
+        shutdown paths (``GracefulShutdown``) so a graceful exit never
+        loses the up-to-``_FLUSH_EVERY - 1`` buffered records."""
+        with self._lock:
+            if self._jsonl is not None and not self._jsonl.closed:
+                self._jsonl.flush()
+                self._jsonl_pending = 0
+
     def close(self) -> None:
+        if self._atexit_registered:
+            self._atexit_registered = False
+            try:
+                atexit.unregister(self._atexit_close)
+            except Exception:
+                pass
         if self._jsonl is not None and not self._jsonl.closed:
-            self._jsonl.close()
+            self._jsonl.close()  # implicit flush of any buffered records
+
+    def _atexit_close(self) -> None:
+        """Clean-exit fallback for tracers never close()d: export the
+        trace document (the context manager normally does this) and
+        flush/close the JSONL log."""
+        try:
+            self.export()
+        except Exception:
+            pass
+        self.close()
 
 
 # ---------------------------------------------------------------------------
@@ -380,6 +460,37 @@ class Span:
         return out
 
 
+# Ambient span context: request-scoped attributes (trace/request ids)
+# that cross API seams without threading kwargs through them — the
+# serving micro-batcher opens a context around its score_fn call and the
+# engine's `serving.score` span inherits the batch/request identity.
+# Thread-local so concurrent micro-batchers don't cross-tag. Read ONLY
+# when a tracer is active, so disabled-mode span() cost is unchanged.
+_span_ctx = threading.local()
+
+
+def current_span_context() -> Optional[Dict[str, Any]]:
+    """The innermost ambient span-context dict, or None."""
+    stack = getattr(_span_ctx, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def span_context(**fields):
+    """Attach ``fields`` to every span opened in this thread inside the
+    block (explicit span attrs win on key collision). Nestable: inner
+    contexts layer over outer ones."""
+    stack = getattr(_span_ctx, "stack", None)
+    if stack is None:
+        stack = _span_ctx.stack = []
+    merged = {**stack[-1], **fields} if stack else dict(fields)
+    stack.append(merged)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
 def span(name: str, cat: str = "app", **attrs):
     """Open a span on the active tracer (context manager). Disabled mode
     returns a shared no-op singleton — the unconditional-call contract
@@ -387,6 +498,9 @@ def span(name: str, cat: str = "app", **attrs):
     tracer = _active
     if tracer is None:
         return _NULL_SPAN
+    ctx = current_span_context()
+    if ctx:
+        attrs = {**ctx, **attrs}
     return Span(tracer, name, cat, attrs)
 
 

@@ -446,6 +446,19 @@ def matvec_and_feature_dots(
         cols.append(jnp.sum(ub * vb, axis=-1, keepdims=True))  # (F, 1)
     payload = jnp.concatenate(cols, axis=-1)  # (F, n + P), sharded on F
     total = jnp.sum(payload, axis=0)  # ONE all-reduce of (n + P,)
+    # collective profiler (obs.collectives): this function only ever
+    # runs under tracing, so the note fires once per COMPILATION —
+    # recording the bucketed reduction's payload geometry
+    # (collective.traced.matvec_and_feature_dots.w<F>.{count,bytes})
+    # with zero cost in the compiled program; callers that know their
+    # pass counts (bench.py) scale it
+    from photon_ml_tpu.obs import collectives as _obs_coll
+
+    _obs_coll.note_traced_collective(
+        "matvec_and_feature_dots",
+        mesh_width=x.num_blocks,
+        nbytes=(n + len(dot_pairs)) * jnp.dtype(total.dtype).itemsize,
+    )
     return total[:n], tuple(total[n + i] for i in range(len(dot_pairs)))
 
 

@@ -224,13 +224,39 @@ def feature_sharded_train_glm(
     return out
 
 
+def _eager_and_traced() -> bool:
+    """True when we are on the HOST side of a dispatch (not inside a jit
+    trace) AND a tracer is active — the only situation where wrapping a
+    collective dispatch in a blocking profile window is both meaningful
+    and paid for by someone who asked for it."""
+    from photon_ml_tpu import obs
+
+    if obs.get_tracer() is None:
+        return False
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:
+        return False
+
+
 def shard_map_value_and_grad(
     objective: GLMObjective, mesh: Mesh
 ):
     """Explicit-collective value+grad: shard_map over 'data' with in-kernel
     psum (``objective.axis_name``). Returns f(w, sharded_batch) -> (val, grad)
-    with replicated outputs."""
+    with replicated outputs.
+
+    Collective profiling (``obs.collectives``): an EAGER call under an
+    active tracer blocks on the result and records one
+    ``collective.psum.value_and_grad.w<N>`` span +
+    ``collective.psum.value_and_grad.w<N>.{count,bytes,wall_ms}``
+    metrics, N = the 'data' mesh width and bytes = the psum payload
+    (value scalar + gradient). Calls from inside a jit trace — and every
+    untraced call — take the raw path unchanged: profiling must never
+    alter the async dispatch semantics of a run nobody is observing.
+    """
     obj = objective.with_axis(DATA_AXIS)
+    width = mesh.shape[DATA_AXIS]
 
     @partial(
         shard_map,
@@ -238,7 +264,21 @@ def shard_map_value_and_grad(
         in_specs=(P(), P(DATA_AXIS)),
         out_specs=(P(), P()),
     )
-    def vg(w, batch: LabeledBatch):
+    def vg_raw(w, batch: LabeledBatch):
         return obj.value_and_grad(w, batch)
+
+    def vg(w, batch: LabeledBatch):
+        if not _eager_and_traced():
+            return vg_raw(w, batch)
+        from photon_ml_tpu.obs import collectives as obs_coll
+
+        nbytes = (int(np.size(w)) + 1) * np.dtype(
+            getattr(w, "dtype", np.float64)
+        ).itemsize
+        with obs_coll.collective_span(
+            "psum.value_and_grad", mesh_width=width, nbytes=nbytes
+        ):
+            out = jax.block_until_ready(vg_raw(w, batch))
+        return out
 
     return vg

@@ -77,7 +77,32 @@ def initialize_multihost(
         process_id=process_id,
     )
     _INITIALIZED = True
+    # pod observability identity (obs.dist): every tracer/metrics
+    # artifact from here on is stamped host.<i>, and — when a tracer is
+    # already installed — a barrier-backed clock.sync event anchors this
+    # process's trace shard so `photon-obs merge` can lay all hosts on
+    # one timeline regardless of per-host clock skew
+    emit_pod_sync()
     return True
+
+
+def emit_pod_sync() -> None:
+    """Stamp this process's obs identity from the live jax runtime and
+    emit a barrier-backed ``clock.sync`` trace event (no-op untraced;
+    the identity stamp always happens). Called by
+    :func:`initialize_multihost`; callable again by drivers that install
+    their tracer after joining."""
+    from photon_ml_tpu.obs import dist as obs_dist
+
+    obs_dist.set_process_identity(jax.process_index(), jax.process_count())
+    barrier = None
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        def barrier():
+            multihost_utils.sync_global_devices("photon-obs-clock-sync")
+
+    obs_dist.emit_clock_sync(sync_id="startup", barrier=barrier)
 
 
 def split_rows(total_rows: int, num_processes: int, process_id: int) -> range:
@@ -152,16 +177,30 @@ def allgather_host(x):
     """Small HOST array -> the concatenation of every process's value
     (process order, axis 0), returned as a host numpy array on every
     process. The bookkeeping primitive for globalizing per-process
-    metadata (entity counts, lane->table index vectors)."""
+    metadata (entity counts, lane->table index vectors).
+
+    Host-blocking by construction, so the collective profiler
+    (``obs.collectives``) gets a TRUE per-exchange wall: every call
+    records ``collective.allgather_host.w<nproc>.{count,bytes,wall_ms}``
+    and, when traced, a ``collective.allgather_host`` span."""
     import numpy as np
 
     if jax.process_count() == 1:
         return np.asarray(x)
     from jax.experimental import multihost_utils
 
-    return np.asarray(
-        multihost_utils.process_allgather(np.asarray(x), tiled=True)
-    )
+    from photon_ml_tpu.obs import collectives as obs_coll
+
+    x = np.asarray(x)
+    with obs_coll.collective_span(
+        "allgather_host",
+        mesh_width=jax.process_count(),
+        nbytes=int(x.nbytes),
+    ):
+        out = np.asarray(
+            multihost_utils.process_allgather(x, tiled=True)
+        )
+    return out
 
 
 def allgather_strings(strs):

@@ -64,6 +64,21 @@ class GracefulShutdown:
                 cat="resilience",
                 signum=signum,
             )
+            # the tracer's flush loss-window guard + flight recorder:
+            # a SIGTERM'd process must leave its last buffered span
+            # records on disk and (when a recorder is installed) a
+            # flight-preemption.json post-mortem. Both are bounded,
+            # non-reentrant file writes — acceptable in the Python-level
+            # handler context, and best-effort either way.
+            try:
+                tracer = obs.get_tracer()
+                if tracer is not None:
+                    tracer.flush()
+                obs.flight_dump(
+                    "preemption" if signum is not None else "shutdown"
+                )
+            except Exception:
+                pass
             self.drain()
 
     # -- drain hooks -------------------------------------------------------

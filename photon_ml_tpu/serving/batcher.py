@@ -23,6 +23,7 @@ SIGTERM drops zero accepted requests.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -31,7 +32,8 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from photon_ml_tpu.serving.stats import ServingStats
+from photon_ml_tpu import obs
+from photon_ml_tpu.serving.stats import ServingStats, SloTracker
 
 
 class Backpressure(RuntimeError):
@@ -39,12 +41,13 @@ class Backpressure(RuntimeError):
 
 
 class _Item:
-    __slots__ = ("request", "future", "enqueued")
+    __slots__ = ("request", "future", "enqueued", "rid")
 
-    def __init__(self, request):
+    def __init__(self, request, rid: int = 0):
         self.request = request
         self.future: Future = Future()
         self.enqueued = time.perf_counter()
+        self.rid = rid
 
 
 class MicroBatcher:
@@ -63,6 +66,7 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         queue_depth: int = 1024,
         stats: Optional[ServingStats] = None,
+        slo: Optional[SloTracker] = None,
         auto_start: bool = True,
     ):
         if max_batch <= 0:
@@ -72,6 +76,11 @@ class MicroBatcher:
         self.max_wait_s = max_wait_ms / 1e3
         self._q: "queue.Queue[_Item]" = queue.Queue(maxsize=queue_depth)
         self.stats = stats if stats is not None else ServingStats()
+        self.slo = slo
+        # request ids: monotone per batcher, stamped at submit and
+        # propagated through _flush into the engine's score span
+        # (obs.span_context) — the request-scoped trace key
+        self._rids = itertools.count(1)
         self._draining = threading.Event()
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -113,17 +122,21 @@ class MicroBatcher:
 
     def submit(self, request) -> Future:
         """Enqueue one request; the Future resolves to its float score.
-        Raises :class:`Backpressure` when draining or the queue is full."""
+        Raises :class:`Backpressure` when draining or the queue is full.
+        Each accepted request gets a monotone request id (``rid``) that
+        its trace spans carry end to end."""
         if self._draining.is_set():
             raise Backpressure("batcher is draining; not accepting requests")
-        item = _Item(request)
+        item = _Item(request, rid=next(self._rids))
         try:
             self._q.put_nowait(item)
         except queue.Full:
             self.stats.record_rejected()
+            self.stats.record_queue_depth(self._q.qsize())
             raise Backpressure(
                 f"request queue full ({self._q.maxsize} deep)"
             ) from None
+        self.stats.record_queue_depth(self._q.qsize())
         return item.future
 
     def score_sync(self, request, timeout: Optional[float] = None) -> float:
@@ -141,8 +154,9 @@ class MicroBatcher:
                     if self._draining.is_set():
                         return
                     continue
+                t_first = time.perf_counter()
                 batch = [first]
-                deadline = time.perf_counter() + self.max_wait_s
+                deadline = t_first + self.max_wait_s
                 while len(batch) < self.max_batch:
                     wait = deadline - time.perf_counter()
                     # draining: no reason to hold the window open — take
@@ -156,23 +170,65 @@ class MicroBatcher:
                             batch.append(self._q.get_nowait())
                     except queue.Empty:
                         break
-                self._flush(batch)
+                self._flush(batch, t_first)
         finally:
             self._stopped.set()
 
-    def _flush(self, batch) -> None:
+    def _flush(self, batch, t_first: Optional[float] = None) -> None:
+        self.stats.record_queue_depth(self._q.qsize())
         t0 = time.perf_counter()
+        if t_first is None:
+            t_first = t0
+        bid = batch[0].rid
         try:
-            scores = np.asarray(self._score_fn([it.request for it in batch]))
+            # ambient span context: the engine's `serving.score` span
+            # (and anything below it) inherits the batch identity, so a
+            # request id found in a trace leads straight to its device
+            # call
+            with obs.span_context(batch_id=bid, batch_size=len(batch)):
+                scores = np.asarray(
+                    self._score_fn([it.request for it in batch])
+                )
         except BaseException as e:  # noqa: BLE001 — futures carry the error
             self.stats.record_error()
+            t_err = time.perf_counter()
             for it in batch:
+                if self.slo is not None:
+                    self.slo.record(t_err - it.enqueued, ok=False)
                 if not it.future.done():
                     it.future.set_exception(e)
             return
         t1 = time.perf_counter()
         self.stats.record_batch(len(batch), t1 - t0)
+        tracer = obs.get_tracer()
+        device_ms = (t1 - t0) * 1e3
+        assembly_ms = max(t0 - t_first, 0.0) * 1e3
         for it, s in zip(batch, scores):
-            self.stats.record_request_latency(t1 - it.enqueued)
+            latency = t1 - it.enqueued
+            self.stats.record_request_latency(latency)
+            if self.slo is not None:
+                self.slo.record(latency)
+            if tracer is not None:
+                # request-scoped trace: one retro-emitted span per
+                # request covering enqueue -> result, decomposed into
+                # queue-wait (sitting in the bounded queue), batch
+                # assembly (the coalescing window), and the device call
+                end_us = tracer.now_us()
+                dur_us = latency * 1e6
+                tracer.add_span(
+                    "serving.request",
+                    end_us - dur_us,
+                    dur_us,
+                    cat="serving",
+                    args={
+                        "request_id": it.rid,
+                        "batch_id": bid,
+                        "queue_wait_ms": round(
+                            max(t_first - it.enqueued, 0.0) * 1e3, 4
+                        ),
+                        "assembly_ms": round(assembly_ms, 4),
+                        "device_ms": round(device_ms, 4),
+                    },
+                )
             if not it.future.done():
                 it.future.set_result(float(s))

@@ -454,6 +454,10 @@ class ScoringEngine:
         ) as sp:
             t0 = time.perf_counter()
             out = np.asarray(compiled(self._params, feats_p, ents_p))[:n]
+            elapsed = time.perf_counter() - t0
+            # per-bucket device latency: the aggregate device_ms
+            # histogram cannot say WHICH padded size is slow
+            self.stats.record_bucket_latency(bucket, elapsed)
             if obs.get_tracer() is not None:
                 # the np.asarray above already synchronized, so the
                 # window is true dispatch-to-done device time; annotate
@@ -461,7 +465,7 @@ class ScoringEngine:
                 obs.annotate_span(
                     sp,
                     obs.cost_book().lookup("serving.score", str(bucket)),
-                    seconds=time.perf_counter() - t0,
+                    seconds=elapsed,
                 )
         if offsets is not None:
             out = out + np.asarray(offsets, out.dtype)

@@ -35,6 +35,7 @@ from photon_ml_tpu.obs.metrics import (  # noqa: F401
 __all__ = [
     "LatencyHistogram",
     "ServingStats",
+    "SloTracker",
     "install_compile_listener",
     "xla_compile_events",
 ]
@@ -116,6 +117,26 @@ class ServingStats:
             self._inc(f"bucket.{bucket}")
             self._inc("bucket_hits" if hit else "bucket_misses")
 
+    def record_bucket_latency(self, bucket: int, device_s: float) -> None:
+        """Per-bucket device latency histogram (``serving.bucket_ms.<b>``):
+        the aggregate ``device_ms`` histogram hides which padded size is
+        slow — a p99 problem confined to the 1024 bucket looks like a
+        uniform tail without this split."""
+        with self._lock:
+            self.registry.observe(
+                f"serving.bucket_ms.{int(bucket)}", device_s * 1e3
+            )
+
+    def record_queue_depth(self, depth: int) -> None:
+        """Instantaneous request-queue depth gauge + peak gauge. Today a
+        saturating queue is invisible until ``Backpressure`` rejects;
+        the gauge makes the approach visible (alert at 80%, not 100%)."""
+        with self._lock:
+            self.registry.set_gauge("serving.queue_depth", depth)
+            peak = self.registry.gauge("serving.queue_depth_peak")
+            if depth > peak.value:
+                peak.set(depth)
+
     def record_compile(self) -> None:
         with self._lock:
             self._inc("compile_count")
@@ -169,7 +190,27 @@ class ServingStats:
                 "compile_count": int(self.compile_count),
                 "request_latency": self.request_ms.snapshot(),
                 "device_latency": self.device_ms.snapshot(),
+                "queue_depth": int(
+                    self.registry.gauge("serving.queue_depth").value
+                ),
+                "queue_depth_peak": int(
+                    self.registry.gauge("serving.queue_depth_peak").value
+                ),
+                "bucket_latency": self._bucket_latency_snapshot(),
             }
+
+    def _bucket_latency_snapshot(self) -> Dict[str, dict]:
+        """``{bucket: histogram snapshot}`` for every bucket that has
+        recorded device latency. Caller holds ``self._lock``; registry
+        access takes its own lock (no ordering cycle: registry methods
+        never call back into ServingStats)."""
+        prefix = "serving.bucket_ms."
+        out: Dict[str, dict] = {}
+        for name in self.registry.names(prefix):
+            out[name[len(prefix):]] = self.registry.histogram(
+                name
+            ).snapshot()
+        return out
 
     def to_json(self, **kw) -> str:
         return json.dumps(self.snapshot(), **kw)
@@ -177,3 +218,118 @@ class ServingStats:
     def dump(self, path: str) -> None:
         with open(path, "w") as f:
             f.write(self.to_json(indent=2))
+
+
+class SloTracker:
+    """Rolling-window SLO tracking: p99 vs target + error budget.
+
+    Lifetime histograms answer "how has the server done since boot";
+    an SLO answers "are we meeting the promise RIGHT NOW and how much
+    failure allowance is left". The tracker keeps a bounded window of
+    recent requests (at most ``window_s`` seconds and ``max_samples``
+    entries — at very high qps the window degrades to the newest
+    ``max_samples``, still a current view) and derives:
+
+    - ``p99_ms``: exact 99th percentile over the window,
+    - ``violation_rate``: fraction of windowed requests that broke the
+      promise (latency > ``target_p99_ms``, or errored),
+    - ``error_budget_remaining``: 1 - violation_rate / (1 - objective),
+      clamped to [0, 1] — at ``objective=0.99`` a 0.5% violation rate
+      has burned half the budget; 0.0 means the SLO is being missed.
+
+    Gauges (``serving.slo.p99_ms``, ``serving.slo.violation_rate``,
+    ``serving.slo.error_budget_remaining``) refresh on every snapshot
+    and every 256th record, so a Prometheus scrape sees a current view
+    without paying the percentile sort per request. Fed by
+    ``MicroBatcher`` per request; surfaced by ``cli/serve.py``'s
+    ``{"cmd": "slo"}``.
+    """
+
+    _GAUGE_EVERY = 256
+
+    def __init__(
+        self,
+        target_p99_ms: float = 10.0,
+        objective: float = 0.99,
+        window_s: float = 60.0,
+        max_samples: int = 65536,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        if not (0.0 < objective < 1.0):
+            raise ValueError(
+                f"objective must be in (0, 1), got {objective}"
+            )
+        self.target_p99_ms = float(target_p99_ms)
+        self.objective = float(objective)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        # (monotonic_ts, latency_ms, violated)
+        self._window = collections.deque(maxlen=max_samples)
+        self._since_gauge = 0
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.total = 0
+        self.total_violations = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, seconds: float, ok: bool = True) -> None:
+        ms = seconds * 1e3
+        violated = (not ok) or ms > self.target_p99_ms
+        now = time.monotonic()
+        with self._lock:
+            self._window.append((now, ms, violated))
+            self.total += 1
+            if violated:
+                self.total_violations += 1
+            self._since_gauge += 1
+            refresh = self._since_gauge >= self._GAUGE_EVERY
+            if refresh:
+                self._since_gauge = 0
+        if refresh:
+            self.snapshot()
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._window and self._window[0][0] < horizon:
+            self._window.popleft()
+
+    # -- readout -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            lats = sorted(item[1] for item in self._window)
+            violations = sum(1 for item in self._window if item[2])
+            total = self.total
+            total_violations = self.total_violations
+        n = len(lats)
+        p99 = lats[min(n - 1, int(0.99 * n))] if n else 0.0
+        p50 = lats[n // 2] if n else 0.0
+        rate = violations / n if n else 0.0
+        allowed = 1.0 - self.objective
+        budget = 1.0 - rate / allowed if allowed > 0 else 0.0
+        budget = max(0.0, min(1.0, budget))
+        out = {
+            "target_p99_ms": self.target_p99_ms,
+            "objective": self.objective,
+            "window_s": self.window_s,
+            "window_requests": n,
+            "p50_ms": round(p50, 4),
+            "p99_ms": round(p99, 4),
+            "violations": violations,
+            "violation_rate": round(rate, 6),
+            "error_budget_remaining": round(budget, 6),
+            "slo_met": p99 <= self.target_p99_ms,
+            "total_requests": total,
+            "total_violations": total_violations,
+        }
+        self.registry.set_gauge("serving.slo.p99_ms", out["p99_ms"])
+        self.registry.set_gauge(
+            "serving.slo.violation_rate", out["violation_rate"]
+        )
+        self.registry.set_gauge(
+            "serving.slo.error_budget_remaining",
+            out["error_budget_remaining"],
+        )
+        return out

@@ -371,11 +371,15 @@ class TestPhotonLogger:
 
 
 class TestServingStatsCompat:
+    # the pre-obs schema plus the PR-6 queue/bucket observability keys
+    # (queue_depth gauge, peak, per-bucket device-latency histograms) —
+    # additions only; every pre-existing key keeps its shape
     GOLDEN_KEYS = {
         "uptime_s", "requests", "batches", "rejected", "errors",
         "reloads", "qps", "batch_occupancy_mean", "buckets",
         "bucket_hits", "bucket_misses", "compile_count",
         "request_latency", "device_latency",
+        "queue_depth", "queue_depth_peak", "bucket_latency",
     }
 
     def test_snapshot_schema_unchanged(self):
