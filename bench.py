@@ -516,18 +516,40 @@ def bench_game(print_json=False):
     t0 = time.perf_counter()
     _warm_disjoint(cd)
     log(f"GAME warmup (compile+run): {time.perf_counter() - t0:.2f}s")
-    t0 = time.perf_counter()
-    model, history = cd.run(num_iterations=GAME_ITERS)
-    dt = time.perf_counter() - t0
+    # convergence-health decode (obs.convergence): the per-entity
+    # (reason, iterations, final |grad|) trackers ride the run's one
+    # batched stats drain regardless; the tracker makes materialize()
+    # fold them into fleet summaries, from which the sentinel-tracked
+    # convergence.{median_iters,nonconverged_frac} derive. Host numpy
+    # over already-fetched arrays — no extra device syncs in the timed
+    # window.
+    from photon_ml_tpu import obs
+
+    tracker = obs.install_convergence_tracker()
+    try:
+        t0 = time.perf_counter()
+        model, history = cd.run(num_iterations=GAME_ITERS)
+        dt = time.perf_counter() - t0
+        conv = tracker.report()
+    finally:
+        obs.uninstall_convergence_tracker()
     iters_per_s = GAME_ITERS / dt
     obj = float(history[-1].objective)
     auc = heldout_auc(model)
     log(
         f"GAME CD: {GAME_ITERS} iterations in {dt:.2f}s "
         f"({iters_per_s:.3f} iters/s) objective={obj:.5f} "
-        f"held-out auc={auc:.4f}"
+        f"held-out auc={auc:.4f} "
+        f"median_iters={conv['median_iters']:g} "
+        f"nonconverged_frac={conv['nonconverged_frac']:.4f}"
     )
-    out = {"iters_per_s": iters_per_s, "objective": obj, "auc": auc}
+    out = {
+        "iters_per_s": iters_per_s,
+        "objective": obj,
+        "auc": auc,
+        "convergence_median_iters": conv["median_iters"],
+        "convergence_nonconverged_frac": conv["nonconverged_frac"],
+    }
     if print_json:
         print(json.dumps(out))
     return out
@@ -1615,6 +1637,14 @@ def main():
         ),
         "game_cd_iters_per_s": round(game["iters_per_s"], 3),
         "game_heldout_auc": round(game["auc"], 4),
+        # convergence health of the flagship GAME run (sentinel-tracked,
+        # lower-is-better: obs.sentinel's convergence.* direction rules)
+        "convergence": {
+            "median_iters": round(game["convergence_median_iters"], 3),
+            "nonconverged_frac": round(
+                game["convergence_nonconverged_frac"], 5
+            ),
+        },
         "game_multi_re_mf_iters_per_s": round(
             game_multi["iters_per_s"], 3
         ),

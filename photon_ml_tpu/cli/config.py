@@ -104,6 +104,12 @@ class GLMDriverParams:
     # land here on preemption / crash. Defaults to trace_dir when
     # tracing; set explicitly to record flights without a full trace
     flight_dir: Optional[str] = None
+    # convergence-health layer (obs.convergence): decode every solve's
+    # device-side tapes into convergence.* metrics + events and write
+    # <output_dir>/convergence-report.json — works with or without
+    # --trace-dir (the decode syncs; pipelined solves pay nothing when
+    # off)
+    convergence_report: bool = False
 
     def validate(self) -> None:
         if not self.train_input:
@@ -222,6 +228,11 @@ class CoordinateSpec:
     # columns into the MXU slab (-1 = auto), ops.sparse.to_hybrid applied
     # coordinate-locally (the row permutation never leaves the coordinate)
     hot_columns: int = 0
+    # record per-iteration solver tapes (values/grad norms/radius/step)
+    # inside this coordinate's solves — the obs/convergence.py decode
+    # surface. Costs (entities, max_iters+1) carry state on vmapped
+    # random effects, so off by default; fleet summaries work without it
+    track_states: bool = False
 
 
 @dataclasses.dataclass
@@ -283,6 +294,12 @@ class GameDriverParams:
     # trace_dir when tracing; set explicitly to record flights without a
     # full trace (a ring-only tracer is installed)
     flight_dir: Optional[str] = None
+    # convergence-health layer (obs.convergence): per-coordinate fleet
+    # summaries (iterations histogram, non-converged entities, worst-k
+    # by final grad norm) recorded every pass + a run-level
+    # <output_dir>/convergence-report.json — works with or without
+    # --trace-dir
+    convergence_report: bool = False
 
     def validate(self) -> None:
         if not self.train_input:

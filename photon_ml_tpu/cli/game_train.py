@@ -82,6 +82,7 @@ def _coordinate_config(
         down_sampling_rate=spec.down_sampling_rate,
         random_effect=spec.random_effect,
         active_cap=spec.active_cap,
+        track_states=spec.track_states,
     )
 
 
@@ -456,8 +457,17 @@ def run_game_training(params) -> GameTrainingRun:
     # metrics.json lands in trace_dir when tracing, else next to
     # log-message.txt when periodic snapshots were asked for
     metrics_path = None
-    if params.trace_dir is None and params.metrics_every > 0:
+    if params.trace_dir is None and (
+        params.metrics_every > 0 or params.convergence_report
+    ):
         metrics_path = os.path.join(params.output_dir, "metrics.json")
+    conv_tracker = None
+    if params.convergence_report:
+        # decode every coordinate update's per-entity convergence even
+        # without a tracer; the aggregated run report lands next to the
+        # models (fleet events additionally hit events.jsonl when
+        # tracing)
+        conv_tracker = obs.install_convergence_tracker()
     try:
         with obs.observe(
             trace_dir=params.trace_dir,
@@ -470,6 +480,17 @@ def run_game_training(params) -> GameTrainingRun:
         ):
             return _run_game_training(params, logger, shutdown)
     finally:
+        if conv_tracker is not None:
+            try:
+                path = conv_tracker.dump(
+                    os.path.join(
+                        params.output_dir, "convergence-report.json"
+                    )
+                )
+                logger.info(f"wrote convergence report to {path}")
+            except OSError:
+                pass
+            obs.uninstall_convergence_tracker()
         shutdown.uninstall()
         logger.close()
 
@@ -1064,6 +1085,12 @@ def main(argv=None) -> None:
         ".json dumps on divergence/preemption/crash (default: "
         "--trace-dir)",
     )
+    p.add_argument(
+        "--convergence-report", action="store_true", default=None,
+        help="decode the solvers' device-side tapes: per-coordinate "
+        "fleet convergence summaries every pass (convergence.* metrics "
+        "+ events) and <output-dir>/convergence-report.json",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1089,6 +1116,8 @@ def main(argv=None) -> None:
         base["hbm_every"] = args.hbm_every
     if args.flight_dir is not None:
         base["flight_dir"] = args.flight_dir
+    if args.convergence_report is not None:
+        base["convergence_report"] = args.convergence_report
     run_game_training(base)
 
 

@@ -8,6 +8,17 @@ own monotonic clock. This CLI folds them into pod-level artifacts:
     python -m photon_ml_tpu.cli.obs_tools merge \
         --out out/pod-trace out/trace-host0 out/trace-host1 ...
 
+    # render a run's convergence health from its events.jsonl
+    python -m photon_ml_tpu.cli.obs_tools convergence out/trace
+
+``convergence`` reads the ``convergence.solve`` / ``convergence.fleet``
+events the obs.convergence layer emits (train CLIs under ``--trace-dir``
+and/or ``--convergence-report``) and renders per-solve value/grad-norm
+curves plus per-coordinate fleet summaries (iterations histogram,
+non-converged entities, worst-k by final gradient norm) as terminal
+text. Exit 0 with a BENCH-style JSON summary line, 2 when the log holds
+no convergence records.
+
 ``merge`` accepts trace directories or ``trace.json`` paths, aligns the
 per-shard clocks at the barrier-stamped ``clock.sync`` event each shard
 carries (``obs.dist.emit_clock_sync``; fallback: wall-clock epochs),
@@ -138,6 +149,184 @@ def merge_command(args) -> int:
     return 0
 
 
+# -- photon-obs convergence --------------------------------------------------
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(series, width: int = 48) -> str:
+    """Terminal sparkline of a numeric series (log-spread where the
+    dynamic range warrants it — grad norms span decades per solve)."""
+    import math as _math
+
+    vals = [
+        float(v)
+        for v in series
+        if isinstance(v, (int, float)) and _math.isfinite(v)
+    ]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # decimate evenly; keep the endpoints
+        idx = [round(i * (len(vals) - 1) / (width - 1)) for i in range(width)]
+        vals = [vals[i] for i in idx]
+    lo, hi = min(vals), max(vals)
+    if hi > 0 and lo > 0 and hi / max(lo, 1e-300) > 1e3:
+        vals = [_math.log10(v) for v in vals]
+        lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        _SPARK_BLOCKS[
+            min(int((v - lo) / span * (len(_SPARK_BLOCKS) - 1) + 0.5),
+                len(_SPARK_BLOCKS) - 1)
+        ]
+        for v in vals
+    )
+
+
+def _load_convergence_events(path: str):
+    """(solve_events, fleet_events, warnings) from one events.jsonl —
+    torn lines skipped, like the merge path (post-mortem logs)."""
+    solves, fleets, warnings = [], [], []
+    try:
+        f = open(path, encoding="utf-8")
+    except OSError as e:
+        return [], [], [f"{path}: unreadable ({e})"]
+    with f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                warnings.append(f"{path}:{lineno}: torn line skipped")
+                continue
+            # kind matters: the convergence counter-track samples share
+            # the "convergence.solve" NAME with the structured events
+            if rec.get("kind") != "event":
+                continue
+            name = rec.get("name", "")
+            if name == "convergence.solve":
+                solves.append(rec)
+            elif name == "convergence.fleet":
+                fleets.append(rec)
+    return solves, fleets, warnings
+
+
+def convergence_command(args) -> int:
+    path = args.events
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    solves, fleets, warnings = _load_convergence_events(path)
+    for w in warnings:
+        print(f"photon-obs: warning: {w}", file=sys.stderr)
+    if not solves and not fleets:
+        print(
+            f"photon-obs: no convergence records in {path} (run training "
+            "with --trace-dir and/or --convergence-report)",
+            file=sys.stderr,
+        )
+        return 2
+
+    out = sys.stderr  # human rendering; the JSON summary owns stdout
+    if solves:
+        print(f"— per-solve convergence ({len(solves)} solves) —", file=out)
+        for rec in solves[-args.last:]:
+            label = rec.get("label") or rec.get("optimizer", "solve")
+            print(
+                f"{label}: {rec.get('optimizer', '?')} "
+                f"iters={rec.get('iterations')} "
+                f"reason={rec.get('reason')} order={rec.get('order')}"
+                + (
+                    f" rate={rec['rate']:.3g}"
+                    if isinstance(rec.get("rate"), (int, float))
+                    else ""
+                ),
+                file=out,
+            )
+            values = rec.get("values") or []
+            gnorms = rec.get("grad_norms") or []
+            if len(values) > 1:
+                print(f"  value     {_sparkline(values)}", file=out)
+            if len(gnorms) > 1:
+                print(f"  |grad|    {_sparkline(gnorms)}", file=out)
+            for tape_name, tape in sorted(
+                (rec.get("tapes") or {}).items()
+            ):
+                if len(tape) > 1:
+                    print(
+                        f"  {tape_name:<9} {_sparkline(tape)}", file=out
+                    )
+    by_coord = {}
+    for rec in fleets:
+        by_coord.setdefault(rec.get("coordinate", "?"), []).append(rec)
+    if by_coord:
+        print(
+            f"— fleet convergence ({len(fleets)} coordinate updates) —",
+            file=out,
+        )
+        for coord, recs in sorted(by_coord.items()):
+            entities = recs[-1].get("entities", 0)
+            nonconv = sum(r.get("nonconverged", 0) for r in recs)
+            total = sum(r.get("entities", 0) for r in recs)
+            medians = [
+                r["median_iters"]
+                for r in recs
+                if isinstance(r.get("median_iters"), (int, float))
+            ]
+            med = sorted(medians)[len(medians) // 2] if medians else 0.0
+            print(
+                f"{coord}: {len(recs)} updates x {entities} entities; "
+                f"median_iters={med:g} "
+                f"nonconverged={nonconv}/{total} "
+                f"({(nonconv / total if total else 0.0):.2%})",
+                file=out,
+            )
+            print(
+                "  median iters/pass "
+                + _sparkline([r.get("median_iters", 0) for r in recs]),
+                file=out,
+            )
+            last = recs[-1]
+            hist = last.get("iters_histogram") or {}
+            if hist:
+                pairs = sorted((int(k), v) for k, v in hist.items())
+                print(
+                    "  last-pass iters histogram: "
+                    + " ".join(f"{k}:{v}" for k, v in pairs),
+                    file=out,
+                )
+            worst = last.get("worst") or []
+            if worst:
+                print(
+                    "  worst entities (final |grad|): "
+                    + ", ".join(
+                        f"#{int(e)}={g:.3g}" for e, g in worst
+                    ),
+                    file=out,
+                )
+    print(
+        json.dumps(
+            {
+                "metric": "obs_convergence",
+                "value": len(solves) + len(fleets),
+                "unit": "records",
+                "extra": {
+                    "events": path,
+                    "solves": len(solves),
+                    "fleet_updates": len(fleets),
+                    "coordinates": sorted(by_coord),
+                    "warnings": len(warnings),
+                },
+            }
+        )
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="photon-obs",
@@ -159,6 +348,22 @@ def main(argv=None) -> int:
         help="output directory for the merged pod artifacts",
     )
     mp.set_defaults(func=merge_command)
+    cp = sub.add_parser(
+        "convergence",
+        help="render per-solve curves + fleet summaries from a run's "
+        "events.jsonl",
+    )
+    cp.add_argument(
+        "events",
+        help="trace directory (or events.jsonl path) of a traced run",
+    )
+    cp.add_argument(
+        "--last",
+        type=int,
+        default=8,
+        help="how many of the most recent solves to render (default 8)",
+    )
+    cp.set_defaults(func=convergence_command)
     args = p.parse_args(argv)
     return args.func(args)
 

@@ -168,23 +168,47 @@ def run_glm_training(params) -> GLMTrainingRun:
 
     params = load_params(params, GLMDriverParams)
     params.validate()
+    # the output-dir guard must fire BEFORE the observe() envelope: a
+    # metrics.json path inside output_dir makes the envelope mkdir it,
+    # which the guard would then misread as a pre-existing run
+    prepare_output_dir(params.output_dir, params.overwrite)
     metrics_path = None
-    if params.trace_dir is None and params.metrics_every > 0:
-        metrics_path = os.path.join(params.output_dir, "metrics.json")
-    with obs.observe(
-        trace_dir=params.trace_dir,
-        metrics_path=metrics_path,
-        metrics_every=params.metrics_every,
-        profile_dir=params.profile_dir,
-        hbm_every_s=params.hbm_every,
-        process_name="photon_ml_tpu.train",
-        flight_dir=params.flight_dir,
+    if params.trace_dir is None and (
+        params.metrics_every > 0 or params.convergence_report
     ):
-        return _run_glm_training(params)
+        metrics_path = os.path.join(params.output_dir, "metrics.json")
+    conv_tracker = None
+    if params.convergence_report:
+        # per-solve tape decode even without a tracer (obs.convergence);
+        # the aggregated report lands next to the models below
+        conv_tracker = obs.install_convergence_tracker()
+    try:
+        with obs.observe(
+            trace_dir=params.trace_dir,
+            metrics_path=metrics_path,
+            metrics_every=params.metrics_every,
+            profile_dir=params.profile_dir,
+            hbm_every_s=params.hbm_every,
+            process_name="photon_ml_tpu.train",
+            flight_dir=params.flight_dir,
+        ):
+            return _run_glm_training(params)
+    finally:
+        if conv_tracker is not None:
+            try:
+                conv_tracker.dump(
+                    os.path.join(
+                        params.output_dir, "convergence-report.json"
+                    )
+                )
+            except OSError:
+                pass
+            obs.uninstall_convergence_tracker()
 
 
 def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
-    prepare_output_dir(params.output_dir, params.overwrite)
+    # output dir already prepared by run_glm_training (before the
+    # observe envelope could create it)
     tracker = StageTracker()
     logger = PhotonLogger(
         os.path.join(params.output_dir, "log-message.txt"),
@@ -373,12 +397,14 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
                 # metrics (``Driver.scala:293-347``)
                 per_iter: Dict[str, List[Dict[str, float]]] = {}
                 for i, tm in enumerate(models):
-                    hist = tm.result.w_history
-                    if hist is None:
+                    if tm.result.w_history is None:
                         continue
-                    n_models = int(tm.result.iterations) + 1
+                    # masked_history truncates the ModelTracker buffer
+                    # past `iterations` (the entries-are-garbage
+                    # contract, solvers/common.SolverResult)
+                    hist = tm.result.masked_history()[2]
                     rows = []
-                    for it in range(n_models):
+                    for it in range(hist.shape[0]):
                         margins = (
                             vbatch.features @ hist[it] + vbatch.offsets
                         )
@@ -544,6 +570,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--flight-dir", default=None,
         help="crash flight recorder output directory: flight-<reason>"
         ".json dumps on preemption/crash (default: --trace-dir)",
+    )
+    p.add_argument(
+        "--convergence-report", action="store_true", default=None,
+        help="decode each solve's device-side tapes (reason / rate / "
+        "plateau / per-iteration curves) into convergence.* metrics + "
+        "events and <output-dir>/convergence-report.json",
     )
     return p
 

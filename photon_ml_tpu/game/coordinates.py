@@ -71,12 +71,18 @@ class CoordinateConfig:
     # random-effect only
     random_effect: Optional[str] = None
     active_cap: Optional[int] = None
+    # per-iteration solver tapes (values/grad norms/radius/step — the
+    # obs/convergence.py decode surface). Off by default: vmapped
+    # per-entity solves would carry (entities, max_iters+1) tracker
+    # state; the fleet summaries (reason/iterations/final grad norm)
+    # don't need it.
+    track_states: bool = False
 
     def solver_config(self) -> SolverConfig:
         return SolverConfig(
             max_iters=self.max_iters,
             tolerance=self.tolerance,
-            track_states=False,
+            track_states=self.track_states,
         )
 
 
@@ -479,21 +485,29 @@ class RandomEffectUpdateSummary:
     buckets with sharding-padding lanes removed
     (``RandomEffectOptimizationTracker.scala:33-110``).
 
-    LAZY: holds device arrays until `.reason` / `.iterations` is first
-    read, so the coordinate-descent loop can enqueue the next update
-    without a device->host sync per pass (the reference pays a collect
-    per tracker read; we defer it to history materialization)."""
+    LAZY: holds device arrays until `.reason` / `.iterations` /
+    `.grad_norms` is first read, so the coordinate-descent loop can
+    enqueue the next update without a device->host sync per pass (the
+    reference pays a collect per tracker read; we defer it to history
+    materialization)."""
 
-    # [(reason_dev (E_b,), iterations_dev (E_b,), valid mask), ...]
+    # [(reason_dev (E_b,), iterations_dev (E_b,), grad_norm_dev (E_b,),
+    #   valid mask, entity_index (E_b,)), ...]
     pending: list
 
     def _materialize(self):
         if self.pending is not None:
             self._reason = np.concatenate(
-                [np.asarray(r)[v] for r, _, v in self.pending]
+                [np.asarray(r)[v] for r, _, _, v, _ in self.pending]
             )
             self._iterations = np.concatenate(
-                [np.asarray(i)[v] for _, i, v in self.pending]
+                [np.asarray(i)[v] for _, i, _, v, _ in self.pending]
+            )
+            self._grad_norms = np.concatenate(
+                [np.asarray(g)[v] for _, _, g, v, _ in self.pending]
+            )
+            self._entity_ids = np.concatenate(
+                [np.asarray(e)[v] for _, _, _, v, e in self.pending]
             )
             self.pending = None
 
@@ -506,6 +520,16 @@ class RandomEffectUpdateSummary:
     def iterations(self) -> np.ndarray:  # (E_active,) int32
         self._materialize()
         return self._iterations
+
+    @property
+    def grad_norms(self) -> np.ndarray:  # (E_active,) final ||grad||
+        self._materialize()
+        return self._grad_norms
+
+    @property
+    def entity_ids(self) -> np.ndarray:  # (E_active,) table rows
+        self._materialize()
+        return self._entity_ids
 
 
 def _make_multi_bucket_update(config: CoordinateConfig):
@@ -527,6 +551,7 @@ def _make_multi_bucket_update(config: CoordinateConfig):
 @lru_cache(maxsize=128)
 def _make_multi_bucket_update_cached(config: CoordinateConfig):
     solve = _make_solve(config, batched=True)
+    from photon_ml_tpu.solvers.common import final_grad_norm
 
     @jax.jit
     def update_all(
@@ -543,7 +568,13 @@ def _make_multi_bucket_update_cached(config: CoordinateConfig):
                 bucket.weights, bucket.mask,
             )
             table = table.at[eidx].set(result.w, mode="drop")
-            trackers.append((result.reason, result.iterations))
+            # final per-entity gradient norm rides the tracker tuple
+            # (valid with tracking on or off), feeding the fleet-level
+            # convergence summaries' worst-k signal for free — it is
+            # computed in-program, no extra dispatch
+            trackers.append(
+                (result.reason, result.iterations, final_grad_norm(result))
+            )
         # full-row rescore in the same dispatch
         scores = _score_rows_by_entity(table, row_features, row_entities)
         return table, tuple(trackers), scores
@@ -674,10 +705,14 @@ class RandomEffectCoordinate:
         )
 
     def wrap_tracker(self, trackers: tuple) -> "RandomEffectUpdateSummary":
-        """Raw (reason, iterations) bucket tuple -> lazy history summary."""
+        """Raw (reason, iterations, grad_norm) bucket tuple -> lazy
+        history summary (valid-lane masks and the lanes' table-row
+        indices are host-side statics attached here)."""
         pending = [
-            (reason, iters, valid)
-            for (reason, iters), valid in zip(trackers, self._valid_lanes)
+            (reason, iters, gnorm, valid, np.asarray(ei))
+            for (reason, iters, gnorm), valid, ei in zip(
+                trackers, self._valid_lanes, self.design.entity_index
+            )
         ]
         return RandomEffectUpdateSummary(pending=pending)
 

@@ -496,6 +496,17 @@ class CoordinateDescent:
         def materialize():
             if not pending:
                 return
+            from photon_ml_tpu.obs import convergence as _conv
+
+            # convergence decode is OPT-IN via the installed
+            # --convergence-report tracker, not via plain tracing: the
+            # per-update fleet decode (numpy aggregation + one
+            # structured event per coordinate per pass) measurably eats
+            # into the <5% tracing budget on smoke shapes, so it rides
+            # the gate's dedicated tapes-on leg instead
+            # (benchmarks/obs_overhead.py). Events still land in
+            # events.jsonl when a tracer is ALSO active.
+            conv_enabled = _conv.tracking_enabled()
             # ONE batched device->host transfer for the whole backlog:
             # individually materialized values cost a full tunnel RTT
             # EACH (measured ~0.1-0.36 s/fetch on this runtime vs ~0.16 s
@@ -509,15 +520,27 @@ class CoordinateDescent:
                 raw = getattr(r, "pending", None)
                 if raw is not None:
                     # lazy RandomEffectUpdateSummary: per-bucket device
-                    # (reason, iterations); valid-lane masks are host-side
+                    # (reason, iterations, final grad norm); valid-lane
+                    # masks and entity indices are host-side
                     fetch.append(
                         (
                             p["objective"],
-                            tuple((re_, it_) for re_, it_, _ in raw),
+                            tuple(
+                                (re_, it_, gn_)
+                                for re_, it_, gn_, _, _ in raw
+                            ),
                         )
                     )
                 else:
-                    fetch.append((p["objective"], (r.reason, r.iterations)))
+                    # grad_norms tape rides the drain whole (tiny); the
+                    # final-norm gather happens host-side below so the
+                    # track_states=True case stays correct
+                    fetch.append(
+                        (
+                            p["objective"],
+                            (r.reason, r.iterations, r.grad_norms),
+                        )
+                    )
             if jax.process_count() > 1:
                 # global arrays with non-addressable shards (entity-lane
                 # sharded trackers) reshard to replicated ON DEVICE so
@@ -533,21 +556,40 @@ class CoordinateDescent:
                 result = p.pop("result")
                 raw = getattr(result, "pending", None)
                 if raw is not None:
-                    valid = [v for _, _, v in raw]
+                    valid = [v for _, _, _, v, _ in raw]
                     reason = np.concatenate(
                         [
                             np.asarray(re_)[v]
-                            for (re_, _), v in zip(tr, valid)
+                            for (re_, _, _), v in zip(tr, valid)
                         ]
                     )
                     iterations = np.concatenate(
                         [
                             np.asarray(it_)[v]
-                            for (_, it_), v in zip(tr, valid)
+                            for (_, it_, _), v in zip(tr, valid)
+                        ]
+                    )
+                    grad_norms = np.concatenate(
+                        [
+                            np.asarray(gn_)[v]
+                            for (_, _, gn_), v in zip(tr, valid)
+                        ]
+                    )
+                    entity_ids = np.concatenate(
+                        [
+                            np.asarray(ei)[v]
+                            for (_, _, _, v, ei) in raw
                         ]
                     )
                 else:
-                    reason, iterations = tr
+                    reason, iterations, gn_tape = tr
+                    gn_arr = np.asarray(gn_tape)
+                    it_arr = np.asarray(iterations)
+                    idx = np.minimum(it_arr, gn_arr.shape[-1] - 1)
+                    grad_norms = np.take_along_axis(
+                        gn_arr, idx[..., None], axis=-1
+                    )[..., 0]
+                    entity_ids = None
                 rec = _history_record(
                     p["iteration"],
                     p["coordinate"],
@@ -560,6 +602,20 @@ class CoordinateDescent:
                 )
                 history.append(rec)
                 _record_update_metrics(rec)
+                if conv_enabled:
+                    # fleet summary per coordinate per pass: iterations
+                    # histogram, non-converged count/fraction, worst-k
+                    # entities by final grad norm -> convergence.*
+                    # metrics + convergence.fleet events (which also
+                    # ride the tracer hook into the flight recorder)
+                    _conv.note_update(
+                        coordinate=p["coordinate"],
+                        iteration=p["iteration"],
+                        reasons=reason,
+                        iterations=iterations,
+                        grad_norms=grad_norms,
+                        entity_ids=entity_ids,
+                    )
             pending.clear()
 
         # the fused path needs the FULL trace-safe surface, not just

@@ -433,14 +433,23 @@ def train_glm(
             optimizer=config.optimizer.name,
             reg_weight=float(lam),
         ) as sp:
+            tracer = obs.get_tracer()
+            ts0 = tracer.now_us() if tracer is not None else 0.0
             t0 = time.perf_counter()
             result = solve(w, jnp.asarray(lam, dtype), batch, norm)
-            if obs.get_tracer() is not None:
-                # device-time attribution + per-solve iteration counters.
-                # Both synchronize, so they run ONLY under an active
-                # tracer: the disabled path must keep pipelined solves
-                # (bench.py) free of inserted host syncs.
+            conv_enabled = (
+                tracer is not None
+                or obs.convergence.tracking_enabled()
+            )
+            if conv_enabled:
+                # device-time attribution + per-solve iteration counters
+                # + the convergence decode. All synchronize, so they run
+                # ONLY under an active tracer (or an installed
+                # --convergence-report tracker): the disabled path must
+                # keep pipelined solves (bench.py) free of inserted host
+                # syncs.
                 sp.sync(result.w)
+                seconds = time.perf_counter() - t0
                 _record_solve_metrics(config, result)
                 # live hardware attribution: counted design passes x the
                 # cost book's per-pass FLOPs/bytes over the synchronized
@@ -451,9 +460,28 @@ def train_glm(
                 obs.annotate_span(
                     sp,
                     _objective_pass_cost(config, batch, norm),
-                    seconds=time.perf_counter() - t0,
+                    seconds=seconds,
                     passes=design_passes(result),
                 )
+                # convergence-health decode (obs/convergence.py): the
+                # in-program tapes -> reason/rate/plateau report,
+                # convergence.* metrics, a structured event carrying
+                # the tapes, and a Chrome counter track replaying the
+                # (value, |grad|) curve under this span's window
+                report = obs.decode_result(
+                    result, optimizer=config.optimizer.name.lower()
+                )
+                obs.convergence.note_solve(
+                    report, label=f"lambda={float(lam):g}"
+                )
+                sp.set(
+                    convergence_reason=report.reason,
+                    convergence_order=report.order,
+                )
+                if tracer is not None:
+                    obs.convergence.emit_tape_counters(
+                        report, tracer, ts0, seconds * 1e6
+                    )
         w = result.w  # warm start for the next (smaller) lambda
         if config.track_models and result.w_history is not None:
             # snapshots leave the solver in normalized space; de-normalize

@@ -31,7 +31,18 @@ import threading
 from typing import Optional
 
 from photon_ml_tpu.obs import collectives
+from photon_ml_tpu.obs import convergence
 from photon_ml_tpu.obs import dist
+from photon_ml_tpu.obs.convergence import (
+    ConvergenceReport,
+    ConvergenceTracker,
+    FleetSummary,
+    convergence_tracker,
+    decode_result,
+    fleet_summary,
+    install_convergence_tracker,
+    uninstall_convergence_tracker,
+)
 from photon_ml_tpu.obs.collectives import (
     collective_span,
     note_traced_collective,
@@ -142,6 +153,16 @@ __all__ = [
     # ambient span context
     "span_context",
     "current_span_context",
+    # convergence-health layer (obs.convergence)
+    "convergence",
+    "ConvergenceReport",
+    "ConvergenceTracker",
+    "FleetSummary",
+    "convergence_tracker",
+    "decode_result",
+    "fleet_summary",
+    "install_convergence_tracker",
+    "uninstall_convergence_tracker",
 ]
 
 

@@ -85,6 +85,14 @@ _DIRECTION_RULES = (
     (re.compile(r"achieved_tflops$"), HIGHER_IS_BETTER),
     (re.compile(r"auc"), HIGHER_IS_BETTER),
     # smaller is better
+    # convergence health (bench_game's decoded fleet summaries): more
+    # iterations to converge or a larger non-converged fraction is a
+    # solver-quality regression even when wall clocks hold steady
+    (re.compile(r"(^|\.)convergence\.median_iters$"), LOWER_IS_BETTER),
+    (
+        re.compile(r"(^|\.)convergence\.nonconverged_frac$"),
+        LOWER_IS_BETTER,
+    ),
     (re.compile(r"(_s|_ms|_mb|_kb|_m)$"), LOWER_IS_BETTER),
     (re.compile(r"(^|\.)passes$"), LOWER_IS_BETTER),
     (re.compile(r"^value$"), LOWER_IS_BETTER),

@@ -223,7 +223,12 @@ class Tracer:
         name: str,
         cat: str = "event",
         args: Optional[Dict[str, Any]] = None,
+        flush: bool = True,
     ) -> None:
+        """Instant events flush the JSONL immediately by default — they
+        exist to survive the crash that follows them. Periodic telemetry
+        instants (per-pass convergence summaries) pass ``flush=False``
+        and ride the batched span flush instead."""
         ts = self.now_us()
         ev = {
             "ph": "i",
@@ -246,25 +251,31 @@ class Tracer:
                     "time_unix": round(self._wall(ts), 6),
                     **(args or {}),
                 },
-                flush=True,
+                flush=flush,
             )
 
     def add_counter(
-        self, name: str, values: Dict[str, float]
+        self,
+        name: str,
+        values: Dict[str, float],
+        ts_us: Optional[float] = None,
     ) -> None:
         """Record a Chrome counter-track sample ('C' event): Perfetto
         renders successive samples of the same ``name`` as a stacked
         area graph under the timeline — the HBM telemetry surface
-        (``obs.device``). Samples are periodic and bulky, so the JSONL
-        mirror rides the batched span flush, not the instant-event
-        immediate flush."""
+        (``obs.device``). ``ts_us`` retro-stamps the sample (the
+        convergence layer replays a solve's tape across the solve's
+        span window; the iterations happened inside one dispatch, so
+        their timestamps are only known after it returns). Samples are
+        periodic and bulky, so the JSONL mirror rides the batched span
+        flush, not the instant-event immediate flush."""
         ev = {
             "ph": "C",
             "name": name,
             "cat": "counter",
             "pid": self._pid,
             "tid": 0,
-            "ts": round(self.now_us(), 3),
+            "ts": round(self.now_us() if ts_us is None else ts_us, 3),
             "args": dict(values),
         }
         with self._lock:

@@ -16,6 +16,8 @@ from photon_ml_tpu.solvers.common import (
     SolverConfig,
     SolverResult,
     design_passes,
+    final_grad_norm,
+    mask_tape,
     project_to_hypercube,
 )
 from photon_ml_tpu.solvers.lbfgs import minimize_lbfgs, minimize_owlqn
@@ -27,6 +29,8 @@ __all__ = [
     "SolverConfig",
     "SolverResult",
     "design_passes",
+    "final_grad_norm",
+    "mask_tape",
     "project_to_hypercube",
     "minimize_lbfgs",
     "minimize_owlqn",

@@ -77,8 +77,9 @@ class SolverResult:
     """What a solve returns — all device arrays, so it vmaps cleanly.
 
     ``values``/``grad_norms`` are (max_iters+1,) tracker buffers; entries at
-    index > iterations are garbage and must be masked by callers (the tracker
-    wrapper does this). Mirrors OptimizerState + OptimizationStatesTracker.
+    index > iterations are garbage and must be masked by callers — use
+    :meth:`masked_history` / :func:`mask_tape` instead of re-deriving the
+    contract by hand. Mirrors OptimizerState + OptimizationStatesTracker.
     """
 
     w: jax.Array
@@ -100,6 +101,72 @@ class SolverResult:
     # (ModelTracker); entries at index > iterations are unwritten zeros
     # and must be masked by callers like the values buffer
     w_history: Optional[jax.Array] = None
+    # in-program convergence tapes (track_states; one slot otherwise),
+    # decoded by obs/convergence.py — the telemetry that rides the
+    # while_loop carry and therefore survives fully device-resident
+    # solver loops (no host-side tracer needed):
+    # TRON only: trust-region radius after each outer step (slot 0 =
+    # the initial radius) and inner CG iterations per outer step
+    radius_tape: Optional[jax.Array] = None
+    cg_tape: Optional[jax.Array] = None
+    # first-order + Newton: accepted step size per iteration (slot 0 =
+    # 0) and objective evaluations per iteration (slot 0 = the initial
+    # value/grad pass)
+    step_tape: Optional[jax.Array] = None
+    eval_tape: Optional[jax.Array] = None
+
+    def masked_history(self):
+        """Host-side tracker buffers with the entries-past-``iterations``
+        garbage removed — THE reader every consumer of ``values`` /
+        ``grad_norms`` / ``w_history`` should use instead of slicing by
+        hand. Returns ``(values, grad_norms)`` — plus ``w_history`` as a
+        third element when it was tracked. Scalar results come back
+        TRUNCATED to ``iterations + 1`` entries (``iterations ==
+        max_iters`` keeps the full buffer); vmapped results keep the
+        full tape length with invalid entries masked to NaN (ragged
+        truncation cannot batch). Materializes device arrays."""
+        out = [
+            mask_tape(self.values, self.iterations),
+            mask_tape(self.grad_norms, self.iterations),
+        ]
+        if self.w_history is not None:
+            out.append(mask_tape(self.w_history, self.iterations, axis=-2))
+        return tuple(out)
+
+
+def mask_tape(tape, iterations, axis: int = -1) -> np.ndarray:
+    """Apply the tracker-buffer contract (entries past ``iterations``
+    are garbage) on the host: truncate along ``axis`` for a scalar
+    ``iterations``, NaN-mask for batched ones (a vmapped result's lanes
+    stop at different iterations, so truncation cannot batch). Also
+    correct for untracked one-slot buffers (index clamps)."""
+    arr = np.asarray(tape)
+    iters = np.asarray(iterations)
+    axis = axis % arr.ndim
+    size = arr.shape[axis]
+    if iters.ndim == 0:
+        n = min(int(iters), size - 1) + 1
+        sl = [slice(None)] * arr.ndim
+        sl[axis] = slice(0, n)
+        return arr[tuple(sl)]
+    idx_shape = [1] * arr.ndim
+    idx_shape[axis] = size
+    idx = np.arange(size).reshape(idx_shape)
+    lim = np.minimum(iters, size - 1).reshape(
+        list(iters.shape) + [1] * (arr.ndim - iters.ndim)
+    )
+    return np.where(idx <= lim, arr, np.nan)
+
+
+def final_grad_norm(result: "SolverResult") -> jax.Array:
+    """||grad|| at the solve's LAST written tracker slot — valid with
+    tracking on (gather at ``iterations``) or off (the one slot holds
+    the latest state). Trace-safe and batched-safe; the GAME tracker
+    tuples carry this per entity so fleet convergence summaries get a
+    final-gradient signal without full tapes."""
+    gn = result.grad_norms
+    idx = jnp.minimum(result.iterations, gn.shape[-1] - 1)
+    return jnp.take_along_axis(gn, idx[..., None], axis=-1)[..., 0]
 
 
 def design_passes(result: "SolverResult") -> float:
@@ -111,16 +178,19 @@ def design_passes(result: "SolverResult") -> float:
     products (the curvature weights ride the acceptance evaluation, so
     no extra setup pass). First-order solvers: tracked value/grad
     evaluations. Fallback (exotic results): iterations + 1.
-    Materializes device scalars — callers gate on observability."""
+    A vmapped (batched) result sums the counted passes over its batch
+    lanes — each lane is one solve. Materializes device scalars —
+    callers gate on observability."""
+    iters = np.asarray(result.iterations)
     if result.cg_iterations is not None:
         return (
-            float(np.asarray(result.iterations))
-            + 1.0
-            + float(np.asarray(result.cg_iterations))
+            float(iters.sum())
+            + float(iters.size)
+            + float(np.asarray(result.cg_iterations).sum())
         )
     if result.evals is not None:
-        return float(np.asarray(result.evals))
-    return float(np.asarray(result.iterations)) + 1.0
+        return float(np.asarray(result.evals).sum())
+    return float(iters.sum()) + float(iters.size)
 
 
 def record_solver_metrics(prefix: str, result: "SolverResult", registry=None) -> None:
@@ -211,6 +281,21 @@ def tracker_buffers(
 def record_state(values, grad_norms, i, value, grad_norm):
     i = jnp.minimum(i, values.shape[0] - 1)
     return values.at[i].set(value), grad_norms.at[i].set(grad_norm)
+
+
+def tape_buffer(max_iters: int, dtype, track: bool = True) -> jax.Array:
+    """One per-iteration tape (radius, step size, CG/eval counts…):
+    same sizing/sentinel contract as :func:`tracker_buffers` — one slot
+    when tracking is off so vmapped per-entity solves don't carry
+    (entities, max_iters) state, +inf fill so unwritten slots are
+    obviously not measurements yet jax_debug_nans-safe."""
+    size = max_iters + 1 if track else 1
+    return jnp.full((size,), jnp.inf, dtype)
+
+
+def record_tape(tape: jax.Array, i, value) -> jax.Array:
+    i = jnp.minimum(i, tape.shape[0] - 1)
+    return tape.at[i].set(value)
 
 
 def model_buffer(max_iters: int, w0: jax.Array, track: bool) -> jax.Array:

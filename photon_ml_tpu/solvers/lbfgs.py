@@ -33,6 +33,8 @@ from photon_ml_tpu.solvers.common import (
     check_convergence,
     project_to_hypercube,
     record_state,
+    record_tape,
+    tape_buffer,
     tracker_buffers,
 )
 from photon_ml_tpu.solvers.linesearch import strong_wolfe
@@ -128,6 +130,10 @@ class _LbfgsState(NamedTuple):
     grad_norms: jax.Array
     w_history: jax.Array
     evals: jax.Array  # total value_and_grad calls (full design passes)
+    # per-iteration convergence tapes (track_states; one slot off):
+    # accepted step size, line-search evaluations
+    step_tape: jax.Array
+    eval_tape: jax.Array
 
 
 def minimize_lbfgs(
@@ -148,6 +154,13 @@ def minimize_lbfgs(
     gnorm0 = jnp.linalg.norm(g0)
     values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
     w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
+    # slot 0: no step yet, one eval (the initial value/grad pass)
+    step_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 0.0
+    )
+    eval_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 1.0
+    )
 
     init = _LbfgsState(
         w=w0,
@@ -166,6 +179,8 @@ def minimize_lbfgs(
         grad_norms=grad_norms,
         w_history=w_hist0,
         evals=jnp.int32(1),
+        step_tape=step_tape0,
+        eval_tape=eval_tape0,
     )
 
     def body(s: _LbfgsState) -> _LbfgsState:
@@ -259,6 +274,10 @@ def minimize_lbfgs(
             grad_norms=grad_norms,
             w_history=record_model(s.w_history, it, w_new),
             evals=s.evals + iter_evals,
+            step_tape=record_tape(s.step_tape, it, alpha),
+            eval_tape=record_tape(
+                s.eval_tape, it, iter_evals.astype(s.eval_tape.dtype)
+            ),
         )
 
     final = lax.while_loop(
@@ -274,6 +293,8 @@ def minimize_lbfgs(
         grad_norms=final.grad_norms,
         w_history=final.w_history if config.track_models else None,
         evals=final.evals,
+        step_tape=final.step_tape,
+        eval_tape=final.eval_tape,
     )
 
 
@@ -304,6 +325,9 @@ class _OwlqnState(NamedTuple):
     grad_norms: jax.Array
     w_history: jax.Array
     evals: jax.Array  # total value_and_grad calls (full design passes)
+    # per-iteration convergence tapes (see _LbfgsState)
+    step_tape: jax.Array
+    eval_tape: jax.Array
 
 
 def minimize_owlqn(
@@ -332,6 +356,12 @@ def minimize_owlqn(
     values, grad_norms = tracker_buffers(config.max_iters, dtype, config.track_states)
     values, grad_norms = record_state(values, grad_norms, 0, f0, pgnorm0)
     w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
+    step_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 0.0
+    )
+    eval_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 1.0
+    )
 
     init = _OwlqnState(
         w=w0,
@@ -351,6 +381,8 @@ def minimize_owlqn(
         grad_norms=grad_norms,
         w_history=w_hist0,
         evals=jnp.int32(1),
+        step_tape=step_tape0,
+        eval_tape=eval_tape0,
     )
 
     def body(s: _OwlqnState) -> _OwlqnState:
@@ -444,6 +476,13 @@ def minimize_owlqn(
             grad_norms=grad_norms,
             w_history=record_model(s.w_history, it, w_new),
             evals=s.evals + ls_evals,
+            # a dead line search commits no step: tape the honest 0.0
+            step_tape=record_tape(
+                s.step_tape, it, jnp.where(ls_ok, alpha, 0.0)
+            ),
+            eval_tape=record_tape(
+                s.eval_tape, it, ls_evals.astype(s.eval_tape.dtype)
+            ),
         )
 
     final = lax.while_loop(
@@ -459,6 +498,8 @@ def minimize_owlqn(
         grad_norms=final.grad_norms,
         w_history=final.w_history if config.track_models else None,
         evals=final.evals,
+        step_tape=final.step_tape,
+        eval_tape=final.eval_tape,
     )
 
 

@@ -33,6 +33,8 @@ from photon_ml_tpu.solvers.common import (
     model_buffer,
     record_model,
     record_state,
+    record_tape,
+    tape_buffer,
     tracker_buffers,
 )
 
@@ -54,6 +56,10 @@ class _NewtonState(NamedTuple):
     grad_norms: jax.Array
     w_history: jax.Array
     evals: jax.Array  # total value_and_grad calls (full design passes)
+    # per-iteration convergence tapes (track_states; one slot off):
+    # accepted damping step size, line-search evaluations
+    step_tape: jax.Array
+    eval_tape: jax.Array
 
 
 # Dimension bound for the unrolled Cholesky path. Measured on the real
@@ -121,6 +127,12 @@ def minimize_newton(
     )
     values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
     w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
+    step_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 0.0
+    )
+    eval_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 1.0
+    )
 
     init = _NewtonState(
         w=w0,
@@ -138,6 +150,8 @@ def minimize_newton(
         grad_norms=grad_norms,
         w_history=w_hist0,
         evals=jnp.int32(1),
+        step_tape=step_tape0,
+        eval_tape=eval_tape0,
     )
 
     def body(s: _NewtonState) -> _NewtonState:
@@ -225,6 +239,12 @@ def minimize_newton(
             grad_norms=grad_norms,
             w_history=record_model(s.w_history, it, w_new),
             evals=s.evals + ls_evals,
+            step_tape=record_tape(
+                s.step_tape, it, jnp.where(ls_ok, alpha, 0.0)
+            ),
+            eval_tape=record_tape(
+                s.eval_tape, it, ls_evals.astype(s.eval_tape.dtype)
+            ),
         )
 
     final = lax.while_loop(
@@ -240,4 +260,6 @@ def minimize_newton(
         grad_norms=final.grad_norms,
         w_history=final.w_history if config.track_models else None,
         evals=final.evals,
+        step_tape=final.step_tape,
+        eval_tape=final.eval_tape,
     )

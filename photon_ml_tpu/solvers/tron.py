@@ -39,6 +39,8 @@ from photon_ml_tpu.solvers.common import (
     model_buffer,
     record_model,
     record_state,
+    record_tape,
+    tape_buffer,
     tracker_buffers,
 )
 
@@ -146,6 +148,10 @@ class _TronState(NamedTuple):
     grad_norms: jax.Array
     cg_total: jax.Array
     w_history: jax.Array
+    # per-outer-step convergence tapes (track_states; one slot off):
+    # trust-region radius after the step's update, inner CG iterations
+    radius_tape: jax.Array
+    cg_tape: jax.Array
 
 
 def minimize_tron(
@@ -182,6 +188,13 @@ def minimize_tron(
     values, grad_norms = tracker_buffers(config.max_iters, dtype, config.track_states)
     values, grad_norms = record_state(values, grad_norms, 0, v0, gnorm0)
     w_hist0 = model_buffer(config.max_iters, w0, config.track_models)
+    # slot 0 = initial radius / zero CG work before the first step
+    radius_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, gnorm0
+    )
+    cg_tape0 = record_tape(
+        tape_buffer(config.max_iters, dtype, config.track_states), 0, 0.0
+    )
 
     init = _TronState(
         w=w0,
@@ -202,6 +215,8 @@ def minimize_tron(
         grad_norms=grad_norms,
         cg_total=jnp.int32(0),
         w_history=w_hist0,
+        radius_tape=radius_tape0,
+        cg_tape=cg_tape0,
     )
 
     def body(s: _TronState) -> _TronState:
@@ -306,6 +321,10 @@ def minimize_tron(
             grad_norms=grad_norms,
             cg_total=s.cg_total + cg_iters,
             w_history=record_model(s.w_history, it, w_new),
+            radius_tape=record_tape(s.radius_tape, it, delta),
+            cg_tape=record_tape(
+                s.cg_tape, it, cg_iters.astype(s.cg_tape.dtype)
+            ),
         )
 
     final = lax.while_loop(
@@ -321,6 +340,8 @@ def minimize_tron(
         grad_norms=final.grad_norms,
         cg_iterations=final.cg_total,
         w_history=final.w_history if config.track_models else None,
+        radius_tape=final.radius_tape,
+        cg_tape=final.cg_tape,
     )
 
 

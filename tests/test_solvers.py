@@ -93,8 +93,9 @@ class TestLBFGS:
     def test_tracker_buffers(self, rng):
         vg, _, _ = quadratic_problem(rng, d=4)
         res = minimize_lbfgs(vg, jnp.zeros(4))
-        iters = int(res.iterations)
-        vals = np.asarray(res.values)[: iters + 1]
+        # masked_history applies the entries-past-iterations contract
+        vals, _ = res.masked_history()
+        assert vals.shape == (int(res.iterations) + 1,)
         assert np.all(np.isfinite(vals))
         # objective decreases monotonically on a quadratic
         assert np.all(np.diff(vals) <= 1e-12)
