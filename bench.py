@@ -330,6 +330,46 @@ def bench_glm_dense():
     mfu = hw.get("mfu", 0.0)
     hbm_util = hw.get("hbm_util", 0.0)
 
+    # Device-resident regularization path (ROADMAP item 1): N lambdas
+    # execute as ONE lax.scan program — one dispatch + one RTT for the
+    # whole warm-started path, where the host loop paid one of each per
+    # lambda. Two numbers gate it: path wall per lambda (the amortized
+    # win; compare tpu_wall_incl_rtt_s, which pays a full RTT for ONE
+    # solve) and the counted solver dispatches per path (the
+    # tunnel-invariant proof, sentinel-tracked lower-is-better).
+    from photon_ml_tpu.obs.dispatch_count import count_dispatches
+
+    def path_config(lams_):
+        return GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.TRON,
+            regularization=RegularizationContext("L2"),
+            reg_weights=lams_,
+            tolerance=1e-5,
+            max_iters=20,
+            track_states=False,
+        )
+
+    n_path = 4
+    warm_path = train_glm(batch, path_config((11.0, 3.3, 1.1, 0.37)))
+    np.asarray(warm_path[-1].model.coefficients.means)  # compile + warm
+    t0 = time.perf_counter()
+    path = train_glm(
+        batch, path_config((10.0 * lam, 3.0 * lam, lam, 0.3 * lam))
+    )
+    for tm_ in path:
+        _jax.block_until_ready(tm_.model.coefficients.means)
+    np.asarray(path[-1].model.coefficients.means)
+    path_wall = time.perf_counter() - t0
+    with count_dispatches() as dc:
+        train_glm(batch, path_config((9.0, 2.9, 0.95, 0.29)))
+    dispatches_per_path = float(dc.for_program("solve_path"))
+    log(
+        f"regularization path: {n_path} lambdas in {path_wall:.3f}s "
+        f"({path_wall / n_path:.4f}s/lambda, "
+        f"{dispatches_per_path:.0f} solver dispatch(es))"
+    )
+
     from sklearn.linear_model import LogisticRegression
 
     t0 = time.perf_counter()
@@ -360,10 +400,15 @@ def bench_glm_dense():
         "achieved_tflops": pipe_fl / tpu_s / 1e12,
         "auc_device": auc_dev,
         "auc_cpu": auc_cpu,
+        "dispatches_per_path": dispatches_per_path,
+        "path_wall_per_lambda_s": path_wall / n_path,
     }
 
 
-def _build_game_cd(n_rows, d_fixed, n_entities, d_user, seed=7):
+def _build_game_cd(
+    n_rows, d_fixed, n_entities, d_user, seed=7,
+    fuse_passes="coordinate",
+):
     import jax.numpy as jnp
 
     from photon_ml_tpu.core.tasks import TaskType
@@ -456,8 +501,9 @@ def _build_game_cd(n_rows, d_fixed, n_entities, d_user, seed=7):
         # at this scale the one-dispatch-per-pass program exceeds the
         # session's remote-compile request limits (broken pipe ~25 min
         # in); the chunked per-coordinate mode keeps 2 dispatches/pass
-        # with the rescore + objective fused into each (VERDICT r4 #4)
-        fuse_passes="coordinate",
+        # with the rescore + objective fused into each (VERDICT r4 #4).
+        # bench_game_superpass overrides to True at a compact shape.
+        fuse_passes=fuse_passes,
     )
 
     def heldout_auc(model) -> float:
@@ -549,6 +595,76 @@ def bench_game(print_json=False):
         "auc": auc,
         "convergence_median_iters": conv["median_iters"],
         "convergence_nonconverged_frac": conv["nonconverged_frac"],
+    }
+    if print_json:
+        print(json.dumps(out))
+    return out
+
+
+# Compact fused-mode shape for the multi-pass dispatch-economy probe:
+# big enough that a pass does real work, small enough that the fused
+# whole-pass program compiles everywhere the bench runs.
+GAME_SUPER_SHAPE = dict(
+    n_rows=100_000, d_fixed=32, n_entities=5_000, d_user=8
+)
+GAME_SUPER_PASSES, GAME_SUPER_K = 6, 3
+
+
+def bench_game_superpass(print_json=False):
+    """Device-resident multi-pass GAME descent (ROADMAP item 1): P
+    coordinate-descent passes at K passes per dispatch must execute as
+    ceil(P/K) XLA dispatches — counted, not inferred from wall clocks
+    (sentinel-tracked lower-is-better ``game_dispatches_per_run``)."""
+    import jax
+
+    from photon_ml_tpu.game.descent import GameModel
+    from photon_ml_tpu.obs.dispatch_count import count_dispatches
+
+    cd, _ = _build_game_cd(**GAME_SUPER_SHAPE, fuse_passes=True)
+
+    def perturbed(eps):
+        return GameModel(
+            params={
+                name: jax.tree_util.tree_map(
+                    lambda a: a + eps, c.initial_params()
+                )
+                for name, c in cd.coordinates.items()
+            }
+        )
+
+    t0 = time.perf_counter()
+    cd.run(
+        num_iterations=GAME_SUPER_K,
+        passes_per_dispatch=GAME_SUPER_K,
+        initial_model=perturbed(1e-3),
+    )
+    log(f"superpass warmup (compile+run): {time.perf_counter() - t0:.2f}s")
+    t0 = time.perf_counter()
+    model, history = cd.run(
+        num_iterations=GAME_SUPER_PASSES,
+        passes_per_dispatch=GAME_SUPER_K,
+    )
+    wall = time.perf_counter() - t0
+    # counted run from a perturbed start so the runtime cannot replay
+    # bit-identical dispatches (_warm_disjoint rationale)
+    with count_dispatches() as dc:
+        cd.run(
+            num_iterations=GAME_SUPER_PASSES,
+            passes_per_dispatch=GAME_SUPER_K,
+            initial_model=perturbed(2e-3),
+        )
+    dispatches = float(dc.for_program("superpass"))
+    iters_per_s = GAME_SUPER_PASSES / wall
+    log(
+        f"GAME superpass: {GAME_SUPER_PASSES} passes @ K={GAME_SUPER_K} "
+        f"in {wall:.2f}s ({iters_per_s:.3f} iters/s, "
+        f"{dispatches:.0f} dispatches) objective="
+        f"{float(history[-1].objective):.5f}"
+    )
+    out = {
+        "game_dispatches_per_run": dispatches,
+        "superpass_iters_per_s": iters_per_s,
+        "objective": float(history[-1].objective),
     }
     if print_json:
         print(json.dumps(out))
@@ -1576,6 +1692,7 @@ def main():
     log(f"tunnel RTT: {rtt}")
     glm = _phase("glm_dense", bench_glm_dense)
     game = _phase("game", bench_game)
+    game_super = _phase("game_superpass", bench_game_superpass)
     game_cpu = _phase("game_cpu_baseline", _game_cpu_baseline)
     game_multi = _phase("game_multi", bench_game_multi_re)
     game_multi_cpu = _phase(
@@ -1637,6 +1754,17 @@ def main():
         ),
         "game_cd_iters_per_s": round(game["iters_per_s"], 3),
         "game_heldout_auc": round(game["auc"], 4),
+        # dispatch economy (ROADMAP item 1, sentinel lower-is-better):
+        # counted XLA dispatches per N-lambda GLM path / per multi-pass
+        # GAME run, plus the path's amortized per-lambda wall
+        "dispatches_per_path": glm["dispatches_per_path"],
+        "path_wall_per_lambda_s": round(
+            glm["path_wall_per_lambda_s"], 4
+        ),
+        "game_dispatches_per_run": game_super["game_dispatches_per_run"],
+        "game_superpass_iters_per_s": round(
+            game_super["superpass_iters_per_s"], 3
+        ),
         # convergence health of the flagship GAME run (sentinel-tracked,
         # lower-is-better: obs.sentinel's convergence.* direction rules)
         "convergence": {

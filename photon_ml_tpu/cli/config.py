@@ -110,6 +110,11 @@ class GLMDriverParams:
     # --trace-dir (the decode syncs; pipelined solves pay nothing when
     # off)
     convergence_report: bool = False
+    # regularization-path execution: "scan" (default) runs the whole
+    # descending-lambda warm-started path as ONE device-resident XLA
+    # dispatch (models/training._build_path_solver); "loop" keeps the
+    # reference-shaped host loop of one dispatch per lambda
+    path_mode: str = "scan"
 
     def validate(self) -> None:
         if not self.train_input:
@@ -184,6 +189,7 @@ class GLMDriverParams:
             tolerance=self.tolerance,
             compute_variances=self.compute_variances,
             track_models=self.validate_per_iteration,
+            path_mode=self.path_mode,
             # set by the driver once the vocabulary exists
             intercept_index=None,
         )
@@ -300,6 +306,18 @@ class GameDriverParams:
     # <output_dir>/convergence-report.json — works with or without
     # --trace-dir
     convergence_report: bool = False
+    # device-resident multi-pass descent (K): with the fused whole-pass
+    # mode, run up to K coordinate-descent passes per XLA dispatch
+    # (game/descent.CoordinateDescent._superpass_fn) — a run of P passes
+    # costs ceil(P/K) dispatches. Checkpoint / preemption / divergence-
+    # guard semantics hold at dispatch boundaries: K is the checkpoint
+    # granularity (the chunk shrinks to land on checkpoint_every).
+    passes_per_dispatch: int = 1
+    # in-program objective-tolerance early exit for K > 1: stop when the
+    # training objective moves less than tol * |objective at dispatch
+    # entry| between consecutive passes. 0 disables (every requested
+    # pass runs — the reference behavior).
+    convergence_tolerance: float = 0.0
 
     def validate(self) -> None:
         if not self.train_input:
@@ -378,6 +396,16 @@ class GameDriverParams:
                 "resume=True requires checkpoint_every > 0; without "
                 "checkpoints a resumed run would silently retrain from "
                 "scratch over the existing output directory"
+            )
+        if self.passes_per_dispatch < 1:
+            raise ValueError(
+                f"passes_per_dispatch must be >= 1, got "
+                f"{self.passes_per_dispatch}"
+            )
+        if self.convergence_tolerance < 0:
+            raise ValueError(
+                f"convergence_tolerance must be >= 0, got "
+                f"{self.convergence_tolerance}"
             )
 
     def grid(self) -> List[Dict[str, float]]:

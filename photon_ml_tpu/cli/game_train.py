@@ -880,6 +880,11 @@ def _run_game_training(
                 # polled at pass boundaries: SIGTERM/SIGINT finishes the
                 # pass, checkpoints, and falls through to the break below
                 stop_check=shutdown,
+                # device-resident multi-pass descent: K passes per
+                # dispatch with in-program convergence/guard detection
+                # (checkpoints + preemption land on dispatch boundaries)
+                passes_per_dispatch=params.passes_per_dispatch,
+                convergence_tolerance=params.convergence_tolerance,
             )
             frozen_events = [
                 h for h in history if getattr(h, "event", None) == "frozen"
@@ -1091,6 +1096,17 @@ def main(argv=None) -> None:
         "fleet convergence summaries every pass (convergence.* metrics "
         "+ events) and <output-dir>/convergence-report.json",
     )
+    p.add_argument(
+        "--passes-per-dispatch", type=int, default=None,
+        help="device-resident multi-pass descent: run up to K "
+        "coordinate-descent passes per XLA dispatch (ceil(P/K) "
+        "dispatches for P passes; K caps the checkpoint granularity)",
+    )
+    p.add_argument(
+        "--convergence-tolerance", type=float, default=None,
+        help="with K > 1: in-program objective-tolerance early exit "
+        "between passes (0 disables)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1118,6 +1134,10 @@ def main(argv=None) -> None:
         base["flight_dir"] = args.flight_dir
     if args.convergence_report is not None:
         base["convergence_report"] = args.convergence_report
+    if args.passes_per_dispatch is not None:
+        base["passes_per_dispatch"] = args.passes_per_dispatch
+    if args.convergence_tolerance is not None:
+        base["convergence_tolerance"] = args.convergence_tolerance
     run_game_training(base)
 
 

@@ -577,6 +577,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "plateau / per-iteration curves) into convergence.* metrics + "
         "events and <output-dir>/convergence-report.json",
     )
+    p.add_argument(
+        "--path-mode", choices=("scan", "loop"), default=None,
+        help="regularization-path execution: 'scan' (default) runs the "
+        "whole descending-lambda path as ONE device-resident dispatch; "
+        "'loop' keeps the host loop of one dispatch per lambda",
+    )
     return p
 
 

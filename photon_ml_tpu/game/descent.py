@@ -18,6 +18,7 @@ from typing import Dict, List, Mapping, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from photon_ml_tpu import obs
 from photon_ml_tpu.core.tasks import TaskType
@@ -157,6 +158,47 @@ def _normalize_fuse_passes(fp):
     )
 
 
+def _pass_body(live, names, loss_fn, labels, base_offsets, weights,
+               params, scores, key):
+    """ONE full coordinate-descent pass, trace-safe: every coordinate's
+    update_step + rescore + post-update training objective, in sequence.
+    THE pass definition shared by the single-pass fused program
+    (:meth:`CoordinateDescent._fused_pass_fn`) and the multi-pass
+    superpass program (:meth:`CoordinateDescent._superpass_fn`), so the
+    two dispatch granularities cannot drift numerically — including the
+    PRNG stream (one split per coordinate, in update order)."""
+
+    def reg_term(name, p):
+        return _coordinate_reg_term(live[name], p)
+
+    objs = []
+    trackers = []
+    for name in names:
+        total = sum(scores.values())
+        partial = total - scores[name]
+        key, sub = jax.random.split(key)
+        p, tr, s = live[name].update_step(params[name], partial, sub)
+        params = {**params, name: p}
+        scores = {**scores, name: s}
+        reg = sum(reg_term(n, params[n]) for n in names)
+        tot = sum(scores[n] for n in names)
+        objs.append(loss_fn(labels, base_offsets + tot, weights) + reg)
+        trackers.append(tr)
+    return params, scores, key, tuple(objs), tuple(trackers)
+
+
+def _tree_finite(tree) -> jax.Array:
+    """Scalar bool: every inexact leaf of ``tree`` is finite — the
+    divergence-guard *detection* predicate evaluated in-program (the
+    non-finite-UPDATE half; the non-finite-OBJECTIVE half is a direct
+    isfinite on the pass objectives)."""
+    fin = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            fin = fin & jnp.all(jnp.isfinite(leaf))
+    return fin
+
+
 def _loss_fn_for_task(task: TaskType):
     if task == TaskType.LOGISTIC_REGRESSION:
         return metrics_mod.total_logistic_loss
@@ -261,28 +303,10 @@ class CoordinateDescent:
                 live = {
                     n: coords[n].with_fused_state(states[n]) for n in names
                 }
-
-                def reg_term(name, p):
-                    return _coordinate_reg_term(live[name], p)
-
-                objs = []
-                trackers = []
-                for name in names:
-                    total = sum(scores.values())
-                    partial = total - scores[name]
-                    key, sub = jax.random.split(key)
-                    p, tr, s = live[name].update_step(
-                        params[name], partial, sub
-                    )
-                    params = {**params, name: p}
-                    scores = {**scores, name: s}
-                    reg = sum(reg_term(n, params[n]) for n in names)
-                    tot = sum(scores[n] for n in names)
-                    objs.append(
-                        loss_fn(labels, base_offsets + tot, weights) + reg
-                    )
-                    trackers.append(tr)
-                return params, scores, key, tuple(objs), tuple(trackers)
+                return _pass_body(
+                    live, names, loss_fn, labels, base_offsets, weights,
+                    params, scores, key,
+                )
 
             self._fused_pass = jax.jit(one_pass)
         f = self._fused_pass
@@ -302,6 +326,151 @@ class CoordinateDescent:
             )
 
         return call
+
+    def _superpass_fn(self, k: int):
+        """ONE jitted dispatch running up to ``k`` FULL coordinate-descent
+        passes — the multi-pass extension of :meth:`_fused_pass_fn` (same
+        ``_pass_body``, same PRNG stream, same state-threading contract).
+        A bounded ``lax.while_loop`` carries (params, scores, key) across
+        passes entirely in HBM and early-exits ON DEVICE when either
+
+        - the objective-tolerance convergence check fires: the last
+          coordinate's post-update objective moved <= tol * |objective at
+          dispatch entry| relative to the previous pass (tol is a traced
+          scalar; 0 disables), or
+        - the divergence-guard DETECTION predicate fires: a non-finite
+          pass objective or non-finite updated parameters. The failing
+          pass is NOT committed — the returned (params, scores, key) are
+          the last good state, so the host can replay exactly that pass
+          through the per-update guarded loop (rollback / damped retry /
+          freeze stay host-side policy, at dispatch granularity).
+
+        Per-pass objectives and coordinate trackers write into fixed-size
+        (k, ...) tape buffers (the PR-7 carry-tape idiom) whose entries
+        past ``passes_done`` are garbage the host masks. Returns
+        ``(params, scores, key, passes_done, guard, converged, objs_tape,
+        tracker_tapes)``."""
+        cache = getattr(self, "_superpass_progs", None)
+        if cache is None:
+            cache = self._superpass_progs = {}
+        if k not in cache:
+            names = list(self.coordinates)
+            coords = self.coordinates
+            loss_fn = _loss_fn_for_task(self.task)
+
+            def superpass(states, labels, base_offsets, weights, params,
+                          scores, key, tol, guard_on):
+                live = {
+                    n: coords[n].with_fused_state(states[n]) for n in names
+                }
+
+                def one_pass(params, scores, key):
+                    return _pass_body(
+                        live, names, loss_fn, labels, base_offsets,
+                        weights, params, scores, key,
+                    )
+
+                # objective at dispatch entry: the convergence reference
+                # scale, and the "previous objective" for the chunk's
+                # first pass (cross-dispatch continuity: this equals the
+                # previous chunk's final objective by construction)
+                reg0 = sum(
+                    _coordinate_reg_term(live[n], params[n]) for n in names
+                )
+                tot0 = sum(scores[n] for n in names)
+                obj_in = loss_fn(labels, base_offsets + tot0, weights) + reg0
+
+                out_sh = jax.eval_shape(one_pass, params, scores, key)
+                obj_dtype = out_sh[3][0].dtype
+                objs_tape0 = jnp.full((k, len(names)), jnp.nan, obj_dtype)
+                tr_tapes0 = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros((k,) + s.shape, s.dtype), out_sh[4]
+                )
+                obj_in = obj_in.astype(obj_dtype)
+                tol_c = jnp.asarray(tol, obj_dtype)
+
+                def cond(carry):
+                    i, stop = carry[0], carry[1]
+                    return (i < k) & ~stop
+
+                def body(carry):
+                    (i, _stop, guard, conv, params, scores, key,
+                     objs_tape, tr_tapes) = carry
+                    p2, s2, k2, objs, trs = one_pass(params, scores, key)
+                    objs_vec = jnp.stack(
+                        [o.astype(obj_dtype) for o in objs]
+                    )
+                    finite = jnp.all(jnp.isfinite(objs_vec))
+                    for n in names:
+                        finite = finite & _tree_finite(p2[n])
+                    # without the guard, non-finite passes COMMIT — the
+                    # unguarded fused loop's semantics (one NaN poisons
+                    # the run), and the host's passes_done == chunk
+                    # assumption (it never reads the flags) stays true
+                    commit = finite | ~guard_on
+                    tripped = guard_on & ~finite
+                    prev = jnp.where(
+                        i == 0,
+                        obj_in,
+                        objs_tape[jnp.maximum(i - 1, 0), -1],
+                    )
+                    cur = objs_vec[-1]
+                    converged = (
+                        commit
+                        & (tol_c > 0)
+                        & (jnp.abs(prev - cur) <= tol_c * jnp.abs(obj_in))
+                    )
+                    objs_tape = objs_tape.at[i].set(
+                        jnp.where(commit, objs_vec, objs_tape[i])
+                    )
+                    tr_tapes = jax.tree_util.tree_map(
+                        lambda buf, v: buf.at[i].set(
+                            jnp.where(commit, v, buf[i])
+                        ),
+                        tr_tapes,
+                        trs,
+                    )
+                    sel = lambda a, b: jnp.where(commit, a, b)
+                    params = jax.tree_util.tree_map(sel, p2, params)
+                    scores = jax.tree_util.tree_map(sel, s2, scores)
+                    key = jnp.where(commit, k2, key)
+                    return (
+                        i + commit.astype(i.dtype),
+                        tripped | converged,
+                        guard | tripped,
+                        conv | converged,
+                        params,
+                        scores,
+                        key,
+                        objs_tape,
+                        tr_tapes,
+                    )
+
+                init = (
+                    jnp.int32(0), jnp.bool_(False), jnp.bool_(False),
+                    jnp.bool_(False), params, scores, key, objs_tape0,
+                    tr_tapes0,
+                )
+                (i, _stop, guard, conv, params, scores, key, objs_tape,
+                 tr_tapes) = lax.while_loop(cond, body, init)
+                return (
+                    params, scores, key, i, guard, conv, objs_tape,
+                    tr_tapes,
+                )
+
+            cache[k] = jax.jit(superpass)
+        states = {
+            n: self.coordinates[n].fused_state()
+            for n in self.coordinates
+        }
+
+        def call(p, s, key, tol, guard_on):
+            return cache[k](
+                states, self.labels, self.base_offsets, self.weights,
+                p, s, key, tol, guard_on,
+            )
+
+        return call, states
 
     def _coordinate_step_fns(self):
         """One jitted dispatch PER COORDINATE: update_step + rescore +
@@ -380,11 +549,38 @@ class CoordinateDescent:
         resume: bool = True,
         divergence_guard: bool = False,
         stop_check=None,
+        passes_per_dispatch: int = 1,
+        convergence_tolerance: float = 0.0,
     ):
         """Returns (model, history). Objective is logged after every
         coordinate update like ``CoordinateDescent.scala:160-170``;
         `validation_fn(model) -> float`, when given, is evaluated after
         every coordinate update too (``CoordinateDescent.scala:173-189``).
+
+        ``passes_per_dispatch`` (K): with ``fuse_passes=True`` and K > 1,
+        up to K coordinate-descent passes run per XLA dispatch through
+        the device-resident superpass program (:meth:`_superpass_fn`) —
+        a run of P passes costs ceil(P/K) dispatches instead of P. K is
+        capped by the checkpoint cadence (``checkpoint_every`` still
+        fires on schedule; the dispatch chunk shrinks to hit each
+        boundary), and checkpoint / preemption / validation semantics
+        hold at DISPATCH boundaries — K is the checkpoint granularity.
+        Identical math to K = 1 (asserted in tests/test_device_loops.py).
+
+        ``convergence_tolerance``: with K > 1, an objective-tolerance
+        convergence check runs IN-PROGRAM after every pass — when the
+        full training objective moves less than tol * |objective at
+        dispatch entry| between consecutive passes, the superpass
+        early-exits on device and the run returns with the passes
+        actually executed. 0 (default) disables the check.
+
+        With ``divergence_guard`` AND K > 1, the guard's *detection*
+        predicate (non-finite pass objective or updated params) also
+        runs in-program: a failing pass is not committed, the superpass
+        returns the last good state plus a guard flag, and the host
+        replays exactly that pass through the per-update guarded loop —
+        so rollback / damped-retry / freeze semantics are bit-identical
+        to a fully host-guarded run while healthy passes stay fused.
 
         With ``checkpoint_dir``, the full training state (parameter tables,
         PRNG key, iteration counter, history) is written atomically every
@@ -643,6 +839,19 @@ class CoordinateDescent:
         use_chunked = (
             mode == "coordinate" and has_surface and not divergence_guard
         )
+        # multi-pass superpass (K passes per dispatch): the fused-mode
+        # surface requirements, but the guard is ALLOWED — its detection
+        # predicate runs in-program and failing passes replay through
+        # the per-update loop (force_plain below). A grown/resumed
+        # frozen set still can't be excluded inside the program, so the
+        # branch re-checks `frozen` every iteration.
+        k_dispatch = max(1, int(passes_per_dispatch))
+        use_super = (
+            k_dispatch > 1
+            and mode is True
+            and validation_fn is None
+            and has_surface
+        )
         from photon_ml_tpu.resilience import faults as _faults
 
         def _save_ckpt(step):
@@ -665,11 +874,192 @@ class CoordinateDescent:
         # (obs.compile_events; idempotent global listener)
         obs.install_compile_listener()
         stopped = False
-        for it in range(start_it, num_iterations):
+        it = start_it
+        # guard replay: when a superpass reports a non-committed pass,
+        # run exactly ONE pass through the per-update loop (which owns
+        # the rollback/damp/freeze policy), then resume superpassing
+        force_plain = False
+        while it < num_iterations:
             tracer = obs.get_tracer()
             pass_t0 = time.perf_counter()
             pass_ts = tracer.now_us() if tracer is not None else 0.0
             pass_recs = []  # cost records of this pass's dispatches
+            if use_super and not frozen and not force_plain:
+                # dispatch chunk: K passes, shrunk to land exactly on
+                # the checkpoint cadence and the run end — checkpoint /
+                # preemption semantics live at dispatch boundaries
+                chunk = min(k_dispatch, num_iterations - it)
+                if checkpoint_dir is not None:
+                    chunk = min(
+                        chunk,
+                        checkpoint_every
+                        - ((it - start_it) % checkpoint_every),
+                    )
+                sp_call, sp_states = self._superpass_fn(chunk)
+                params_in = {n: model.params[n] for n in names}
+                tol_arr = jnp.asarray(float(convergence_tolerance))
+                guard_arr = jnp.asarray(bool(divergence_guard))
+                rec = None
+                if tracer is not None:
+                    rec = self._pass_cost(
+                        f"superpass-{chunk}",
+                        lambda: self._superpass_progs[chunk].lower(
+                            sp_states, self.labels, self.base_offsets,
+                            self.weights, params_in, scores, key,
+                            tol_arr, guard_arr,
+                        ),
+                    )
+                    pass_recs.append(rec)
+                t0 = time.perf_counter()
+                (params_out, scores, key, passes_dev, guard_dev,
+                 conv_dev, objs_tape, tr_tapes) = sp_call(
+                    params_in, scores, key, tol_arr, guard_arr
+                )
+                model.params.update(params_out)
+                seconds = time.perf_counter() - t0
+                if divergence_guard or convergence_tolerance > 0:
+                    # the flags decide host control flow, so reading
+                    # them synchronizes — ONE sync per K passes. With
+                    # neither feature on they are statically known and
+                    # the dispatch chain stays fully pipelined.
+                    passes_done = int(passes_dev)
+                    guard = bool(guard_dev)
+                    converged = bool(conv_dev)
+                else:
+                    passes_done, guard, converged = chunk, False, False
+                for p in range(passes_done):
+                    for ci, name in enumerate(names):
+                        pending.append(
+                            {
+                                "iteration": it + p,
+                                "coordinate": name,
+                                # dispatch wall on the chunk's FIRST
+                                # record only — the dispatch is
+                                # indivisible (fused-mode contract)
+                                "seconds": (
+                                    seconds if p == 0 and ci == 0
+                                    else None
+                                ),
+                                "objective": objs_tape[p, ci],
+                                "validation_metric": None,
+                                "result": self.coordinates[
+                                    name
+                                ].wrap_tracker(
+                                    jax.tree_util.tree_map(
+                                        lambda a, _p=p: a[_p],
+                                        tr_tapes[ci],
+                                    )
+                                ),
+                            }
+                        )
+                if tracer is not None:
+                    sp_args = {
+                        "iteration": it,
+                        "chunk": chunk,
+                        "passes": passes_done,
+                        "coordinates": len(names),
+                        "guard": guard,
+                        "converged": converged,
+                        "timing": "wall",
+                    }
+                    try:
+                        from photon_ml_tpu.kernels import kernel_mode
+
+                        sp_args["sparse_kernel"] = kernel_mode()
+                    except Exception:
+                        pass
+                    if rec is not None and passes_done:
+                        # XLA's cost analysis counts the while body
+                        # ONCE; scale by the passes that actually ran
+                        sp_args.update(
+                            rec.achieved(seconds, passes=passes_done)
+                        )
+                    tracer.add_span(
+                        "game.superpass", pass_ts, seconds * 1e6,
+                        cat="game", args=sp_args,
+                    )
+                    # per-pass / per-coordinate spans share even splits
+                    # of the indivisible window (same contract as the
+                    # fused pass's shared-window coordinate spans)
+                    share = seconds * 1e6 / max(passes_done, 1)
+                    for p in range(passes_done):
+                        tracer.add_span(
+                            "game.pass", pass_ts + p * share, share,
+                            cat="game",
+                            args={
+                                "iteration": it + p,
+                                "coordinates": len(names),
+                                "fused": True,
+                                "superpass": True,
+                                "timing": "wall",
+                            },
+                        )
+                        for name in names:
+                            tracer.add_span(
+                                "game.update", pass_ts + p * share,
+                                share, cat="game",
+                                args={
+                                    "coordinate": name,
+                                    "iteration": it + p,
+                                    "fused": True,
+                                    "superpass": True,
+                                },
+                            )
+                    obs.sample_hbm()
+                it += passes_done
+                _reg = obs.registry()
+                _reg.inc("game.dispatches")
+                _reg.inc("game.superpasses")
+                _reg.inc("game.passes", passes_done)
+                if passes_done:
+                    _reg.observe(
+                        "game.pass_ms", seconds * 1e3 / passes_done
+                    )
+                if guard:
+                    # in-program detection, host-side policy: replay the
+                    # non-committed pass through the per-update loop
+                    # (same PRNG key — the superpass never advanced it
+                    # past the last committed pass, so the replay
+                    # reproduces the exact failing updates)
+                    force_plain = True
+                    obs.emit_event(
+                        "resilience.superpass_guard",
+                        cat="resilience",
+                        iteration=it,
+                        passes_done=passes_done,
+                    )
+                saved = False
+                if (
+                    passes_done
+                    and checkpoint_dir is not None
+                    and (it - start_it) % checkpoint_every == 0
+                ):
+                    _save_ckpt(it)
+                    saved = True
+                if stop_check is not None and stop_check():
+                    stopped = True
+                    if checkpoint_dir is not None:
+                        if not saved:
+                            _save_ckpt(it)
+                        from photon_ml_tpu.resilience.shutdown import (
+                            write_preempted_marker,
+                        )
+
+                        write_preempted_marker(
+                            checkpoint_dir,
+                            it,
+                            getattr(stop_check, "signum", None),
+                        )
+                    break
+                if converged:
+                    obs.emit_event(
+                        "game.converged",
+                        cat="game",
+                        iteration=it,
+                        tolerance=float(convergence_tolerance),
+                    )
+                    break
+                continue
             if use_fused:
                 params_in = {n: model.params[n] for n in names}
                 fused = self._fused_pass_fn()
@@ -915,6 +1305,7 @@ class CoordinateDescent:
                                 "result": result,
                             }
                         )
+            force_plain = False
             pass_seconds = time.perf_counter() - pass_t0
             if tracer is not None:
                 pass_args = {"iteration": it, "coordinates": len(names)}
@@ -989,6 +1380,7 @@ class CoordinateDescent:
                         getattr(stop_check, "signum", None),
                     )
                 break
+            it += 1
         materialize()
         if checkpoint_dir is not None and not stopped:
             # the run reached its target: a stale marker from an earlier

@@ -29,6 +29,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from photon_ml_tpu import obs
 from photon_ml_tpu.core.normalization import (
@@ -57,25 +58,36 @@ _VARIANCE_EPSILON = 1e-12
 
 
 class HashableBounds:
-    """Immutable per-coefficient bound vector with O(1) hashing.
+    """Immutable per-coefficient bound vector with O(1) hashing AND O(1)
+    equality.
 
-    Configs key the lru_cache'd solver builder, so bounds must be
+    Configs key the lru_cache'd solver builders, so bounds must be
     hashable; a plain float tuple would make every cache lookup
     hash/compare d boxed floats — O(d) Python work per solve, which is
     pathological at the feature-sharded huge-d regime where
     ``parallel/distributed.py`` blocks the bounds out to d_block slots.
-    The hash is a content digest computed once at construction; equality
-    is a C-speed memcmp."""
+    The content is digested ONCE at construction into a 16-byte
+    ``bytes`` key (shape + blake2b of the raw buffer); hashing hashes
+    the digest and HashableBounds-vs-HashableBounds equality compares
+    digests only, so every ``_build_solver`` lookup on a config carrying
+    bounds costs O(1) regardless of d (a blake2b collision is
+    cryptographically negligible next to lru_cache's false-hit cost)."""
 
-    __slots__ = ("values", "_hash")
+    __slots__ = ("values", "digest", "_hash")
 
     def __init__(self, values):
+        import hashlib
+
         import numpy as np
 
         arr = np.ascontiguousarray(np.asarray(values, dtype=float))
         arr.setflags(write=False)
         self.values = arr
-        self._hash = hash((arr.shape, arr.tobytes()))
+        self.digest = (
+            repr(arr.shape).encode()
+            + hashlib.blake2b(arr.tobytes(), digest_size=16).digest()
+        )
+        self._hash = hash(self.digest)
 
     def __hash__(self):
         return self._hash
@@ -84,9 +96,7 @@ class HashableBounds:
         import numpy as np
 
         if isinstance(other, HashableBounds):
-            return self._hash == other._hash and np.array_equal(
-                self.values, other.values
-            )
+            return self.digest == other.digest
         if other is None:
             return False
         try:
@@ -146,6 +156,14 @@ class GLMTrainingConfig:
     # per-iteration coefficient snapshots (ModelTracker,
     # ``supervised/model/ModelTracker.scala``) — feeds validate-per-iteration
     track_models: bool = False
+    # regularization-path execution mode: "scan" runs the WHOLE
+    # descending-lambda path as ONE jitted ``lax.scan`` program (one
+    # dispatch + one decode for N lambdas — the device-resident rebuild
+    # of ``ModelTraining.scala:32-141``); "loop" keeps the host loop of
+    # one dispatch per lambda (the reference shape, kept as the
+    # equivalence oracle and an escape hatch for toolchains that cannot
+    # compile the scanned program)
+    path_mode: str = "scan"
 
     def __post_init__(self):
         import numpy as np
@@ -167,6 +185,10 @@ class GLMTrainingConfig:
     def validate(self) -> None:
         """The reference's cross-flag validation matrix
         (``Params.scala:156-173``, ``OptimizationProblem.scala:155-161``)."""
+        if self.path_mode not in ("scan", "loop"):
+            raise ValueError(
+                f"path_mode must be 'scan' or 'loop', got {self.path_mode!r}"
+            )
         has_l1 = self.regularization.reg_type in ("L1", "ELASTIC_NET")
         if self.optimizer == OptimizerType.TRON and has_l1:
             raise ValueError(
@@ -249,8 +271,11 @@ def _build_solver(config: GLMTrainingConfig):
     )
 
 
-@lru_cache(maxsize=64)
-def _build_solver_cached(config: GLMTrainingConfig):
+def _solver_step_fn(config: GLMTrainingConfig):
+    """Trace-safe ``solve(w0, reg_weight, batch, norm) -> SolverResult``
+    closure — the ONE per-lambda solve body shared by the per-lambda jit
+    (``path_mode="loop"``) and the scanned whole-path program
+    (``path_mode="scan"``), so the two modes cannot drift."""
     loss = loss_for_task(config.task)
     reg = config.regularization
     scfg = config.solver_config()
@@ -258,7 +283,6 @@ def _build_solver_cached(config: GLMTrainingConfig):
     use_tron = config.optimizer == OptimizerType.TRON
     use_newton = config.optimizer == OptimizerType.NEWTON
 
-    @jax.jit
     def solve(w0, reg_weight, batch: LabeledBatch, norm: NormalizationContext):
         l1 = reg_weight * reg.l1_weight(1.0)
         l2 = reg_weight * reg.l2_weight(1.0)
@@ -278,7 +302,14 @@ def _build_solver_cached(config: GLMTrainingConfig):
             return minimize_newton(vg, hess, w0, scfg)
         return minimize_lbfgs(vg, w0, scfg)
 
-    @jax.jit
+    return solve
+
+
+def _variances_fn(config: GLMTrainingConfig):
+    """Trace-safe per-coefficient variance estimate (1 / Hessian diag)."""
+    loss = loss_for_task(config.task)
+    reg = config.regularization
+
     def variances(
         w, reg_weight, batch: LabeledBatch, norm: NormalizationContext
     ):
@@ -287,7 +318,80 @@ def _build_solver_cached(config: GLMTrainingConfig):
         diag = obj.hessian_diagonal(w, batch)
         return 1.0 / jnp.maximum(diag, _VARIANCE_EPSILON)
 
-    return solve, variances
+    return variances
+
+
+@lru_cache(maxsize=64)
+def _build_solver_cached(config: GLMTrainingConfig):
+    return (
+        jax.jit(_solver_step_fn(config)),
+        jax.jit(_variances_fn(config)),
+    )
+
+
+def _build_path_solver(config: GLMTrainingConfig):
+    """jitted ``solve_path(w0, reg_weights, batch, norm)`` running the
+    WHOLE descending-lambda regularization path as ONE XLA program: a
+    ``lax.scan`` over the lambda vector whose carry is the warm-start
+    coefficients (exactly the host loop's warm-start chaining,
+    ``GeneralizedLinearAlgorithm.scala:226-235``) and whose stacked ys
+    carry, per lambda: the full SolverResult (PR-7 convergence tapes
+    included — they ride the scan axis), the de-normalized raw-space
+    coefficient means, variances when ``compute_variances``, and
+    de-normalized ModelTracker snapshots when ``track_models``. The host
+    dispatches ONCE per path and decodes afterwards; the carry is
+    donated (off-CPU) so the warm start runs copy-free in HBM. Same
+    cache-key convention as ``_build_solver``: reg weights are traced
+    call arguments, so configs differing only in lambdas share one
+    compilation (a new PATH LENGTH is a new input shape — one XLA
+    compile per length, no Python re-trace)."""
+    return _build_path_solver_cached(
+        dataclasses.replace(config, reg_weights=(0.0,))
+    )
+
+
+@lru_cache(maxsize=64)
+def _build_path_solver_cached(config: GLMTrainingConfig):
+    solve_one = _solver_step_fn(config)
+    variances = _variances_fn(config)
+    compute_variances = config.compute_variances
+    track_models = config.track_models
+    intercept_index = config.intercept_index
+
+    def solve_path(
+        w0, reg_weights, batch: LabeledBatch, norm: NormalizationContext
+    ):
+        def step(w, lam):
+            result = solve_one(w, lam, batch, norm)
+            coef = Coefficients(
+                means=result.w,
+                variances=(
+                    variances(result.w, lam, batch, norm)
+                    if compute_variances
+                    else None
+                ),
+            )
+            raw = norm.transform_model_coefficients(coef, intercept_index)
+            ys = {"result": result, "means": raw.means}
+            if raw.variances is not None:
+                ys["variances"] = raw.variances
+            if track_models and result.w_history is not None:
+                # de-normalize the per-iteration snapshots in-program
+                # (the host loop vmaps the same transform per lambda)
+                ys["w_history_raw"] = jax.vmap(
+                    lambda m: norm.transform_model_coefficients(
+                        Coefficients(means=m), intercept_index
+                    ).means
+                )(result.w_history)
+            return result.w, ys
+
+        _, ys = lax.scan(step, w0, reg_weights)
+        return ys
+
+    # donating the warm-start carry keeps the path copy-free in HBM;
+    # CPU backends ignore donation with a warning, so skip it there
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(solve_path, donate_argnums=donate)
 
 
 def _record_solve_metrics(config: GLMTrainingConfig, result) -> None:
@@ -407,6 +511,13 @@ def train_glm(
     `initial_coefficients` are likewise expected in RAW space (e.g. a
     previously returned model) and are mapped into normalized space before
     solving.
+
+    With ``path_mode="scan"`` (default) the whole path — every solve,
+    warm-start chaining, de-normalization, variances — executes as ONE
+    XLA dispatch (``_build_path_solver``); ``path_mode="loop"`` keeps
+    the reference-shaped host loop of one dispatch per lambda. Both
+    modes are numerically equivalent to <= 1e-10 (asserted in
+    tests/test_device_loops.py) and share the per-lambda solve body.
     """
     config.validate()
     norm = (
@@ -414,17 +525,153 @@ def train_glm(
         if normalization is not None
         else prepare_normalization(config, batch)
     )
-    solve, variances_fn = _build_solver(config)
-
     d = batch.num_features
     dtype = solve_dtype(batch)
     if initial_coefficients is not None:
         w = norm.inverse_transform_model_coefficients(
             initial_coefficients, config.intercept_index
         ).means
+        w = jnp.asarray(w, dtype)
+        if config.path_mode == "scan":
+            # the path program donates its carry argument; hand it a
+            # fresh buffer so the caller's warm-start model (which, with
+            # identity normalization, w aliases) is never invalidated
+            w = w + jnp.zeros((), dtype)
     else:
         w = jnp.zeros((d,), dtype)
 
+    if config.path_mode == "scan":
+        return _train_glm_scan(batch, config, norm, w)
+    return _train_glm_loop(batch, config, norm, w)
+
+
+def _train_glm_scan(
+    batch: LabeledBatch,
+    config: GLMTrainingConfig,
+    norm: NormalizationContext,
+    w: jax.Array,
+) -> Sequence[TrainedModel]:
+    """Single-dispatch regularization path: one ``lax.scan`` program over
+    the descending lambda vector, decoded on the host afterwards. The
+    untraced path inserts NO host syncs — results are lazy slices of the
+    stacked ys, so consecutive train_glm calls still pipeline (bench.py
+    depends on that); the traced/convergence-enabled path synchronizes
+    once and retro-emits per-lambda ``glm.solve`` spans + tape counters
+    inside the one ``glm.solve_path`` span window."""
+    dtype = solve_dtype(batch)
+    lams = sorted(config.reg_weights, reverse=True)
+    solve_path = _build_path_solver(config)
+    with obs.span(
+        "glm.solve_path",
+        cat="solver",
+        optimizer=config.optimizer.name,
+        path_len=len(lams),
+        dispatches=1,
+    ) as sp:
+        tracer = obs.get_tracer()
+        ts0 = tracer.now_us() if tracer is not None else 0.0
+        t0 = time.perf_counter()
+        ys = solve_path(w, jnp.asarray(lams, dtype), batch, norm)
+        conv_enabled = (
+            tracer is not None or obs.convergence.tracking_enabled()
+        )
+        results = None
+        if conv_enabled:
+            # one sync for the whole path, then the per-element decode:
+            # solver metrics, convergence reports/events, and — under a
+            # tracer — retro-stamped per-lambda glm.solve spans whose
+            # windows split the path wall proportionally to each solve's
+            # counted design passes (the honest attribution available
+            # for an indivisible dispatch), each carrying its own cost
+            # annotation and (value, |grad|) counter replay
+            sp.sync(ys["means"])
+            seconds = time.perf_counter() - t0
+            from photon_ml_tpu.solvers.common import (
+                design_passes,
+                index_result,
+            )
+
+            results = [
+                index_result(ys["result"], i) for i in range(len(lams))
+            ]
+            passes = [design_passes(r) for r in results]
+            total_passes = sum(passes) or 1.0
+            rec = _objective_pass_cost(config, batch, norm)
+            obs.annotate_span(
+                sp, rec, seconds=seconds, passes=total_passes
+            )
+            offset_us = ts0
+            for i, (lam, result) in enumerate(zip(lams, results)):
+                _record_solve_metrics(config, result)
+                report = obs.decode_result(
+                    result, optimizer=config.optimizer.name.lower()
+                )
+                obs.convergence.note_solve(
+                    report, label=f"lambda={float(lam):g}"
+                )
+                if tracer is not None:
+                    share_s = seconds * passes[i] / total_passes
+                    span_args = {
+                        "optimizer": config.optimizer.name,
+                        "reg_weight": float(lam),
+                        "path": True,
+                        "convergence_reason": report.reason,
+                        "convergence_order": report.order,
+                    }
+                    if rec is not None and share_s > 0:
+                        span_args.update(
+                            rec.achieved(share_s, passes=passes[i])
+                        )
+                    tracer.add_span(
+                        "glm.solve",
+                        offset_us,
+                        share_s * 1e6,
+                        cat="solver",
+                        args=span_args,
+                    )
+                    obs.convergence.emit_tape_counters(
+                        report, tracer, offset_us, share_s * 1e6
+                    )
+                    offset_us += share_s * 1e6
+
+    # decode: lazy per-lambda slices of the stacked ys (each slice is an
+    # async device op, not a sync — the pipelined-solve contract)
+    if results is None:
+        from photon_ml_tpu.solvers.common import index_result
+
+        results = [
+            index_result(ys["result"], i) for i in range(len(lams))
+        ]
+    by_lambda = {}
+    for i, lam in enumerate(lams):
+        result = results[i]
+        if config.track_models and "w_history_raw" in ys:
+            result = dataclasses.replace(
+                result, w_history=ys["w_history_raw"][i]
+            )
+        coef = Coefficients(
+            means=ys["means"][i],
+            variances=(
+                ys["variances"][i] if "variances" in ys else None
+            ),
+        )
+        model = GeneralizedLinearModel(coefficients=coef, task=config.task)
+        by_lambda[lam] = TrainedModel(
+            reg_weight=lam, model=model, result=result
+        )
+    return [by_lambda[lam] for lam in config.reg_weights]
+
+
+def _train_glm_loop(
+    batch: LabeledBatch,
+    config: GLMTrainingConfig,
+    norm: NormalizationContext,
+    w: jax.Array,
+) -> Sequence[TrainedModel]:
+    """The reference-shaped host loop (one jit dispatch per lambda) —
+    ``path_mode="loop"``, kept as the scan path's equivalence oracle."""
+    solve, variances_fn = _build_solver(config)
+    dtype = solve_dtype(batch)
     by_lambda = {}
     for lam in sorted(config.reg_weights, reverse=True):
         with obs.span(

@@ -52,6 +52,10 @@ from photon_ml_tpu.obs.compile_events import (
     install_compile_listener,
     xla_compile_events,
 )
+from photon_ml_tpu.obs.dispatch_count import (
+    DispatchCounts,
+    count_dispatches,
+)
 from photon_ml_tpu.obs.device import (
     HbmSampler,
     HbmWatermark,
@@ -163,6 +167,9 @@ __all__ = [
     "fleet_summary",
     "install_convergence_tracker",
     "uninstall_convergence_tracker",
+    # executable-dispatch counting (obs.dispatch_count)
+    "DispatchCounts",
+    "count_dispatches",
 ]
 
 

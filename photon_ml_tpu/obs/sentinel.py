@@ -93,6 +93,16 @@ _DIRECTION_RULES = (
         re.compile(r"(^|\.)convergence\.nonconverged_frac$"),
         LOWER_IS_BETTER,
     ),
+    # dispatch economy (ROADMAP item 1, device-resident loops): host
+    # round trips per training unit — a creeping dispatch count is the
+    # latency regression wall clocks on a timeshared bench host cannot
+    # see, so it gates directly and tunnel-invariantly
+    (
+        re.compile(r"(^|\.)dispatches_per_(path|run|solve)$"),
+        LOWER_IS_BETTER,
+    ),
+    (re.compile(r"(^|\.)game_dispatches_per_run$"), LOWER_IS_BETTER),
+    (re.compile(r"(^|\.)dispatches$"), LOWER_IS_BETTER),
     (re.compile(r"(_s|_ms|_mb|_kb|_m)$"), LOWER_IS_BETTER),
     (re.compile(r"(^|\.)passes$"), LOWER_IS_BETTER),
     (re.compile(r"^value$"), LOWER_IS_BETTER),

@@ -16,6 +16,7 @@ from photon_ml_tpu.solvers.common import (
     SolverConfig,
     SolverResult,
     design_passes,
+    index_result,
     final_grad_norm,
     mask_tape,
     project_to_hypercube,
@@ -29,6 +30,7 @@ __all__ = [
     "SolverConfig",
     "SolverResult",
     "design_passes",
+    "index_result",
     "final_grad_norm",
     "mask_tape",
     "project_to_hypercube",

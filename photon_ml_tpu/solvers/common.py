@@ -158,6 +158,16 @@ def mask_tape(tape, iterations, axis: int = -1) -> np.ndarray:
     return np.where(idx <= lim, arr, np.nan)
 
 
+def index_result(result: "SolverResult", i) -> "SolverResult":
+    """Element ``i`` of a STACKED SolverResult — the decode reader for
+    results whose leaves carry a leading batch/path axis (a vmapped
+    per-entity solve, or one lambda of ``train_glm``'s scanned
+    regularization path, where every leaf — tapes included — is stacked
+    along the scan axis). A lazy tree of device slices: no host sync, so
+    decoding a pipelined path stays async until something materializes."""
+    return jax.tree_util.tree_map(lambda a: a[i], result)
+
+
 def final_grad_norm(result: "SolverResult") -> jax.Array:
     """||grad|| at the solve's LAST written tracker slot — valid with
     tracking on (gather at ``iterations``) or off (the one slot holds

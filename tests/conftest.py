@@ -33,3 +33,21 @@ def devices():
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture
+def dispatch_counter():
+    """THE dispatch-count assertion helper (like the serving suite's
+    zero-recompile drill, but for executions): wraps executable-call
+    counting (``obs.dispatch_count``) so tests can prove one-dispatch
+    guarantees::
+
+        with dispatch_counter() as dc:
+            train_glm(batch, cfg)          # N-lambda path
+        dc.assert_program("solve_path", 1)
+
+    Counting never forces a recompile — the zero-recompile invariants
+    stay assertable inside a counted block."""
+    from photon_ml_tpu.obs.dispatch_count import count_dispatches
+
+    return count_dispatches

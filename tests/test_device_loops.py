@@ -1,0 +1,594 @@
+"""Device-resident training loops (ROADMAP item 1).
+
+Two host loops became single-dispatch programs in this layer:
+
+- the GLM regularization path: ``train_glm``'s host loop over
+  descending lambdas is a ``lax.scan`` inside ONE jitted program
+  (``models/training._build_path_solver``) — N lambdas, 1 dispatch;
+- multi-pass GAME descent: ``CoordinateDescent.run(...,
+  passes_per_dispatch=K)`` runs K coordinate passes per dispatch with
+  the objective-tolerance convergence check and the divergence-guard
+  DETECTION predicate evaluated in-program.
+
+The drills here prove (a) the dispatch counts — with the reusable
+``dispatch_counter`` fixture wrapping executable-call counting — and
+(b) bit-level (<= 1e-10) equivalence against the host-loop oracles,
+including warm-start order, PR-7 convergence tapes, the divergence
+guard's host-side rollback/damp/freeze policy, and checkpoint /
+preemption round-trips at dispatch boundaries.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.core.types import LabeledBatch
+from photon_ml_tpu.models.training import (
+    GLMTrainingConfig,
+    OptimizerType,
+    train_glm,
+)
+from photon_ml_tpu.ops.objective import RegularizationContext
+from photon_ml_tpu.solvers.common import SolverResult, mask_tape
+
+from test_game import build_game, make_mixed_effects_data
+
+
+def _logistic_batch(rng, n=400, d=6):
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-x @ w_true))).astype(float)
+    return LabeledBatch.create(x, y, dtype=jnp.float64)
+
+
+def _cfg(optimizer, reg_type, lams, path_mode="scan", **kw):
+    return GLMTrainingConfig(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=optimizer,
+        regularization=RegularizationContext(reg_type, alpha=0.5),
+        reg_weights=tuple(lams),
+        max_iters=30,
+        tolerance=1e-9,
+        path_mode=path_mode,
+        **kw,
+    )
+
+
+class TestDispatchCounter:
+    """The counting harness itself (tests/conftest.py fixture over
+    obs.dispatch_count)."""
+
+    def test_counts_repeat_calls_per_program(self, dispatch_counter):
+        def poly(x):
+            return x * 2.0 + 1.0
+
+        f = jax.jit(poly)
+        x = jnp.ones((8,))
+        f(x).block_until_ready()  # compile outside the window
+        with dispatch_counter() as dc:
+            for _ in range(3):
+                f(x).block_until_ready()
+        assert dc.for_program("poly") == 3
+        dc.assert_program("poly", 3)
+        with pytest.raises(AssertionError, match="expected 7"):
+            dc.assert_program("poly", 7)
+
+    def test_counting_does_not_recompile(self, dispatch_counter):
+        obs.install_compile_listener()
+
+        def cube(x):
+            return x * x * x
+
+        g = jax.jit(cube)
+        x = jnp.arange(4.0)
+        g(x).block_until_ready()
+        before = obs.xla_compile_events()
+        with dispatch_counter() as dc:
+            g(x).block_until_ready()
+        assert obs.xla_compile_events() == before
+        assert dc.for_program("cube") == 1
+
+
+class TestSingleDispatchRegularizationPath:
+    def test_path_is_one_dispatch(self, rng, dispatch_counter):
+        batch = _logistic_batch(rng)
+        cfg = _cfg(OptimizerType.TRON, "L2", (5.0, 0.5, 0.05))
+        (_, _, warm) = train_glm(batch, cfg)  # compile + warm
+        np.asarray(warm.model.coefficients.means)
+        with dispatch_counter() as dc:
+            tms = train_glm(batch, cfg)
+            for tm in tms:
+                np.asarray(tm.model.coefficients.means)
+        dc.assert_program("solve_path", 1)
+        # the host-loop oracle pays one dispatch per lambda
+        loop_cfg = dataclasses.replace(cfg, path_mode="loop")
+        train_glm(batch, loop_cfg)  # warm the per-lambda program
+        with dispatch_counter() as dc:
+            train_glm(batch, loop_cfg)
+        assert dc.for_program("solve") - dc.for_program("solve_path") == 3
+
+    @pytest.mark.parametrize(
+        "optimizer,reg_type",
+        [
+            (OptimizerType.TRON, "L2"),
+            (OptimizerType.LBFGS, "L2"),
+            (OptimizerType.LBFGS, "ELASTIC_NET"),  # runs OWL-QN
+        ],
+    )
+    def test_scan_equals_host_loop(self, rng, optimizer, reg_type):
+        """Scanned path == host loop to 1e-10 for every lambda —
+        coefficients, objective values, iteration counts — across the
+        warm-started descending order (results returned in config
+        order, which is shuffled here on purpose)."""
+        batch = _logistic_batch(rng)
+        lams = (0.5, 50.0, 5.0)  # NOT sorted: order preservation too
+        scan = train_glm(batch, _cfg(optimizer, reg_type, lams, "scan"))
+        loop = train_glm(batch, _cfg(optimizer, reg_type, lams, "loop"))
+        for s, l in zip(scan, loop):
+            assert s.reg_weight == l.reg_weight
+            np.testing.assert_allclose(
+                np.asarray(s.model.coefficients.means),
+                np.asarray(l.model.coefficients.means),
+                atol=1e-10,
+            )
+            np.testing.assert_allclose(
+                float(s.result.value), float(l.result.value), rtol=1e-10
+            )
+            assert int(s.result.iterations) == int(l.result.iterations)
+            assert int(s.result.reason) == int(l.result.reason)
+
+    def test_scan_preserves_tapes_variances_and_model_tracker(self, rng):
+        """PR-7 convergence tapes ride the scan axis: each lambda's
+        masked radius/CG tapes equal the host loop's; variances and
+        de-normalized ModelTracker snapshots match too."""
+        batch = _logistic_batch(rng)
+        kw = dict(
+            track_states=True, track_models=True, compute_variances=True
+        )
+        lams = (5.0, 0.5)
+        scan = train_glm(batch, _cfg(OptimizerType.TRON, "L2", lams, **kw))
+        loop = train_glm(
+            batch, _cfg(OptimizerType.TRON, "L2", lams, "loop", **kw)
+        )
+        for s, l in zip(scan, loop):
+            for tape in ("radius_tape", "cg_tape"):
+                np.testing.assert_allclose(
+                    mask_tape(
+                        getattr(s.result, tape), s.result.iterations
+                    ),
+                    mask_tape(
+                        getattr(l.result, tape), l.result.iterations
+                    ),
+                    atol=1e-10,
+                )
+            np.testing.assert_allclose(
+                np.asarray(s.model.coefficients.variances),
+                np.asarray(l.model.coefficients.variances),
+                atol=1e-10,
+            )
+            np.testing.assert_allclose(
+                np.asarray(s.result.w_history),
+                np.asarray(l.result.w_history),
+                atol=1e-10,
+            )
+
+    def test_warm_start_from_model_not_invalidated(self, rng):
+        """The path donates its carry; a caller's warm-start model must
+        survive (fresh-buffer guard) and seed the path identically to
+        the loop."""
+        batch = _logistic_batch(rng)
+        cfg = _cfg(OptimizerType.LBFGS, "L2", (1.0,))
+        (first,) = train_glm(batch, cfg)
+        init = first.model.coefficients
+        (scan,) = train_glm(batch, cfg, initial_coefficients=init)
+        (loop,) = train_glm(
+            batch,
+            dataclasses.replace(cfg, path_mode="loop"),
+            initial_coefficients=init,
+        )
+        # the donor's own coefficients are still readable afterwards
+        assert np.all(np.isfinite(np.asarray(init.means)))
+        np.testing.assert_allclose(
+            np.asarray(scan.model.coefficients.means),
+            np.asarray(loop.model.coefficients.means),
+            atol=1e-10,
+        )
+
+    def test_traced_path_emits_per_lambda_solve_spans(self, rng, tmp_path):
+        """One glm.solve_path span per dispatch; per-lambda glm.solve
+        spans + convergence.solve events retro-stamped inside its
+        window (the PR-3/4/7 obs surfaces survive the fused path)."""
+        batch = _logistic_batch(rng)
+        cfg = _cfg(OptimizerType.TRON, "L2", (5.0, 0.5, 0.05))
+        trace_dir = str(tmp_path / "trace")
+        with obs.observe(trace_dir=trace_dir):
+            train_glm(batch, cfg)
+        with open(os.path.join(trace_dir, "trace.json")) as f:
+            doc = json.load(f)
+        paths = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "glm.solve_path"
+        ]
+        solves = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "glm.solve"
+        ]
+        assert len(paths) == 1
+        assert paths[0]["args"]["path_len"] == 3
+        assert paths[0]["args"]["dispatches"] == 1
+        assert len(solves) == 3
+        p0, p1 = paths[0]["ts"], paths[0]["ts"] + paths[0]["dur"]
+        for e in solves:
+            assert e["args"]["path"] is True
+            assert e["args"]["convergence_reason"]
+            assert e["ts"] >= p0 - 1.0
+            assert e["ts"] + e["dur"] <= p1 + 1.0
+        # descending lambda order inside the window
+        assert [e["args"]["reg_weight"] for e in sorted(
+            solves, key=lambda e: e["ts"]
+        )] == [5.0, 0.5, 0.05]
+        with open(os.path.join(trace_dir, "events.jsonl")) as f:
+            reports = [
+                json.loads(line)
+                for line in f
+                if '"convergence.solve"' in line
+            ]
+        assert len([r for r in reports if r.get("kind") == "event"]) == 3
+
+
+class TestMultiPassGameDescent:
+    def test_superpass_equals_single_pass(self, rng):
+        """K passes per dispatch == the K=1 fused run == the plain loop:
+        identical params, objectives, histograms, PRNG stream."""
+        data, _, n_users = make_mixed_effects_data(rng)
+        ref_cd = build_game(data, n_users)
+        m_ref, h_ref = ref_cd.run(num_iterations=4, seed=3)
+        for k in (2, 3, 4, 7):
+            cd = build_game(data, n_users)
+            m, h = cd.run(
+                num_iterations=4, seed=3, passes_per_dispatch=k
+            )
+            for name in m_ref.params:
+                np.testing.assert_allclose(
+                    np.asarray(m.params[name]),
+                    np.asarray(m_ref.params[name]),
+                    atol=1e-10,
+                    err_msg=f"K={k}",
+                )
+            assert len(h) == len(h_ref)
+            for a, b in zip(h, h_ref):
+                assert (a.iteration, a.coordinate) == (
+                    b.iteration, b.coordinate,
+                )
+                np.testing.assert_allclose(
+                    a.objective, b.objective, rtol=1e-10
+                )
+                assert a.convergence_histogram == b.convergence_histogram
+
+    def test_superpass_dispatch_count(self, rng, dispatch_counter):
+        """P passes at K per dispatch = ceil(P/K) superpass dispatches."""
+        data, _, n_users = make_mixed_effects_data(rng)
+        cd = build_game(data, n_users)
+        cd.run(num_iterations=5, seed=3, passes_per_dispatch=2)  # warm
+        cd2 = build_game(data, n_users)
+        with dispatch_counter() as dc:
+            cd2.run(num_iterations=5, seed=3, passes_per_dispatch=2)
+        dc.assert_program("superpass", 3)  # ceil(5/2)
+
+    def test_convergence_tolerance_early_exits_on_device(self, rng):
+        data, _, n_users = make_mixed_effects_data(rng)
+        cd = build_game(data, n_users)
+        m, h = cd.run(
+            num_iterations=40,
+            seed=3,
+            passes_per_dispatch=8,
+            convergence_tolerance=1e-8,
+        )
+        n_passes = len(h) // len(cd.coordinates)
+        assert 0 < n_passes < 40
+        # tol=0 (default) keeps the reference run-them-all behavior
+        cd0 = build_game(data, n_users)
+        _, h0 = cd0.run(num_iterations=6, seed=3, passes_per_dispatch=8)
+        assert len(h0) // len(cd0.coordinates) == 6
+
+    def test_checkpoint_cadence_bounds_dispatch_chunk(
+        self, rng, tmp_path, dispatch_counter
+    ):
+        """checkpoint_every still fires on schedule when K exceeds it —
+        the dispatch chunk shrinks to land on every boundary."""
+        from photon_ml_tpu.io.checkpoint import latest_checkpoint
+
+        data, _, n_users = make_mixed_effects_data(rng)
+        cd = build_game(data, n_users)
+        ck = str(tmp_path / "ck")
+        m, _ = cd.run(
+            num_iterations=4,
+            seed=3,
+            passes_per_dispatch=16,
+            checkpoint_dir=ck,
+            checkpoint_every=2,
+        )
+        assert latest_checkpoint(ck).step == 4
+        ref = build_game(data, n_users)
+        m_ref, _ = ref.run(num_iterations=4, seed=3)
+        for name in m_ref.params:
+            np.testing.assert_allclose(
+                np.asarray(m.params[name]),
+                np.asarray(m_ref.params[name]),
+                atol=1e-10,
+            )
+
+
+class _DivergingCoordinate:
+    """Deterministic blow-up implementing the full fused/trace-safe
+    surface: params scale by 1e100 per update, so the SECOND update's
+    reg term overflows float64 — the divergence drill for the
+    in-program guard (finite on pass 1, non-finite objective on pass 2,
+    and un-fixable by the damped retry, so the host policy must land on
+    FREEZE)."""
+
+    def __init__(self, n_rows):
+        self.n_rows = n_rows
+
+    def initial_params(self):
+        return jnp.ones((2,), jnp.float64)
+
+    def fused_state(self):
+        return (jnp.zeros((), jnp.float64),)
+
+    def with_fused_state(self, state):
+        return self
+
+    def wrap_tracker(self, tracker):
+        return tracker
+
+    def score(self, w):
+        # scores stay zero (the objective blows up through reg_term),
+        # but keep the value-dependence so tracing threads w
+        return jnp.zeros((self.n_rows,), jnp.float64) + 0.0 * jnp.sum(w)
+
+    def reg_term(self, w):
+        return 0.5 * jnp.vdot(w, w)
+
+    def update_step(self, w, partial_scores, key=None):
+        p = w * 1e100
+        tracker = SolverResult(
+            w=p,
+            value=0.5 * jnp.vdot(p, p),
+            grad=jnp.zeros_like(p),
+            iterations=jnp.int32(1),
+            reason=jnp.int32(1),  # MAX_ITERATIONS -> nonconverged
+            values=(0.5 * jnp.vdot(p, p))[None],
+            grad_norms=jnp.linalg.norm(p)[None],
+        )
+        return p, tracker, self.score(p)
+
+    # plain-loop surface
+    def update_and_score(self, w, partial_scores, key=None):
+        return self.update_step(w, partial_scores, key)
+
+
+class TestSuperpassDivergenceGuard:
+    def _build(self, rng):
+        from photon_ml_tpu.game import CoordinateDescent
+
+        data, _, n_users = make_mixed_effects_data(rng, n_users=10)
+        base = build_game(data, n_users)
+        coords = dict(base.coordinates)
+        n = int(np.asarray(base.labels).shape[0])
+        # included at CONSTRUCTION: the training objective closes over
+        # the coordinate list, so a post-hoc insert would be invisible
+        # to the objective (and to the guard)
+        coords["bad"] = _DivergingCoordinate(n)
+        return CoordinateDescent(
+            coordinates=coords,
+            labels=base.labels,
+            base_offsets=base.base_offsets,
+            weights=base.weights,
+            task=TaskType.LOGISTIC_REGRESSION,
+        )
+
+    def test_in_program_guard_triggers_host_freeze(self, rng, tmp_path):
+        """K=3 superpass: pass 1 commits, pass 2 diverges IN-PROGRAM;
+        the dispatch early-exits without committing it, the host replays
+        that pass through the guarded per-update loop (rollback + damped
+        retry + freeze), training continues for the healthy
+        coordinates, and the PR-7 precursor event fires."""
+        cd = self._build(rng)
+        trace_dir = str(tmp_path / "trace")
+        tracker = obs.install_convergence_tracker()
+        try:
+            with obs.observe(trace_dir=trace_dir):
+                model, history = cd.run(
+                    num_iterations=4,
+                    seed=3,
+                    passes_per_dispatch=3,
+                    divergence_guard=True,
+                )
+        finally:
+            obs.uninstall_convergence_tracker()
+        frozen = [h for h in history if h.event == "frozen"]
+        assert len(frozen) == 1
+        assert frozen[0].coordinate == "bad"
+        assert frozen[0].iteration == 1  # pass 2, the in-program trip
+        # every pass completed; the healthy coordinates' params are
+        # finite and "bad" stayed at its last-committed (finite) state
+        n_coords = len(cd.coordinates)
+        per_pass = [
+            [h for h in history if h.iteration == i] for i in range(4)
+        ]
+        assert [len(p) for p in per_pass] == [
+            n_coords, n_coords, n_coords - 1, n_coords - 1
+        ]
+        for name, p in model.params.items():
+            assert np.all(
+                np.isfinite(np.asarray(jax.tree_util.tree_leaves(p)[0]))
+            ), name
+        events = []
+        with open(os.path.join(trace_dir, "events.jsonl")) as f:
+            for line in f:
+                events.append(json.loads(line))
+        names = [e.get("name") for e in events]
+        assert "resilience.superpass_guard" in names
+        assert "resilience.rollback" in names
+        assert "resilience.freeze" in names
+        # PR-7 precursor: the frozen coordinate's non-finite tracker
+        # grad norms ride the fleet decode
+        assert "convergence.precursor" in names
+
+    def test_unguarded_superpass_propagates_nonfinite(self):
+        """Without divergence_guard the in-program predicate must NOT
+        change semantics: non-finite passes commit (one NaN poisons the
+        run, the unguarded fused-loop behavior), every requested pass
+        runs, and the host's passes_done == chunk assumption holds."""
+        cd = self._build(np.random.default_rng(20260729))
+        m, h = cd.run(num_iterations=3, seed=3, passes_per_dispatch=3)
+        assert len(h) == 3 * len(cd.coordinates)  # nothing early-exited
+        assert not any(rec.event for rec in h)
+        assert not np.isfinite(h[-1].objective)
+
+    def test_guarded_superpass_equals_guarded_loop(self):
+        """The superpass-with-replay trajectory == the fully host-guarded
+        per-update run: same freezes, same params, same objectives.
+        (Same-seeded fresh rngs: the builder consumes random draws.)"""
+        cd_a = self._build(np.random.default_rng(20260729))
+        m_a, h_a = cd_a.run(
+            num_iterations=3, seed=3, passes_per_dispatch=3,
+            divergence_guard=True,
+        )
+        cd_b = self._build(np.random.default_rng(20260729))
+        m_b, h_b = cd_b.run(
+            num_iterations=3, seed=3, divergence_guard=True
+        )
+        assert [
+            (h.iteration, h.coordinate, h.event) for h in h_a
+        ] == [(h.iteration, h.coordinate, h.event) for h in h_b]
+        for a, b in zip(h_a, h_b):
+            if np.isfinite(a.objective) and np.isfinite(b.objective):
+                np.testing.assert_allclose(
+                    a.objective, b.objective, rtol=1e-10
+                )
+        for name in m_a.params:
+            np.testing.assert_allclose(
+                np.asarray(
+                    jax.tree_util.tree_leaves(m_a.params[name])[0]
+                ),
+                np.asarray(
+                    jax.tree_util.tree_leaves(m_b.params[name])[0]
+                ),
+                atol=1e-10,
+            )
+
+
+class TestDriverKnobs:
+    """The CLI/config surface of both device-resident loops."""
+
+    def test_glm_path_mode_threads_and_validates(self):
+        from photon_ml_tpu.cli.config import GLMDriverParams
+
+        p = GLMDriverParams(
+            train_input=["x"], output_dir="o", path_mode="loop"
+        )
+        assert p.to_training_config().path_mode == "loop"
+        assert (
+            GLMDriverParams(train_input=["x"], output_dir="o")
+            .to_training_config()
+            .path_mode
+            == "scan"
+        )
+        with pytest.raises(ValueError, match="path_mode"):
+            GLMTrainingConfig(path_mode="bogus").validate()
+
+    def test_game_dispatch_knobs_validate(self):
+        from photon_ml_tpu.cli.config import (
+            GameDriverParams,
+            load_params,
+        )
+
+        base = dict(
+            train_input=["x"],
+            output_dir="o",
+            coordinates={"g": {"shard": "global"}},
+            updating_sequence=["g"],
+        )
+        p = load_params(
+            {
+                **base,
+                "passes_per_dispatch": 4,
+                "convergence_tolerance": 1e-6,
+            },
+            GameDriverParams,
+        )
+        p.validate()
+        assert p.passes_per_dispatch == 4
+        with pytest.raises(ValueError, match="passes_per_dispatch"):
+            load_params(
+                {**base, "passes_per_dispatch": 0}, GameDriverParams
+            ).validate()
+        with pytest.raises(ValueError, match="convergence_tolerance"):
+            load_params(
+                {**base, "convergence_tolerance": -1.0}, GameDriverParams
+            ).validate()
+
+
+class TestPreemptionAtDispatchBoundaries:
+    def test_preempt_and_resume_with_multi_pass_dispatches(
+        self, rng, tmp_path
+    ):
+        """Preemption with K>1 lands on a dispatch boundary
+        (preempted.json step == passes completed, a multiple of the
+        chunk), and the resumed run reproduces the uninterrupted
+        trajectory bit-for-bit."""
+        from photon_ml_tpu.resilience.shutdown import (
+            read_preempted_marker,
+        )
+
+        data, _, n_users = make_mixed_effects_data(rng)
+        uncd = build_game(data, n_users)
+        m_ref, h_ref = uncd.run(
+            num_iterations=6, seed=3, passes_per_dispatch=2
+        )
+
+        ck = str(tmp_path / "ck")
+        cd1 = build_game(data, n_users)
+        m1, h1 = cd1.run(
+            num_iterations=6,
+            seed=3,
+            passes_per_dispatch=2,
+            checkpoint_dir=ck,
+            checkpoint_every=2,
+            stop_check=lambda: True,  # preempted at the FIRST boundary
+        )
+        marker = read_preempted_marker(ck)
+        assert marker is not None and marker["step"] == 2
+        cd2 = build_game(data, n_users)
+        m2, h2 = cd2.run(
+            num_iterations=6,
+            seed=3,
+            passes_per_dispatch=2,
+            checkpoint_dir=ck,
+            checkpoint_every=2,
+            resume=True,
+        )
+        assert read_preempted_marker(ck) is None  # completed: cleared
+        for name in m_ref.params:
+            np.testing.assert_allclose(
+                np.asarray(m2.params[name]),
+                np.asarray(m_ref.params[name]),
+                atol=1e-12,
+            )
+        assert len(h2) == len(h_ref)
+        for a, b in zip(h2, h_ref):
+            assert (a.iteration, a.coordinate) == (
+                b.iteration, b.coordinate,
+            )
+            np.testing.assert_allclose(
+                a.objective, b.objective, rtol=1e-12
+            )

@@ -303,6 +303,33 @@ class TestHashableBounds:
         assert len(a) == 3 and list(a) == [0.0, 1.0, 2.0]
         np.testing.assert_array_equal(np.asarray(a), [0.0, 1.0, 2.0])
 
+    def test_digest_key_is_o1_per_lookup(self):
+        """Equality between HashableBounds is digest-vs-digest — the
+        bytes key is computed ONCE at construction, so every solver-
+        cache lookup on a bounds-carrying config costs O(1) in d (no
+        per-lookup elementwise compare of d boxed floats)."""
+        from unittest import mock
+
+        from photon_ml_tpu.models.training import HashableBounds
+
+        a = HashableBounds(np.arange(10_000, dtype=float))
+        b = HashableBounds(np.arange(10_000, dtype=float))
+        assert isinstance(a.digest, bytes)
+        assert a.digest == b.digest and a == b
+        # HB-vs-HB equality must never touch the value arrays
+        with mock.patch.object(
+            np, "array_equal",
+            side_effect=AssertionError("O(d) compare on HB==HB"),
+        ):
+            assert a == b
+            assert a != HashableBounds(np.arange(3, dtype=float))
+        # d=10k configs differing only in bounds hash/compare apart
+        cfg_a = GLMTrainingConfig(lower_bounds=a)
+        cfg_b = GLMTrainingConfig(
+            lower_bounds=np.arange(10_000, dtype=float) + 1.0
+        )
+        assert cfg_a != cfg_b
+
     def test_config_wraps_and_rewraps_idempotently(self):
         import dataclasses
 
