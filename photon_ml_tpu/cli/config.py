@@ -42,11 +42,25 @@ class GLMDriverParams:
     tolerance: float = 1e-7
     add_intercept: bool = True
     sparse: bool = False
-    # stream the (dense) dataset to the device one input file at a time
-    # — host decode / host->device transfer / compile overlap, and peak
-    # host memory is one file's chunk instead of the whole dataset
-    # (io.ingest.labeled_batch_streamed; VERDICT r4 #6)
+    # stream the (dense) dataset to the device through the ingest
+    # pipeline (io.pipeline: parallel decode, ring staging, async
+    # prefetch) — host decode / host->device transfer / compile
+    # overlap, and peak host memory is the staging ring instead of the
+    # whole dataset (docs/INGEST.md)
     streamed_ingest: bool = False
+    # OUT-OF-CORE training: the design exceeds HBM. Decode+stage once
+    # into host-resident chunks and stream every objective pass through
+    # the fused per-chunk programs (models.training.train_glm_streamed;
+    # exact full-dataset objective, <=1e-10 vs in-core). Requires
+    # normalization NONE, dense features, TRON/LBFGS, single device.
+    out_of_core: bool = False
+    # ingest-pipeline knobs (docs/INGEST.md): target decoded-chunk MB
+    # (file-group planning + uniform staged row blocks), decode workers
+    # (0 = auto, PHOTON_DECODE_THREADS honored), and how many chunks
+    # decode/staging may run ahead of the consumer
+    ingest_chunk_mb: float = 64.0
+    decode_threads: int = 0
+    prefetch_depth: int = 2
     # with sparse=True: densify the hottest columns into an MXU slab and
     # keep only the power-law tail in the ELL scatter path (ops.sparse
     # HybridFeatures). 0 = off, -1 = auto (count-threshold split), N > 0 =
@@ -129,6 +143,50 @@ class GLMDriverParams:
             )
         if self.hot_columns and not self.sparse:
             raise ValueError("hot_columns requires sparse=True")
+        if self.ingest_chunk_mb <= 0:
+            raise ValueError(
+                f"ingest_chunk_mb must be > 0, got {self.ingest_chunk_mb}"
+            )
+        if self.decode_threads < 0:
+            raise ValueError(
+                f"decode_threads must be >= 0 (0 = auto), got "
+                f"{self.decode_threads}"
+            )
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
+            )
+        if self.out_of_core:
+            if self.sparse:
+                raise ValueError(
+                    "out_of_core streams dense uniform chunks; sparse "
+                    "designs decode in-core (padded-ELL width is global)"
+                )
+            if self.streamed_ingest:
+                raise ValueError(
+                    "out_of_core subsumes streamed_ingest (chunks stay "
+                    "host-side instead of assembling on device); pick one"
+                )
+            if self.normalization != "NONE":
+                raise ValueError(
+                    "out_of_core requires normalization NONE (the "
+                    "whitening summary would need its own streaming pass)"
+                )
+            if self.optimizer == "NEWTON":
+                raise ValueError(
+                    "NEWTON materializes the explicit Hessian from the "
+                    "in-core design; out_of_core supports TRON/LBFGS"
+                )
+            if self.mesh_shape:
+                raise ValueError(
+                    "out_of_core is single-device for now (chunk "
+                    "streaming does not partition across a mesh)"
+                )
+            if self.diagnostics or self.validate_per_iteration:
+                raise ValueError(
+                    "diagnostics/validate_per_iteration need the in-core "
+                    "training batch; not available with out_of_core"
+                )
         if self.hot_columns and self.mesh_shape:
             raise ValueError(
                 "hot_columns (hybrid features) is single-device for now: "
@@ -285,6 +343,14 @@ class GameDriverParams:
     # bag regime). Sparse shards serve plain fixed-effect coordinates
     # only: per-entity designs gather dense rows.
     sparse_shards: List[str] = dataclasses.field(default_factory=list)
+    # decode the training input through the streaming ingest pipeline
+    # (io.pipeline: bounded parallel decode; identical GameData to the
+    # one-shot read — docs/INGEST.md) with the same three knobs as the
+    # GLM driver
+    streamed_ingest: bool = False
+    ingest_chunk_mb: float = 64.0
+    decode_threads: int = 0
+    prefetch_depth: int = 2
     # observability (docs/OBSERVABILITY.md): span tracer output directory
     # (Chrome trace-event JSON + events.jsonl + metrics.json), periodic
     # metrics-registry snapshot interval in seconds (0 = final-only), and
@@ -401,6 +467,19 @@ class GameDriverParams:
             raise ValueError(
                 f"passes_per_dispatch must be >= 1, got "
                 f"{self.passes_per_dispatch}"
+            )
+        if self.ingest_chunk_mb <= 0:
+            raise ValueError(
+                f"ingest_chunk_mb must be > 0, got {self.ingest_chunk_mb}"
+            )
+        if self.decode_threads < 0:
+            raise ValueError(
+                f"decode_threads must be >= 0 (0 = auto), got "
+                f"{self.decode_threads}"
+            )
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
             )
         if self.convergence_tolerance < 0:
             raise ValueError(

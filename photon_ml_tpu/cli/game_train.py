@@ -581,10 +581,23 @@ def _run_game_training(
                 if spec.random_effect is not None
             }
         )
-        data, entity_vocabs, _uids, _present = source.game_data(
-            shard_vocabs, entity_keys,
-            sparse_shards=set(params.sparse_shards),
-        )
+        if params.streamed_ingest:
+            # bounded parallel decode through the ingest pipeline —
+            # identical GameData to the one-shot read (docs/INGEST.md)
+            data, entity_vocabs, _uids, _present = (
+                source.game_data_streamed(
+                    shard_vocabs, entity_keys,
+                    sparse_shards=set(params.sparse_shards),
+                    chunk_mb=params.ingest_chunk_mb,
+                    decode_threads=params.decode_threads,
+                    prefetch_depth=params.prefetch_depth,
+                )
+            )
+        else:
+            data, entity_vocabs, _uids, _present = source.game_data(
+                shard_vocabs, entity_keys,
+                sparse_shards=set(params.sparse_shards),
+            )
         logger.info(f"read {len(data.labels)} training records")
         entity_counts = {k: len(v) for k, v in entity_vocabs.items()}
         logger.info(
@@ -1107,6 +1120,26 @@ def main(argv=None) -> None:
         help="with K > 1: in-program objective-tolerance early exit "
         "between passes (0 disables)",
     )
+    p.add_argument(
+        "--streamed-ingest", action="store_true", default=None,
+        help="decode the training input through the streaming ingest "
+        "pipeline (bounded parallel decode — docs/INGEST.md)",
+    )
+    p.add_argument(
+        "--ingest-chunk-mb", type=float, default=None,
+        help="ingest pipeline: target decoded-chunk size in MB "
+        "(default 64)",
+    )
+    p.add_argument(
+        "--decode-threads", type=int, default=None,
+        help="ingest pipeline: concurrent decode workers (0 = auto; "
+        "PHOTON_DECODE_THREADS override honored)",
+    )
+    p.add_argument(
+        "--prefetch-depth", type=int, default=None,
+        help="ingest pipeline: chunks decode may run ahead of the "
+        "consumer (default 2)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1138,6 +1171,14 @@ def main(argv=None) -> None:
         base["passes_per_dispatch"] = args.passes_per_dispatch
     if args.convergence_tolerance is not None:
         base["convergence_tolerance"] = args.convergence_tolerance
+    if args.streamed_ingest is not None:
+        base["streamed_ingest"] = args.streamed_ingest
+    if args.ingest_chunk_mb is not None:
+        base["ingest_chunk_mb"] = args.ingest_chunk_mb
+    if args.decode_threads is not None:
+        base["decode_threads"] = args.decode_threads
+    if args.prefetch_depth is not None:
+        base["prefetch_depth"] = args.prefetch_depth
     run_game_training(base)
 
 

@@ -233,33 +233,72 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
         logger.info(f"feature space: {len(vocab)} columns "
                     f"(intercept={vocab.intercept_index})")
 
-        if params.streamed_ingest:
+        task = TaskType[params.task]
+        batch = None
+        design = None
+        summary = None
+        if params.out_of_core:
+            # decode + stage ONCE into host-resident uniform chunks;
+            # every objective pass will stream them (docs/INGEST.md)
+            from photon_ml_tpu.io.pipeline import (
+                IngestPipeline,
+                PipelineConfig,
+                StreamedDesign,
+            )
+
+            with IngestPipeline(
+                source.files,
+                [vocab],
+                label_field=source.label_field,
+                config=PipelineConfig(
+                    chunk_mb=params.ingest_chunk_mb,
+                    decode_threads=params.decode_threads,
+                    prefetch_depth=params.prefetch_depth,
+                ),
+            ) as pipe:
+                design = StreamedDesign.from_pipeline(
+                    pipe,
+                    dtype=np.dtype(driver_dtype(params.precision)),
+                )
+            logger.info(
+                f"out-of-core design: {design.n} rows x {design.d} "
+                f"columns in {design.num_chunks} chunks of "
+                f"{design.rows_per_chunk} rows "
+                f"({design.bytes_per_epoch / 1e9:.2f} GB/epoch streamed); "
+                "sanity checks and the feature summary need the in-core "
+                "batch and are skipped"
+            )
+        elif params.streamed_ingest:
             if params.sparse:
                 raise ValueError(
                     "streamed_ingest is dense-only (padded-ELL width is "
                     "a global property; decode sparse inputs whole)"
                 )
             batch, _uids, _present = source.labeled_batch_streamed(
-                vocab, dtype=driver_dtype(params.precision)
+                vocab,
+                dtype=driver_dtype(params.precision),
+                chunk_mb=params.ingest_chunk_mb,
+                decode_threads=params.decode_threads,
+                prefetch_depth=params.prefetch_depth,
             )
         else:
             batch, _uids, _present = source.labeled_batch(
                 vocab, sparse=params.sparse,
                 dtype=driver_dtype(params.precision),
             )
-        logger.info(f"read {batch.labels.shape[0]} training records")
-        if params.sparse and params.hot_columns:
-            batch = _hybridize(batch, params, logger)
-        task = TaskType[params.task]
-        sanity_check_data(
-            batch, task, DataValidationType[params.data_validation]
-        )
-        summary = summarize_features(batch)
-        write_feature_summary(
-            os.path.join(params.output_dir, "feature-summary.tsv"),
-            summary,
-            vocab,
-        )
+        if batch is not None:
+            logger.info(f"read {batch.labels.shape[0]} training records")
+            if params.sparse and params.hot_columns:
+                batch = _hybridize(batch, params, logger)
+            sanity_check_data(
+                batch, task, DataValidationType[params.data_validation]
+            )
+            summary = summarize_features(batch)
+            write_feature_summary(
+                os.path.join(params.output_dir, "feature-summary.tsv"),
+                summary,
+                vocab,
+            )
     tracker.advance(DriverStage.PREPROCESSED)
 
     # ---- TRAIN -----------------------------------------------------------
@@ -313,7 +352,22 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
             # features start at 0)
             initial, _ = load_glm_model(init_path, vocab)
             logger.info(f"warm-starting from {init_path}")
-        if params.mesh_shape:
+        if design is not None:
+            # out-of-core: every objective pass streams the host chunks
+            # through the fused per-chunk programs; the unmodified
+            # TRON/LBFGS loops see the exact full-dataset objective
+            from photon_ml_tpu.models.training import train_glm_streamed
+
+            logger.info(
+                f"out-of-core solve over {design.num_chunks} streamed "
+                "chunks"
+            )
+            models = list(
+                train_glm_streamed(
+                    design, cfg, initial_coefficients=initial
+                )
+            )
+        elif params.mesh_shape:
             # mesh-sharded solve: 'data' row-shards (GSPMD psum), adding
             # 'feature' also shards the coefficient axis (huge-d regime);
             # device-count validation lives in the mesh constructors
@@ -506,7 +560,9 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
         best=best,
         best_index=best_index,
         validation_metrics=validation_metrics,
-        num_training_rows=int(batch.labels.shape[0]),
+        num_training_rows=(
+            design.n if design is not None else int(batch.labels.shape[0])
+        ),
         num_features=len(vocab),
         summary=summary,
     )
@@ -536,8 +592,31 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--streamed-ingest", action="store_true", default=None,
-        help="stream the dense dataset to the device per input file "
-        "(decode/transfer overlap; host memory stays one chunk)",
+        help="stream the dense dataset to the device through the ingest "
+        "pipeline (parallel decode, ring staging, async prefetch; host "
+        "memory stays the staging ring — docs/INGEST.md)",
+    )
+    p.add_argument(
+        "--out-of-core", action="store_true", default=None,
+        help="out-of-core training: keep the design host-side in "
+        "uniform chunks and stream every objective pass through the "
+        "fused per-chunk programs (exact full-dataset objective; "
+        "TRON/LBFGS, normalization NONE — docs/INGEST.md)",
+    )
+    p.add_argument(
+        "--ingest-chunk-mb", type=float, default=None,
+        help="ingest pipeline: target decoded-chunk size in MB (file-"
+        "group planning + uniform staged row blocks; default 64)",
+    )
+    p.add_argument(
+        "--decode-threads", type=int, default=None,
+        help="ingest pipeline: concurrent decode workers (0 = auto; "
+        "PHOTON_DECODE_THREADS override honored)",
+    )
+    p.add_argument(
+        "--prefetch-depth", type=int, default=None,
+        help="ingest pipeline: chunks decode/staging may run ahead of "
+        "the consumer; also sizes the staging ring (default 2)",
     )
     p.add_argument("--overwrite", action="store_true", default=None)
     p.add_argument("--diagnostics", action="store_true", default=None)

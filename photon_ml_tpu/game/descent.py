@@ -209,6 +209,53 @@ def _loss_fn_for_task(task: TaskType):
     raise ValueError(f"no GAME training evaluator for {task}")
 
 
+class _AsyncCheckpointWriter:
+    """One-deep background checkpoint writer: the training loop hands a
+    fully host-snapshotted write closure to :meth:`submit` and keeps
+    dispatching device work while serialization + the atomic swap hit
+    disk (epoch time bounded by device math, not checkpoint I/O —
+    docs/INGEST.md's overlap principle applied to the output side).
+    ``submit`` joins any previous write first, so writes serialize in
+    step order and at most one is in flight; a failed background write
+    re-raises at the next ``submit``/``join`` — at the latest before
+    ``run()`` returns. An exception that unwinds ``run()`` between a
+    submit and its join can at worst lose that one overlapped write,
+    which resume tolerates by falling back to the previous VALID step
+    (``io.checkpoint.latest_checkpoint``)."""
+
+    def __init__(self):
+        import threading
+
+        self._threading = threading
+        self._thread = None
+        self._exc: Optional[BaseException] = None
+
+    def submit(self, write_fn) -> None:
+        self.join()
+
+        def run():
+            try:
+                write_fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                self._exc = e
+
+        t = self._threading.Thread(
+            target=run, name="game-ckpt-writer", daemon=True
+        )
+        self._thread = t
+        t.start()
+
+    def join(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._exc is not None:
+            exc = self._exc
+            self._exc = None
+            raise exc
+
+
 class CoordinateDescent:
     """Owns the coordinates and the outer loop.
 
@@ -854,18 +901,51 @@ class CoordinateDescent:
         )
         from photon_ml_tpu.resilience import faults as _faults
 
-        def _save_ckpt(step):
+        # Checkpoint writes OVERLAP the next dispatch chunk's device
+        # math: the training state is snapshotted to host synchronously
+        # (the write must capture THIS boundary, not whatever the next
+        # pass mutates), then serialization + atomic swap run on a
+        # background writer. At most one write is in flight; the
+        # preemption paths and the run's return join() first, so every
+        # durability guarantee those boundaries had under synchronous
+        # writes still holds — a mid-pass hard crash can at worst lose
+        # the overlapped write, which resume already tolerates (it
+        # falls back to the previous VALID checkpoint and the
+        # deterministic PRNG stream reproduces the run).
+        ckpt_writer = _AsyncCheckpointWriter()
+
+        def _save_ckpt(step, wait: bool = False):
             from photon_ml_tpu.io.checkpoint import save_checkpoint
 
             materialize()
-            save_checkpoint(
-                checkpoint_dir,
-                step,
-                # save_checkpoint handles plain tables AND FactoredParams
-                dict(model.params),
-                np.asarray(key),
-                [dataclasses.asdict(h) for h in history],
-                frozen=sorted(frozen),
+            t0 = time.perf_counter()
+            # host snapshot: params / key / history copied now
+            params_host = {
+                n: jax.tree_util.tree_map(
+                    lambda a: np.asarray(a), model.params[n]
+                )
+                for n in names
+            }
+            key_host = np.asarray(key)
+            hist_host = [dataclasses.asdict(h) for h in history]
+            frozen_host = sorted(frozen)
+            ckpt_writer.submit(
+                lambda: save_checkpoint(
+                    checkpoint_dir,
+                    step,
+                    # save_checkpoint handles plain tables AND
+                    # FactoredParams
+                    params_host,
+                    key_host,
+                    hist_host,
+                    frozen=frozen_host,
+                )
+            )
+            if wait:
+                ckpt_writer.join()
+            obs.registry().observe(
+                "game.checkpoint.submit_ms",
+                (time.perf_counter() - t0) * 1e3,
             )
 
         # count XLA backend compiles for the duration of the run: the
@@ -1039,8 +1119,12 @@ class CoordinateDescent:
                 if stop_check is not None and stop_check():
                     stopped = True
                     if checkpoint_dir is not None:
+                        # the marker promises a durable checkpoint at
+                        # this step: drain the overlapped write first
                         if not saved:
-                            _save_ckpt(it)
+                            _save_ckpt(it, wait=True)
+                        else:
+                            ckpt_writer.join()
                         from photon_ml_tpu.resilience.shutdown import (
                             write_preempted_marker,
                         )
@@ -1368,8 +1452,12 @@ class CoordinateDescent:
             if stop_check is not None and stop_check():
                 stopped = True
                 if checkpoint_dir is not None:
+                    # the marker promises a durable checkpoint at this
+                    # step: drain the overlapped write first
                     if not saved:
-                        _save_ckpt(it + 1)
+                        _save_ckpt(it + 1, wait=True)
+                    else:
+                        ckpt_writer.join()
                     from photon_ml_tpu.resilience.shutdown import (
                         write_preempted_marker,
                     )
@@ -1381,6 +1469,9 @@ class CoordinateDescent:
                     )
                 break
             it += 1
+        # the run's durability contract: every checkpoint submitted is
+        # on disk (or has raised) before run() returns
+        ckpt_writer.join()
         materialize()
         if checkpoint_dir is not None and not stopped:
             # the run reached its target: a stale marker from an earlier

@@ -27,6 +27,13 @@ from photon_ml_tpu.io.ingest import (
     training_examples_to_arrays,
     training_examples_to_sparse,
 )
+from photon_ml_tpu.io.pipeline import (
+    IngestPipeline,
+    PipelineConfig,
+    PipelineStats,
+    StreamedDesign,
+    StreamingObjective,
+)
 from photon_ml_tpu.io.models import (
     load_glm_model,
     load_factored_coordinate,
@@ -46,6 +53,11 @@ __all__ = [
     "LATENT_FACTOR_SCHEMA",
     "FeatureVocabulary",
     "IngestSource",
+    "IngestPipeline",
+    "PipelineConfig",
+    "PipelineStats",
+    "StreamedDesign",
+    "StreamingObjective",
     "labeled_batch_from_avro",
     "training_examples_to_arrays",
     "training_examples_to_sparse",

@@ -74,6 +74,27 @@ _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_error: Optional[str] = None
 
+# Live native-handle census: every successfully created reader/vocabset
+# handle increments, every close() decrements. Threaded decode creates
+# one reader per (chunk, retry attempt) — a leak there scales with the
+# dataset, not the process, so tests assert this returns to zero after
+# every ingest entry point (the handle-count regression drill in
+# tests/test_pipeline.py).
+_handle_lock = threading.Lock()
+_live_handles = 0
+
+
+def _note_handle(delta: int) -> None:
+    global _live_handles
+    with _handle_lock:
+        _live_handles += delta
+
+
+def live_native_handles() -> int:
+    """Number of currently open native reader/vocabset handles."""
+    with _handle_lock:
+        return _live_handles
+
 
 def _build_and_load() -> Tuple[Optional[ctypes.CDLL], Optional[str]]:
     try:
@@ -364,6 +385,7 @@ class NativeVocabSet:
         )
         if not self._handle:
             raise RuntimeError("pml_vocabset_new failed")
+        _note_handle(+1)
 
     @property
     def handle(self):
@@ -373,6 +395,16 @@ class NativeVocabSet:
         if getattr(self, "_handle", None):
             self._lib.pml_vocabset_free(self._handle)
             self._handle = None
+            _note_handle(-1)
+
+    # context-manager form: deterministic release at every ingest call
+    # site (threaded decode must not lean on best-effort __del__ —
+    # a handle per retry attempt leaks O(chunks) otherwise)
+    def __enter__(self) -> "NativeVocabSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __del__(self):  # pragma: no cover — best effort
         try:
@@ -419,6 +451,7 @@ class NativeAvroReader:
         )
         if not self._handle:
             raise RuntimeError("pml_reader_new failed")
+        _note_handle(+1)
         # the vocab set must outlive the reader (C side is non-owning)
         self._keepalive = (vocabset, entity_blob, entity_offsets)
 
@@ -594,6 +627,13 @@ class NativeAvroReader:
         if getattr(self, "_handle", None):
             self._lib.pml_reader_free(self._handle)
             self._handle = None
+            _note_handle(-1)
+
+    def __enter__(self) -> "NativeAvroReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __del__(self):  # pragma: no cover — best effort
         try:
@@ -620,12 +660,61 @@ def _map_files(paths: Sequence[str], fn, max_workers: Optional[int]):
         return list(pool.map(fn, paths))
 
 
+# One-shot announcement of an applied PHOTON_DECODE_THREADS override —
+# once per process, not once per ingest call.
+_env_threads_logged = False
+
+DECODE_THREADS_ENV = "PHOTON_DECODE_THREADS"
+# absolute ceiling for the override: more threads than this never helps
+# block decode and a typo'd huge value must not fork-bomb the pool
+MAX_DECODE_THREADS = 64
+
+
+def _env_decode_threads() -> Optional[int]:
+    """The ``PHOTON_DECODE_THREADS`` override, capped to a sane range
+    (1..min(64, 4*cores)); None when unset or unparseable. Logged once
+    per process when first applied so a pipeline start always records
+    the effective decode parallelism."""
+    global _env_threads_logged
+    raw = os.environ.get(DECODE_THREADS_ENV)
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    cores = os.cpu_count() or 1
+    capped = max(1, min(v, MAX_DECODE_THREADS, 4 * cores))
+    if not _env_threads_logged:
+        _env_threads_logged = True
+        import logging
+
+        logging.getLogger("photon_ml_tpu.io.native").info(
+            "%s=%s -> %d decode threads (cores=%d, cap=%d)",
+            DECODE_THREADS_ENV, raw, capped, cores,
+            min(MAX_DECODE_THREADS, 4 * cores),
+        )
+        from photon_ml_tpu import obs
+
+        obs.emit_event(
+            "io.ingest.decode_threads_override",
+            cat="io",
+            requested=raw,
+            effective=capped,
+        )
+    return capped
+
+
 def _default_decode_threads(
     num_files: int, max_workers: Optional[int] = None
 ) -> int:
     """Block-decode threads per file: split the cores across CONCURRENTLY
     decoding files (files parallelize via ``_map_files``, capped by
-    ``max_workers``); a single file gets the whole machine."""
+    ``max_workers``); a single file gets the whole machine. A
+    ``PHOTON_DECODE_THREADS`` env override wins (capped; logged once)."""
+    env = _env_decode_threads()
+    if env is not None:
+        return env
     cores = os.cpu_count() or 1
     concurrent = min(num_files, cores, 16)
     if max_workers:
@@ -677,18 +766,15 @@ def scan_feature_keys(
     threads = _default_decode_threads(len(paths), max_workers)
 
     def scan_one(path: str) -> Tuple[List[str], int]:
-        reader = NativeAvroReader(
+        with NativeAvroReader(
             field_prog, feat_desc, vocabset, (), collect_keys=True
-        )
-        try:
+        ) as reader:
             reader.feed_file(
                 path, expected_schema=schema, decode_threads=threads
             )
             return reader.distinct_keys(), reader.num_records
-        finally:
-            reader.close()
 
-    try:
+    with vocabset:
         per_file = _map_files(paths, scan_one, max_workers)
         total = sum(n for _, n in per_file)
         if len(per_file) == 1:
@@ -697,8 +783,6 @@ def scan_feature_keys(
         for keys, _ in per_file:
             merged.update(keys)
         return list(merged), total
-    finally:
-        vocabset.close()
 
 
 # write ops (must mirror native/avro_reader.cpp)
@@ -933,10 +1017,9 @@ def read_columnar(
     )
 
     def read_one(path: str) -> Dict[str, object]:
-        reader = NativeAvroReader(
+        with NativeAvroReader(
             field_prog, feat_desc, vocabset, entity_keys
-        )
-        try:
+        ) as reader:
             reader.feed_file(
                 path, expected_schema=schema, decode_threads=threads
             )
@@ -945,17 +1028,13 @@ def read_columnar(
             return check_labels(
                 _extract_columns(reader, entity_keys, len(vocabs)), path
             )
-        finally:
-            reader.close()
 
-    try:
+    with vocabset:
         parts = _map_files(paths, read_one, max_workers)
-        if len(parts) == 1:
-            # common case: hand back the reader's arrays directly, no
-            # concatenate copies
-            return parts[0]
-    finally:
-        vocabset.close()
+    if len(parts) == 1:
+        # common case: hand back the reader's arrays directly, no
+        # concatenate copies
+        return parts[0]
 
     # concatenate in path order; COO row ids shift by the running total
     n = sum(p["n"] for p in parts)

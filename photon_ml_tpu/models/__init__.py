@@ -11,6 +11,7 @@ from photon_ml_tpu.models.training import (
     OptimizerType,
     TrainedModel,
     train_glm,
+    train_glm_streamed,
 )
 
 __all__ = [
@@ -20,6 +21,7 @@ __all__ = [
     "OptimizerType",
     "TrainedModel",
     "train_glm",
+    "train_glm_streamed",
     "bootstrap_train_glm",
     "BootstrapResult",
     "CoefficientSummary",

@@ -662,6 +662,127 @@ def _train_glm_scan(
     return [by_lambda[lam] for lam in config.reg_weights]
 
 
+def train_glm_streamed(
+    design,
+    config: GLMTrainingConfig,
+    initial_coefficients: Optional[Coefficients] = None,
+) -> Sequence[TrainedModel]:
+    """Out-of-core ``train_glm``: the design exceeds HBM, so every
+    objective evaluation STREAMS the host-resident chunks of a
+    :class:`photon_ml_tpu.io.pipeline.StreamedDesign` through the fused
+    per-chunk passes, accumulating exact value/grad/curvature partials
+    in a donated carry (``io.pipeline.StreamingObjective``). The
+    UNMODIFIED device solver loops drive it — inside their
+    ``lax.while_loop`` the sweep runs through ``jax.pure_callback`` —
+    so TRON / L-BFGS / OWL-QN see the exact full-dataset objective and
+    the trained models match the in-core path to <= 1e-10
+    (tests/test_pipeline.py, drilled across solvers and prefetch
+    depths).
+
+    Same contract as :func:`train_glm` (descending warm-started lambda
+    path, models reported in config order, variances from the streamed
+    Hessian diagonal) with out-of-core restrictions: dense chunked
+    designs only, ``normalization=NONE`` (a whitening summary would
+    itself need a streaming pass — not reproduced), no NEWTON (explicit
+    Hessians need the in-core design).
+    """
+    import numpy as np
+
+    from photon_ml_tpu.io.pipeline import StreamingObjective
+
+    config.validate()
+    if config.normalization != NormalizationType.NONE:
+        raise ValueError(
+            "train_glm_streamed supports normalization=NONE only (the "
+            "whitening summary needs its own streaming pass)"
+        )
+    if config.optimizer == OptimizerType.NEWTON:
+        raise ValueError(
+            "NEWTON materializes the explicit Hessian from the in-core "
+            "design; use TRON or LBFGS for out-of-core training"
+        )
+    loss = loss_for_task(config.task)
+    reg = config.regularization
+    scfg = config.solver_config()
+    use_owlqn = reg.reg_type in ("L1", "ELASTIC_NET")
+    use_tron = config.optimizer == OptimizerType.TRON
+    dtype = np.dtype(design.dtype)
+    if initial_coefficients is not None:
+        w = jnp.asarray(initial_coefficients.means, dtype)
+    else:
+        w = jnp.zeros((design.d,), dtype)
+
+    by_lambda = {}
+    for lam in sorted(config.reg_weights, reverse=True):
+        l1 = lam * reg.l1_weight(1.0)
+        l2 = lam * reg.l2_weight(1.0)
+        sobj = StreamingObjective(design, loss, l2_weight=l2)
+        with obs.span(
+            "glm.solve",
+            cat="solver",
+            optimizer=config.optimizer.name,
+            reg_weight=float(lam),
+            streamed=True,
+            chunks=design.num_chunks,
+        ) as sp:
+            tracer = obs.get_tracer()
+            t0 = time.perf_counter()
+            # disable_jit: the solver while_loops run as HOST loops, so
+            # each objective evaluation's chunk sweep executes directly
+            # on the calling thread. Wrapped in a compiled while_loop
+            # the sweep would run via pure_callback on a runtime
+            # callback thread, whose nested chunk dispatches can
+            # deadlock a single-threaded CPU executor (observed) — and
+            # out-of-core solves are sweep-bound anyway, so host-side
+            # solver control flow costs nothing measurable.
+            with jax.disable_jit():
+                if use_owlqn:
+                    result = minimize_owlqn(
+                        sobj.value_and_grad, w, l1, scfg
+                    )
+                elif use_tron:
+                    result = minimize_tron(
+                        sobj.value_and_grad, sobj.hessian_vector, w, scfg
+                    )
+                else:
+                    result = minimize_lbfgs(sobj.value_and_grad, w, scfg)
+            conv_enabled = (
+                tracer is not None or obs.convergence.tracking_enabled()
+            )
+            if conv_enabled:
+                sp.sync(result.w)
+                _record_solve_metrics(config, result)
+                report = obs.decode_result(
+                    result, optimizer=config.optimizer.name.lower()
+                )
+                obs.convergence.note_solve(
+                    report, label=f"lambda={float(lam):g} (streamed)"
+                )
+                sp.set(
+                    convergence_reason=report.reason,
+                    convergence_order=report.order,
+                    sweep_s=round(time.perf_counter() - t0, 4),
+                )
+        w = result.w  # warm start for the next (smaller) lambda
+        var = None
+        if config.compute_variances:
+            var = jnp.asarray(
+                1.0
+                / np.maximum(
+                    sobj.hessian_diagonal(np.asarray(result.w)),
+                    _VARIANCE_EPSILON,
+                ),
+                dtype,
+            )
+        # normalization is NONE: solved space IS raw feature space
+        coef = Coefficients(means=result.w, variances=var)
+        model = GeneralizedLinearModel(coefficients=coef, task=config.task)
+        by_lambda[lam] = TrainedModel(
+            reg_weight=lam, model=model, result=result
+        )
+    return [by_lambda[lam] for lam in config.reg_weights]
+
+
 def _train_glm_loop(
     batch: LabeledBatch,
     config: GLMTrainingConfig,

@@ -80,6 +80,14 @@ _DIRECTION_RULES = (
     ),
     (re.compile(r"scaling_efficiency$"), HIGHER_IS_BETTER),
     (re.compile(r"(iters_per_s|rec_per_s|per_s)$"), HIGHER_IS_BETTER),
+    # ingest pipeline (docs/INGEST.md): host->device bandwidth and the
+    # counted-stage overlap fraction rise as the feed improves; the
+    # epoch stall fraction (consumer time NOT covered by device math)
+    # falls. These gate the decode/transfer/solve overlap directly —
+    # wall clocks on a timeshared bench host cannot.
+    (re.compile(r"_gbps$"), HIGHER_IS_BETTER),
+    (re.compile(r"overlap_frac$"), HIGHER_IS_BETTER),
+    (re.compile(r"stall_frac$"), LOWER_IS_BETTER),
     (re.compile(r"(^|\.)mfu$"), HIGHER_IS_BETTER),
     (re.compile(r"hbm_util$"), HIGHER_IS_BETTER),
     (re.compile(r"achieved_tflops$"), HIGHER_IS_BETTER),

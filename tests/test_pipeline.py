@@ -1,0 +1,625 @@
+"""Streaming ingest->device pipeline (io.pipeline) + out-of-core epochs.
+
+The pipeline's contract is EQUIVALENCE under overlap: whatever the
+decode pool / staging ring / async prefetch reorder in time, the
+assembled dataset is bit-for-bit the one-shot read, a mid-stream fault
+costs a retry (never a duplicated or dropped chunk), and an out-of-core
+epoch computes the exact full-dataset objective (in-core solve match
+<= 1e-10 across solvers and prefetch depths) — docs/INGEST.md.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.avro import write_avro_file
+from photon_ml_tpu.io.ingest import IngestSource, make_training_example
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+from photon_ml_tpu.io.vocab import FeatureVocabulary
+
+native = pytest.importorskip("photon_ml_tpu.io.native")
+from photon_ml_tpu.io.pipeline import (  # noqa: E402 — after the skip
+    IngestPipeline,
+    PipelineConfig,
+    PipelineStats,
+    StreamedDesign,
+    plan_file_groups,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.native_available(),
+    reason=f"native reader unavailable: {native.native_error()}",
+)
+
+D = 60
+
+
+def _records(n, seed=0, with_meta=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        feats = {
+            (f"f{j}", "t"): float(rng.standard_normal())
+            for j in rng.choice(D, 6, replace=False)
+        }
+        rec = make_training_example(
+            label=float(rng.integers(0, 2)),
+            features=feats,
+            uid=f"u{i}" if i % 3 else None,
+            offset=float(rng.standard_normal()) if i % 2 else None,
+            weight=float(rng.uniform(0.5, 2.0)) if i % 5 else None,
+        )
+        if with_meta:
+            rec["metadataMap"] = (
+                {"userId": f"user{i % 7}"} if i % 4 else None
+            )
+        out.append(rec)
+    return out
+
+
+def _vocab():
+    return FeatureVocabulary(
+        [f"f{i}\x01t" for i in range(D)], add_intercept=True
+    )
+
+
+@pytest.fixture()
+def part_files(tmp_path):
+    """Four part files with awkward, distinct row counts."""
+    paths = []
+    for i, n in enumerate([151, 89, 203, 57]):
+        p = str(tmp_path / f"part-{i}.avro")
+        write_avro_file(
+            p,
+            TRAINING_EXAMPLE_SCHEMA,
+            _records(n, seed=10 + i, with_meta=True),
+            codec="deflate",
+        )
+        paths.append(p)
+    return paths
+
+
+def _assert_batches_equal(a, b):
+    np.testing.assert_array_equal(
+        np.asarray(a.features), np.asarray(b.features)
+    )
+    for f in ("labels", "offsets", "weights", "mask"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        )
+
+
+class TestPlanning:
+    def test_groups_respect_budget_and_order(self, part_files):
+        groups = plan_file_groups(part_files, chunk_mb=0.01)
+        # tiny budget: every file its own group, original order
+        assert [g for group in groups for g in group] == part_files
+        assert all(len(g) == 1 for g in groups)
+        one = plan_file_groups(part_files, chunk_mb=1024)
+        assert one == [part_files]
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            PipelineConfig(chunk_mb=0).validate()
+        with pytest.raises(ValueError):
+            PipelineConfig(prefetch_depth=0).validate()
+        with pytest.raises(ValueError):
+            PipelineConfig(decode_threads=-1).validate()
+
+    def test_overlap_frac_sweep_line(self):
+        s = PipelineStats()
+        s.note("decode", 1.0, t0=0.0)
+        s.note("stage", 1.0, t0=0.5)
+        # [0,1.5] covered, [0.5,1.0] doubly covered
+        assert s.overlap_frac() == pytest.approx(1.0 / 3.0)
+        serial = PipelineStats()
+        serial.note("decode", 1.0, t0=0.0)
+        serial.note("stage", 1.0, t0=1.0)
+        assert serial.overlap_frac() == 0.0
+
+
+@needs_native
+class TestPipelineAssembly:
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_bit_for_bit_across_prefetch_depths(self, part_files, depth):
+        """The acceptance drill: streamed pipeline output == one-shot
+        labeled_batch exactly, at every prefetch depth."""
+        vocab = _vocab()
+        whole, uids_w, pres_w = IngestSource(part_files).labeled_batch(
+            vocab, dtype=np.float64
+        )
+        cfg = PipelineConfig(
+            chunk_mb=0.02, decode_threads=2, prefetch_depth=depth
+        )
+        with IngestPipeline(part_files, [vocab], config=cfg) as pipe:
+            batch, uids, pres = pipe.labeled_batch(dtype=np.float64)
+            assert len(pipe.groups) > 1  # the pool had real work
+        _assert_batches_equal(batch, whole)
+        assert list(uids) == list(uids_w)
+        np.testing.assert_array_equal(pres, pres_w)
+
+    def test_streamed_ingest_source_delegates(self, part_files):
+        """IngestSource.labeled_batch_streamed (the driver surface) now
+        rides the pipeline and keeps its old contract."""
+        vocab = _vocab()
+        whole, uids_w, _ = IngestSource(part_files).labeled_batch(
+            vocab, dtype=np.float64
+        )
+        streamed, uids, _ = IngestSource(part_files).labeled_batch_streamed(
+            vocab, dtype=np.float64, chunk_mb=0.02, prefetch_depth=2
+        )
+        _assert_batches_equal(streamed, whole)
+        assert list(uids) == list(uids_w)
+
+    def test_game_data_streamed_matches(self, part_files):
+        vocab = _vocab()
+        src_a = IngestSource(part_files)
+        a, vocabs_a, uids_a, pres_a = src_a.game_data(
+            {"global": vocab}, ["userId"]
+        )
+        src_b = IngestSource(part_files)
+        b, vocabs_b, uids_b, pres_b = src_b.game_data_streamed(
+            {"global": vocab}, ["userId"], chunk_mb=0.02
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.features["global"]),
+            np.asarray(b.features["global"]),
+        )
+        for f in ("labels", "offsets", "weights"):
+            np.testing.assert_array_equal(
+                getattr(a, f), getattr(b, f)
+            )
+        np.testing.assert_array_equal(
+            a.entity_ids["userId"], b.entity_ids["userId"]
+        )
+        assert vocabs_a == vocabs_b
+        assert list(uids_a) == list(uids_b)
+        np.testing.assert_array_equal(pres_a, pres_b)
+
+    def test_pipeline_metrics_and_stats(self, part_files):
+        from photon_ml_tpu import obs
+        from photon_ml_tpu.obs.metrics import MetricsRegistry
+
+        vocab = _vocab()
+        reg = MetricsRegistry()
+        prev = obs.set_registry(reg)
+        try:
+            with IngestPipeline(
+                part_files, [vocab],
+                config=PipelineConfig(chunk_mb=0.02),
+            ) as pipe:
+                pipe.labeled_batch(dtype=np.float64)
+                stats = pipe.stats.snapshot()
+        finally:
+            obs.set_registry(prev)
+        assert stats["records"] == 500
+        assert stats["chunks"] >= 2
+        assert stats["bytes_to_device"] > 0
+        assert stats["wall_s"] > 0
+        snap = reg.snapshot()
+        assert snap["counters"]["ingest.pipeline.records"] == 500
+        assert snap["counters"]["ingest.pipeline.chunks"] == stats["chunks"]
+        assert "ingest.pipeline.decode_ms" in snap["histograms"]
+        assert "ingest.pipeline.transfer_ms" in snap["histograms"]
+
+    def test_empty_input_raises(self, tmp_path):
+        p = str(tmp_path / "empty.avro")
+        write_avro_file(p, TRAINING_EXAMPLE_SCHEMA, [], codec="deflate")
+        with IngestPipeline([p], [_vocab()]) as pipe:
+            with pytest.raises(ValueError, match="no records"):
+                pipe.labeled_batch(dtype=np.float64)
+
+    def test_null_label_policy(self, tmp_path):
+        schema = dict(TRAINING_EXAMPLE_SCHEMA)
+        schema["fields"] = [
+            (
+                {
+                    "name": "label",
+                    "type": ["null", "double"],
+                    "default": None,
+                }
+                if f["name"] == "label"
+                else f
+            )
+            for f in TRAINING_EXAMPLE_SCHEMA["fields"]
+        ]
+        recs = _records(20, seed=1)
+        recs[7]["label"] = None
+        p = str(tmp_path / "nulls.avro")
+        write_avro_file(p, schema, recs, codec="deflate")
+        with IngestPipeline([p], [_vocab()]) as pipe:
+            with pytest.raises(ValueError, match="null/missing label"):
+                pipe.labeled_batch(dtype=np.float64)
+        with IngestPipeline(
+            [p], [_vocab()], allow_null_labels=True
+        ) as pipe:
+            batch, _, present = pipe.labeled_batch(dtype=np.float64)
+            assert batch.batch_size == 20
+            assert not present[7]
+
+
+@needs_native
+class TestFaultInjection:
+    def test_mid_stream_retry_no_dup_no_drop(self, part_files):
+        """A transient decode failure mid-stream retries through the
+        ingest.read seam and the assembled batch is IDENTICAL — no
+        chunk duplicated, none dropped."""
+        from photon_ml_tpu import obs
+        from photon_ml_tpu.obs.metrics import MetricsRegistry
+        from photon_ml_tpu.resilience.faults import FaultSpec, inject
+
+        vocab = _vocab()
+        whole, uids_w, _ = IngestSource(part_files).labeled_batch(
+            vocab, dtype=np.float64
+        )
+        reg = MetricsRegistry()
+        prev = obs.set_registry(reg)
+        try:
+            # 3rd probe of ingest.read = a mid-stream decode group
+            with inject(FaultSpec("ingest.read", "raise", nth=3)):
+                with IngestPipeline(
+                    part_files, [vocab],
+                    config=PipelineConfig(chunk_mb=0.02, decode_threads=2),
+                ) as pipe:
+                    batch, uids, _ = pipe.labeled_batch(dtype=np.float64)
+        finally:
+            obs.set_registry(prev)
+        _assert_batches_equal(batch, whole)
+        assert list(uids) == list(uids_w)
+        assert reg.snapshot()["counters"]["resilience.faults_injected"] == 1
+
+    def test_exhausted_retries_propagate_and_release_handles(
+        self, part_files
+    ):
+        from photon_ml_tpu.resilience.faults import FaultSpec, inject
+        from photon_ml_tpu.resilience.retry import RetryBudgetExceeded
+
+        vocab = _vocab()
+        with inject(
+            FaultSpec("ingest.read", "raise", nth=1, count=-1)
+        ):
+            with IngestPipeline(
+                part_files, [vocab],
+                config=PipelineConfig(chunk_mb=0.02, decode_threads=2),
+            ) as pipe:
+                with pytest.raises(RetryBudgetExceeded):
+                    pipe.labeled_batch(dtype=np.float64)
+        assert native.live_native_handles() == 0
+
+
+@needs_native
+class TestHandleCensus:
+    def test_no_leaked_handles_across_entry_points(self, part_files):
+        """The handle-count regression drill: threaded decode creates
+        one reader per (chunk, attempt) — every entry point must return
+        the census to zero (context-managed close, not __del__)."""
+        import gc
+
+        vocab = _vocab()
+        base = native.live_native_handles()
+        assert base == 0
+        src = IngestSource(part_files)
+        src.build_vocab()
+        src.labeled_batch(vocab)
+        src.labeled_batch_streamed(vocab, chunk_mb=0.02)
+        src.game_data_streamed({"global": vocab}, ["userId"])
+        with IngestPipeline(
+            part_files, [vocab], config=PipelineConfig(chunk_mb=0.02)
+        ) as pipe:
+            for _ in pipe.parts():
+                pass
+        gc.collect()
+        assert native.live_native_handles() == 0
+
+    def test_context_managers(self, part_files):
+        schema = native._read_header_schema(part_files[0])
+        fp, fd = native.compile_schema(schema, label_field="label")
+        with native.NativeVocabSet([], []) as vs:
+            with native.NativeAvroReader(fp, fd, vs, ()) as reader:
+                reader.feed_file(part_files[0])
+                assert reader.num_records > 0
+                assert native.live_native_handles() == 2
+            assert native.live_native_handles() == 1
+        assert native.live_native_handles() == 0
+
+
+class TestDecodeThreadsEnv:
+    def test_env_override_capped(self, monkeypatch):
+        monkeypatch.setattr(native, "_env_threads_logged", True)
+        monkeypatch.setenv(native.DECODE_THREADS_ENV, "3")
+        assert native._default_decode_threads(8) == 3
+        monkeypatch.setenv(native.DECODE_THREADS_ENV, "100000")
+        cores = os.cpu_count() or 1
+        assert native._default_decode_threads(8) == min(
+            native.MAX_DECODE_THREADS, 4 * cores
+        )
+        monkeypatch.setenv(native.DECODE_THREADS_ENV, "not-a-number")
+        # unparseable -> auto heuristic, never a crash
+        assert native._default_decode_threads(1) >= 1
+        monkeypatch.delenv(native.DECODE_THREADS_ENV)
+        assert native._default_decode_threads(1) >= 1
+
+    def test_override_logged_once(self, monkeypatch, caplog):
+        import logging
+
+        monkeypatch.setattr(native, "_env_threads_logged", False)
+        monkeypatch.setenv(native.DECODE_THREADS_ENV, "2")
+        with caplog.at_level(logging.INFO, "photon_ml_tpu.io.native"):
+            native._default_decode_threads(4)
+            native._default_decode_threads(4)
+        hits = [
+            r for r in caplog.records
+            if native.DECODE_THREADS_ENV in r.getMessage()
+        ]
+        assert len(hits) == 1
+
+    @needs_native
+    def test_pipeline_workers_honor_override(
+        self, part_files, monkeypatch
+    ):
+        monkeypatch.setattr(native, "_env_threads_logged", True)
+        monkeypatch.setenv(native.DECODE_THREADS_ENV, "2")
+        with IngestPipeline(
+            part_files, [_vocab()],
+            config=PipelineConfig(chunk_mb=0.02),
+        ) as pipe:
+            assert pipe.decode_workers == 2
+
+
+def _dense_batch(n=260, d=14, seed=0):
+    from photon_ml_tpu.core.types import LabeledBatch
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d))
+    logits = 0.7 * x[:, 0] - 0.4 * x[:, 1]
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(
+        float
+    )
+    w = rng.uniform(0.5, 2.0, size=n)
+    off = rng.standard_normal(n) * 0.1
+    return LabeledBatch.create(
+        x, y, offsets=off, weights=w, dtype=np.float64
+    )
+
+
+class TestOutOfCore:
+    """Out-of-core streamed epochs == the in-core solve, <= 1e-10."""
+
+    @pytest.mark.parametrize("optimizer", ["TRON", "LBFGS"])
+    @pytest.mark.parametrize("rows_per_chunk", [64, 97, 260])
+    def test_matches_in_core(self, optimizer, rows_per_chunk):
+        from photon_ml_tpu.models.glm import TaskType
+        from photon_ml_tpu.models.training import (
+            GLMTrainingConfig,
+            OptimizerType,
+            train_glm,
+            train_glm_streamed,
+        )
+        from photon_ml_tpu.ops.objective import RegularizationContext
+
+        batch = _dense_batch()
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType[optimizer],
+            regularization=RegularizationContext("L2"),
+            reg_weights=(1.0, 0.1),
+            max_iters=80,
+            tolerance=1e-12,
+            compute_variances=True,
+        )
+        incore = train_glm(batch, cfg)
+        design = StreamedDesign.from_batch(
+            batch, rows_per_chunk=rows_per_chunk
+        )
+        streamed = train_glm_streamed(design, cfg)
+        for a, b in zip(incore, streamed):
+            np.testing.assert_allclose(
+                np.asarray(b.model.coefficients.means),
+                np.asarray(a.model.coefficients.means),
+                atol=1e-10,
+                rtol=0,
+            )
+            np.testing.assert_allclose(
+                np.asarray(b.model.coefficients.variances),
+                np.asarray(a.model.coefficients.variances),
+                atol=1e-10,
+                rtol=0,
+            )
+
+    def test_owlqn_l1_matches(self):
+        from photon_ml_tpu.models.glm import TaskType
+        from photon_ml_tpu.models.training import (
+            GLMTrainingConfig,
+            OptimizerType,
+            train_glm,
+            train_glm_streamed,
+        )
+        from photon_ml_tpu.ops.objective import RegularizationContext
+
+        batch = _dense_batch(seed=3)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L1"),
+            reg_weights=(0.3,),
+            max_iters=100,
+            tolerance=1e-12,
+        )
+        (a,) = train_glm(batch, cfg)
+        (b,) = train_glm_streamed(
+            StreamedDesign.from_batch(batch, rows_per_chunk=80), cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(b.model.coefficients.means),
+            np.asarray(a.model.coefficients.means),
+            atol=1e-10,
+            rtol=0,
+        )
+
+    def test_streaming_objective_exact(self):
+        """Each streamed evaluation is the exact full-dataset quantity
+        (row sums reassociated across chunk boundaries only)."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.io.pipeline import StreamingObjective
+        from photon_ml_tpu.models.glm import TaskType
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.ops.objective import GLMObjective
+
+        batch = _dense_batch(seed=5)
+        loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+        obj = GLMObjective(loss=loss, l2_weight=0.7)
+        sobj = StreamingObjective(
+            StreamedDesign.from_batch(batch, rows_per_chunk=50),
+            loss,
+            l2_weight=0.7,
+        )
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.standard_normal(batch.num_features))
+        v = jnp.asarray(rng.standard_normal(batch.num_features))
+        val_i, grad_i = obj.value_and_grad(w, batch)
+        val_s, grad_s = sobj.value_and_grad(w)
+        np.testing.assert_allclose(
+            float(val_s), float(val_i), rtol=1e-13
+        )
+        np.testing.assert_allclose(
+            np.asarray(grad_s), np.asarray(grad_i), atol=1e-12
+        )
+        hv_i = obj.hessian_vector(w, v, batch)
+        hv_s = sobj.hessian_vector(w, v)
+        np.testing.assert_allclose(
+            np.asarray(hv_s), np.asarray(hv_i), atol=1e-12
+        )
+        diag_i = obj.hessian_diagonal(w, batch)
+        diag_s = sobj.hessian_diagonal(np.asarray(w))
+        np.testing.assert_allclose(
+            np.asarray(diag_s), np.asarray(diag_i), atol=1e-12
+        )
+        # epoch accounting: 4 sweeps streamed the whole design each time
+        assert sobj.stats.bytes_to_device > 0
+
+    def test_warm_start_and_order(self):
+        """Models report in config order; warm start accepted."""
+        from photon_ml_tpu.core.types import Coefficients
+        from photon_ml_tpu.models.glm import TaskType
+        from photon_ml_tpu.models.training import (
+            GLMTrainingConfig,
+            OptimizerType,
+            train_glm_streamed,
+        )
+        from photon_ml_tpu.ops.objective import RegularizationContext
+
+        batch = _dense_batch(seed=7)
+        design = StreamedDesign.from_batch(batch, rows_per_chunk=90)
+        cfg = GLMTrainingConfig(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer=OptimizerType.LBFGS,
+            regularization=RegularizationContext("L2"),
+            reg_weights=(0.1, 10.0),  # ascending input order
+            max_iters=60,
+            tolerance=1e-10,
+        )
+        models = train_glm_streamed(design, cfg)
+        assert [m.reg_weight for m in models] == [0.1, 10.0]
+        warm = train_glm_streamed(
+            design,
+            cfg,
+            initial_coefficients=Coefficients(
+                means=models[0].model.coefficients.means
+            ),
+        )
+        assert len(warm) == 2
+
+    def test_rejects_unsupported_configs(self):
+        from photon_ml_tpu.core.normalization import NormalizationType
+        from photon_ml_tpu.models.glm import TaskType
+        from photon_ml_tpu.models.training import (
+            GLMTrainingConfig,
+            OptimizerType,
+            train_glm_streamed,
+        )
+        from photon_ml_tpu.ops.objective import RegularizationContext
+
+        batch = _dense_batch(n=60)
+        design = StreamedDesign.from_batch(batch, rows_per_chunk=30)
+        with pytest.raises(ValueError, match="normalization"):
+            train_glm_streamed(
+                design,
+                GLMTrainingConfig(
+                    task=TaskType.LOGISTIC_REGRESSION,
+                    normalization=(
+                        NormalizationType.SCALE_WITH_STANDARD_DEVIATION
+                    ),
+                ),
+            )
+        with pytest.raises(ValueError, match="NEWTON"):
+            train_glm_streamed(
+                design,
+                GLMTrainingConfig(
+                    task=TaskType.LOGISTIC_REGRESSION,
+                    optimizer=OptimizerType.NEWTON,
+                    regularization=RegularizationContext("L2"),
+                ),
+            )
+
+    @needs_native
+    def test_from_pipeline_matches_from_batch(self, part_files):
+        """The decode->stage->design path carries the same rows as the
+        in-core batch split."""
+        vocab = _vocab()
+        whole, _, _ = IngestSource(part_files).labeled_batch(
+            vocab, dtype=np.float64
+        )
+        with IngestPipeline(
+            part_files, [vocab], config=PipelineConfig(chunk_mb=0.02)
+        ) as pipe:
+            design = StreamedDesign.from_pipeline(
+                pipe, dtype=np.float64, rows_per_chunk=128
+            )
+        oracle = StreamedDesign.from_batch(whole, rows_per_chunk=128)
+        assert design.n == oracle.n
+        assert design.num_chunks == oracle.num_chunks
+        for a, b in zip(design.chunks, oracle.chunks):
+            for k in ("features", "labels", "offsets", "weights", "mask"):
+                np.testing.assert_array_equal(a[k], b[k])
+
+
+class TestGlmDriverOutOfCore:
+    @needs_native
+    def test_driver_out_of_core_matches_in_core(self, tmp_path):
+        """End-to-end: the --out-of-core driver trains the same model
+        the in-core driver does."""
+        from photon_ml_tpu.cli.train import run_glm_training
+
+        recs = _records(240, seed=21)
+        data = str(tmp_path / "train.avro")
+        write_avro_file(data, TRAINING_EXAMPLE_SCHEMA, recs, codec="deflate")
+        base = dict(
+            train_input=[data],
+            task="LOGISTIC_REGRESSION",
+            optimizer="LBFGS",
+            reg_type="L2",
+            reg_weights=[1.0],
+            max_iters=60,
+            tolerance=1e-10,
+            log_level="WARN",
+        )
+        run_a = run_glm_training(
+            dict(base, output_dir=str(tmp_path / "incore"))
+        )
+        run_b = run_glm_training(
+            dict(
+                base,
+                output_dir=str(tmp_path / "oocore"),
+                out_of_core=True,
+                ingest_chunk_mb=0.02,
+            )
+        )
+        assert run_b.num_training_rows == run_a.num_training_rows
+        np.testing.assert_allclose(
+            np.asarray(run_b.models[0].model.coefficients.means),
+            np.asarray(run_a.models[0].model.coefficients.means),
+            atol=1e-10,
+            rtol=0,
+        )
