@@ -1803,6 +1803,53 @@ def bench_ingest_pipeline():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_overload():
+    """Serving under deliberate overload (docs/ROBUSTNESS.md): an
+    open-loop submitter floods a bounded micro-batcher whose service
+    rate is capped, with per-request deadlines and a priority sprinkle.
+    Sentinel-tracked: ``serving_shed_frac`` (lower — less load turned
+    away for the same offered load), ``p99_under_overload_ms`` (lower —
+    what DID score met its promise), ``breaker_recovery_s`` (lower —
+    open -> probe -> reclosed wall for the reload circuit breaker).
+    The hard invariants (zero lost requests, shed only expired/
+    over-budget) are asserted by the drill, not just recorded."""
+    from photon_ml_tpu.resilience.drills import breaker_drill, overload_run
+
+    out = overload_run(total=1200)
+    assert out["lost"] == 0, f"overload run lost requests: {out}"
+    assert out["errors"] == 0, f"overload run errored: {out}"
+    log(
+        f"serving overload: {out['submitted']} submitted -> "
+        f"{out['scored']} scored / {out['expired']} expired / "
+        f"{out['shed']} shed / {out['rejected']} rejected "
+        f"(shed_frac {out['serving_shed_frac']:.3f}), p99 "
+        f"{out['p99_under_overload_ms']:.2f}ms vs unloaded "
+        f"{out['unloaded_p99_ms']:.2f}ms (deadline "
+        f"{out['deadline_ms']:.1f}ms), degraded_batches "
+        f"{out['degraded_batches']}"
+    )
+    brk = breaker_drill(threshold=2, backoff_s=0.25)
+    log(
+        f"serving breaker: opened after {brk['reload_failures']} failed "
+        f"reloads, recovered in {brk['breaker_recovery_s']:.2f}s with "
+        f"{brk['client_scores']} in-flight scores and "
+        f"{brk['client_errors']} errors"
+    )
+    return {
+        "serving_shed_frac": out["serving_shed_frac"],
+        "p99_under_overload_ms": out["p99_under_overload_ms"],
+        "unloaded_p99_ms": out["unloaded_p99_ms"],
+        "deadline_ms": out["deadline_ms"],
+        "scored": out["scored"],
+        "expired": out["expired"],
+        "shed": out["shed"],
+        "rejected": out["rejected"],
+        "degraded_batches": out["degraded_batches"],
+        "breaker_recovery_s": brk["breaker_recovery_s"],
+        "breaker_reload_failures": brk["reload_failures"],
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -1882,6 +1929,7 @@ def main():
     sparse_scaling = _phase("sparse_scaling_cpu", _sparse_scaling_cpu)
     ingest = _phase("ingest", bench_ingest)
     ingest_pipe = _phase("ingest_pipeline", bench_ingest_pipeline)
+    overload = _phase("serving_overload", bench_overload)
 
     extra = {
         **rtt,
@@ -2001,6 +2049,14 @@ def main():
         )
     if ingest:
         extra["ingest_vs_python_codec"] = round(ingest["speedup"], 1)
+    if overload:
+        # chaos-hardened serving (docs/ROBUSTNESS.md): shed fraction and
+        # loaded p99 under a fixed offered overload, breaker recovery
+        # wall — all sentinel-tracked (shed_frac/_ms/_s direction rules)
+        extra["serving_overload"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in overload.items()
+        }
     # where the bench run's own wall clock went + the final metrics
     # registry (solver iteration counters, ingest/checkpoint bytes,
     # recompiles when the compile listener was installed) + the XLA

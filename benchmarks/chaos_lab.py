@@ -1,0 +1,105 @@
+"""Scripted chaos lab: execute the drill schedule, emit a JSON report.
+
+Runs every scripted drill in :mod:`photon_ml_tpu.resilience.drills`
+against training + serving smoke workloads and asserts the recovery
+invariants (docs/ROBUSTNESS.md): every fault site fires and recovers per
+its policy, an overload run sheds only expired/over-budget requests,
+breaker quarantine keeps the last-good model serving with zero dropped
+in-flight requests, checkpoints stay restorable, and training results
+are bit-equal where faults were fully recovered.
+
+    JAX_PLATFORMS=cpu python benchmarks/chaos_lab.py --smoke
+
+Prints one BENCH-style record line (metric ``chaos_drills_passed``) plus
+the per-drill report; ``--report out.json`` writes the full report.
+Exit status: 0 when every executed drill passed, 1 otherwise (skipped
+drills — missing native reader — are reported, not failed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python benchmarks/chaos_lab.py` from the repo root
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(prog="benchmarks/chaos_lab.py")
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="tiny CPU-safe configuration (forces the CPU backend)",
+    )
+    p.add_argument(
+        "--drill", action="append", dest="drills",
+        help="run only this drill (repeatable; default: all)",
+    )
+    p.add_argument("--report", help="write the full JSON report here")
+    p.add_argument(
+        "--list", action="store_true", help="list drills and exit"
+    )
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    # the equivalence drills assert at 1e-10, which needs f64 solves
+    jax.config.update("jax_enable_x64", True)
+
+    from photon_ml_tpu.resilience import drills
+
+    if args.list:
+        for name in drills.DRILLS:
+            print(name)
+        return {}
+
+    t0 = time.perf_counter()
+    report = drills.run_drills(
+        smoke=args.smoke,
+        include=args.drills,
+        logger=lambda line: print(line, file=sys.stderr),
+    )
+    wall = time.perf_counter() - t0
+    record = {
+        "metric": "chaos_drills_passed",
+        "value": report["passed"],
+        "unit": "drills",
+        "extra": {
+            "ran": report["ran"],
+            "skipped": report["skipped"],
+            "wall_s": round(wall, 2),
+            **{
+                d["name"]: (
+                    {"skipped": True, "reason": d["reason"]}
+                    if d["skipped"]
+                    else {**d["details"], "duration_s": d["duration_s"]}
+                    if d["passed"]
+                    else {"FAILED": d["reason"]}
+                )
+                for d in report["drills"]
+            },
+        },
+    }
+    print(json.dumps(record))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
+    if not report["ok"]:
+        failed = [
+            d["name"] for d in report["drills"]
+            if not d["skipped"] and not d["passed"]
+        ]
+        print(f"FAILED drills: {failed}", file=sys.stderr)
+        sys.exit(1)
+    return report
+
+
+if __name__ == "__main__":
+    run()

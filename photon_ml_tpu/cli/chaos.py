@@ -1,0 +1,145 @@
+"""photon-chaos: operator tools for the runtime fault-injection layer.
+
+The chaos layer (docs/ROBUSTNESS.md) is only worth its overhead if
+operators can actually DRIVE it: list what's drillable, validate a fault
+schedule before pointing it at a real job, and run the scripted drill
+suite on the deployment host.
+
+    # what can be drilled, and what's currently armed
+    python -m photon_ml_tpu.cli.chaos sites
+
+    # validate a PHOTON_FAULTS schedule (parse + site check, no arming)
+    python -m photon_ml_tpu.cli.chaos plan \
+        "serving.reload:raise@n=1,count=3;pipeline.decode:delay@p=0.05,seed=7"
+
+    # run the scripted drills (the chaos_lab schedule) on this host
+    python -m photon_ml_tpu.cli.chaos drill --smoke --report drills.json
+
+``plan`` exits 2 on a schedule that would not arm — an unknown site or
+bad grammar; since arm-time validation landed, a typo'd site raises
+instead of silently drilling nothing, and ``plan`` is the preflight
+that catches it before the job launches. ``drill`` exits 1 when any
+executed drill fails (skips — e.g. no native reader — are reported).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_sites(args) -> int:
+    from photon_ml_tpu.resilience import faults
+
+    out = {
+        "known_sites": list(faults.known_sites()),
+        "armed": {
+            site: [
+                {
+                    "mode": s.mode,
+                    "nth": s.nth,
+                    "count": s.count,
+                    "p": s.p,
+                    "key": s.key,
+                }
+                for s in specs
+            ]
+            for site, specs in faults.registry._specs.items()
+        },
+        "env": faults.ENV_VAR,
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_plan(args) -> int:
+    from photon_ml_tpu.resilience import faults
+
+    try:
+        specs = faults.parse_spec(args.schedule)
+        # arm against a THROWAWAY injector: full arm-time validation
+        # (site + mode + trigger) without touching the live registry
+        probe = faults.FaultInjector()
+        for s in specs:
+            probe.arm(s)
+    except ValueError as e:
+        print(f"INVALID schedule: {e}", file=sys.stderr)
+        return 2
+    print(
+        json.dumps(
+            {
+                "valid": True,
+                "specs": [
+                    {
+                        "site": s.site,
+                        "mode": s.mode,
+                        "nth": s.nth,
+                        "count": s.count,
+                        "p": s.p,
+                        "seed": s.seed,
+                        "delay": s.delay,
+                        "key": s.key,
+                    }
+                    for s in specs
+                ],
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def _cmd_drill(args) -> int:
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from photon_ml_tpu.resilience import drills
+
+    report = drills.run_drills(
+        smoke=args.smoke,
+        include=args.drills,
+        logger=lambda line: print(line, file=sys.stderr),
+    )
+    print(json.dumps(report, indent=2))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
+    return 0 if report["ok"] else 1
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        prog="photon-chaos",
+        description="Operator tools for the fault-injection layer "
+        "(docs/ROBUSTNESS.md).",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("sites", help="list drillable sites + armed specs")
+
+    pp = sub.add_parser(
+        "plan", help="validate a PHOTON_FAULTS schedule without arming"
+    )
+    pp.add_argument("schedule", help="the PHOTON_FAULTS spec string")
+
+    pd = sub.add_parser("drill", help="run the scripted drill schedule")
+    pd.add_argument("--smoke", action="store_true",
+                    help="tiny CPU-safe configuration")
+    pd.add_argument("--drill", action="append", dest="drills",
+                    help="run only this drill (repeatable)")
+    pd.add_argument("--report", help="write the JSON report here")
+
+    args = p.parse_args(argv)
+    rc = {"sites": _cmd_sites, "plan": _cmd_plan, "drill": _cmd_drill}[
+        args.cmd
+    ](args)
+    if rc:
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

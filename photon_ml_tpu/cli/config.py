@@ -61,6 +61,13 @@ class GLMDriverParams:
     ingest_chunk_mb: float = 64.0
     decode_threads: int = 0
     prefetch_depth: int = 2
+    # pipeline supervision (docs/ROBUSTNESS.md): per-stage watchdog
+    # deadline in seconds (a decode/stage/transfer attempt stalled past
+    # it is cancelled and re-run through the retry seam; 0/None = off),
+    # and what an EXHAUSTED retry budget does to the epoch — "fail"
+    # raises, "skip" logs+counts the lost group and continues
+    stage_timeout_s: Optional[float] = None
+    epoch_policy: str = "fail"
     # with sparse=True: densify the hottest columns into an MXU slab and
     # keep only the power-law tail in the ELL scatter path (ops.sparse
     # HybridFeatures). 0 = off, -1 = auto (count-threshold split), N > 0 =
@@ -155,6 +162,15 @@ class GLMDriverParams:
         if self.prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
+            )
+        if self.stage_timeout_s is not None and self.stage_timeout_s < 0:
+            raise ValueError(
+                f"stage_timeout_s must be >= 0, got {self.stage_timeout_s}"
+            )
+        if self.epoch_policy not in ("fail", "skip"):
+            raise ValueError(
+                f"epoch_policy must be 'fail' or 'skip', got "
+                f"{self.epoch_policy!r}"
             )
         if self.out_of_core:
             if self.sparse:
@@ -351,6 +367,11 @@ class GameDriverParams:
     ingest_chunk_mb: float = 64.0
     decode_threads: int = 0
     prefetch_depth: int = 2
+    # pipeline supervision (docs/ROBUSTNESS.md): stage watchdog deadline
+    # (seconds; 0/None = off) and the exhausted-retry epoch policy
+    # ("fail" | "skip")
+    stage_timeout_s: Optional[float] = None
+    epoch_policy: str = "fail"
     # observability (docs/OBSERVABILITY.md): span tracer output directory
     # (Chrome trace-event JSON + events.jsonl + metrics.json), periodic
     # metrics-registry snapshot interval in seconds (0 = final-only), and
@@ -480,6 +501,15 @@ class GameDriverParams:
         if self.prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
+            )
+        if self.stage_timeout_s is not None and self.stage_timeout_s < 0:
+            raise ValueError(
+                f"stage_timeout_s must be >= 0, got {self.stage_timeout_s}"
+            )
+        if self.epoch_policy not in ("fail", "skip"):
+            raise ValueError(
+                f"epoch_policy must be 'fail' or 'skip', got "
+                f"{self.epoch_policy!r}"
             )
         if self.convergence_tolerance < 0:
             raise ValueError(

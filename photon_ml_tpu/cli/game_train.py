@@ -591,6 +591,8 @@ def _run_game_training(
                     chunk_mb=params.ingest_chunk_mb,
                     decode_threads=params.decode_threads,
                     prefetch_depth=params.prefetch_depth,
+                    stage_timeout_s=params.stage_timeout_s,
+                    epoch_policy=params.epoch_policy,
                 )
             )
         else:
@@ -1140,6 +1142,16 @@ def main(argv=None) -> None:
         help="ingest pipeline: chunks decode may run ahead of the "
         "consumer (default 2)",
     )
+    p.add_argument(
+        "--stage-timeout-s", type=float, default=None,
+        help="ingest pipeline watchdog: cancel+retry a decode attempt "
+        "stalled past this many seconds (default: off)",
+    )
+    p.add_argument(
+        "--epoch-policy", choices=["fail", "skip"], default=None,
+        help="exhausted ingest retries: fail the run (default) or "
+        "skip-and-log the lost group (docs/ROBUSTNESS.md)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1179,6 +1191,10 @@ def main(argv=None) -> None:
         base["decode_threads"] = args.decode_threads
     if args.prefetch_depth is not None:
         base["prefetch_depth"] = args.prefetch_depth
+    if args.stage_timeout_s is not None:
+        base["stage_timeout_s"] = args.stage_timeout_s
+    if args.epoch_policy is not None:
+        base["epoch_policy"] = args.epoch_policy
     run_game_training(base)
 
 

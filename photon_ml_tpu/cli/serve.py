@@ -13,21 +13,37 @@ or as a TCP socket server (one JSON object per line per connection):
 Protocol (one JSON object per line):
 
     {"features": {"age": 0.7, "ctr\\u0001day7": 1.2},
-     "entities": {"userId": "u123"}, "offset": 0.0}
+     "entities": {"userId": "u123"}, "offset": 0.0,
+     "deadline_ms": 50, "priority": 1}
         -> {"score": 1.234}
     {"cmd": "stats"}    -> latency/QPS/bucket snapshot (serving/stats.py)
     {"cmd": "metrics"}  -> {"prometheus": "<text exposition>"} — the full
                            metrics registry (docs/OBSERVABILITY.md)
     {"cmd": "slo"}      -> rolling-window p99 + error-budget snapshot
                            (serving.stats.SloTracker; --slo-p99-ms)
+    {"cmd": "health"}   -> queue/shed/degraded state + the reload circuit
+                           breaker snapshot (docs/ROBUSTNESS.md)
     {"cmd": "version"}  -> {"version": "<current model version>"}
     {"cmd": "reload", "path": "<export dir>"} -> {"reloaded": "<version>"}
+                           (an explicit reload bypasses the breaker's
+                           quarantine — the operator asked)
+
+``deadline_ms`` (per request, or ``--default-deadline-ms``) drops a
+request that can't start scoring in time — the Future answers
+``{"error": ...}`` and no device work is burned; ``priority`` lets an
+important request shed the oldest lower-priority queued one when the
+bounded queue is full. Under sustained queue pressure the batcher
+degrades to fixed-effect-only scoring (``--no-degrade`` disables).
 
 Unknown feature keys are ignored per shard vocabulary (ingest semantics);
 unknown entity ids score fixed-effect-only (cold start). SIGTERM/SIGINT
 drain the micro-batcher — accepted requests finish, new ones are refused —
-via the ``GracefulShutdown.register_drain`` hook. With ``--watch-root``,
-new verified model exports under the directory hot-reload automatically.
+via the ``GracefulShutdown.register_drain`` hook; a FAILED drain logs the
+undrained depth and exits nonzero so orchestrators see the dropped work.
+With ``--watch-root``, new verified model exports under the directory
+hot-reload automatically; exports that keep failing to load are
+quarantined by the reload circuit breaker (backoff probes re-admit them)
+while the last good version keeps serving.
 """
 
 from __future__ import annotations
@@ -65,6 +81,7 @@ def serve_lines(
     stats: Optional[ServingStats] = None,
     shutdown=None,
     window: int = 128,
+    default_deadline_ms: Optional[float] = None,
 ) -> int:
     """Pump a JSON-lines stream through the batcher, writing one response
     line per request IN ORDER. A dedicated writer thread emits each
@@ -145,10 +162,18 @@ def serve_lines(
                             )
                         else:
                             reply_now(slo.snapshot())
+                    elif cmd == "health":
+                        # breaker/shed/queue state in one reply — the
+                        # orchestration probe (readiness, alerting)
+                        health = dict(batcher.health())
+                        if registry is not None:
+                            health.update(registry.health())
+                        reply_now(health)
                     elif cmd == "version":
                         reply_now({"version": registry.version()})
                     elif cmd == "reload":
-                        v = registry.load(obj["path"])
+                        # operator-explicit: bypass breaker quarantine
+                        v = registry.load(obj["path"], force=True)
                         reply_now({"reloaded": v.version_id})
                     else:
                         reply_now({"error": f"unknown cmd {cmd!r}"})
@@ -156,8 +181,22 @@ def serve_lines(
                     reply_now({"error": str(e)})
                 continue
             try:
-                outbox.put(("score", batcher.submit(build_request(obj))))
-            except (Backpressure, ValueError) as e:
+                deadline_ms = obj.get("deadline_ms", default_deadline_ms)
+                outbox.put(
+                    (
+                        "score",
+                        batcher.submit(
+                            build_request(obj),
+                            deadline_ms=(
+                                float(deadline_ms)
+                                if deadline_ms is not None
+                                else None
+                            ),
+                            priority=int(obj.get("priority", 0)),
+                        ),
+                    )
+                )
+            except (Backpressure, ValueError, TypeError) as e:
                 reply_now({"error": str(e)})
     finally:
         outbox.put(None)
@@ -177,7 +216,10 @@ def _watch_loop(registry, watch_root, poll_s, shutdown, logger):
         shutdown._event.wait(poll_s)
 
 
-def _serve_socket(port, batcher, registry, stats, shutdown, logger):
+def _serve_socket(
+    port, batcher, registry, stats, shutdown, logger,
+    default_deadline_ms=None,
+):
     import socketserver
 
     class Handler(socketserver.StreamRequestHandler):
@@ -192,7 +234,8 @@ def _serve_socket(port, batcher, registry, stats, shutdown, logger):
                     pass
 
             serve_lines(
-                lines, _W(), batcher, registry, stats, shutdown=shutdown
+                lines, _W(), batcher, registry, stats, shutdown=shutdown,
+                default_deadline_ms=default_deadline_ms,
             )
 
     class Server(socketserver.ThreadingTCPServer):
@@ -242,6 +285,25 @@ def main(argv=None) -> None:
         action="store_true",
         help="serve exports without a sha256 manifest (NOT recommended)",
     )
+    p.add_argument(
+        "--default-deadline-ms", type=float, default=None,
+        help="deadline applied to requests that don't carry their own "
+        "deadline_ms; expired requests drop before batch assembly",
+    )
+    p.add_argument(
+        "--no-degrade", action="store_true",
+        help="disable degrade-to-fixed-effect-only scoring under "
+        "sustained queue pressure",
+    )
+    p.add_argument(
+        "--breaker-threshold", type=int, default=3,
+        help="consecutive reload failures that quarantine an export dir",
+    )
+    p.add_argument(
+        "--breaker-backoff-s", type=float, default=30.0,
+        help="initial backoff before a quarantined export is re-probed "
+        "(doubles per failed probe)",
+    )
     p.add_argument("--stats-json", help="dump a stats snapshot here on exit")
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize the backend
@@ -256,6 +318,9 @@ def main(argv=None) -> None:
     registry = ModelRegistry(
         verify=not args.no_verify_manifest,
         warmup_max_batch=args.max_batch,
+        warmup_degraded=not args.no_degrade,
+        breaker_threshold=args.breaker_threshold,
+        breaker_backoff_s=args.breaker_backoff_s,
         stats=stats,
         logger=logger,
         dtype={"float32": jnp.float32, "float64": jnp.float64}[args.dtype],
@@ -275,6 +340,9 @@ def main(argv=None) -> None:
         queue_depth=args.queue_depth,
         stats=stats,
         slo=slo,
+        degraded_score_fn=(
+            None if args.no_degrade else registry.score_fixed_only
+        ),
     )
     shutdown = GracefulShutdown(logger).install()
     shutdown.register_drain(batcher.begin_drain)
@@ -287,7 +355,8 @@ def main(argv=None) -> None:
     try:
         if args.socket:
             _serve_socket(
-                args.socket, batcher, registry, stats, shutdown, logger
+                args.socket, batcher, registry, stats, shutdown, logger,
+                default_deadline_ms=args.default_deadline_ms,
             )
         else:
             serve_lines(
@@ -298,12 +367,22 @@ def main(argv=None) -> None:
                 stats,
                 shutdown=shutdown,
                 window=args.max_batch * 2,
+                default_deadline_ms=args.default_deadline_ms,
             )
     finally:
-        batcher.drain()
+        drained = batcher.drain()
         if args.stats_json:
             stats.dump(args.stats_json)
         shutdown.uninstall()
+        if not drained:
+            # accepted requests are still queued — silently exiting 0
+            # here is how dropped work hides from orchestrators
+            depth = batcher.queue_depth()
+            logger.warn(
+                f"drain FAILED: {depth} accepted request(s) undrained "
+                "at exit"
+            )
+            sys.exit(3)
 
 
 if __name__ == "__main__":

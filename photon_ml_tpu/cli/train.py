@@ -254,6 +254,8 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
                     chunk_mb=params.ingest_chunk_mb,
                     decode_threads=params.decode_threads,
                     prefetch_depth=params.prefetch_depth,
+                    stage_timeout_s=params.stage_timeout_s or None,
+                    epoch_policy=params.epoch_policy,
                 ),
             ) as pipe:
                 design = StreamedDesign.from_pipeline(
@@ -280,6 +282,8 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
                 chunk_mb=params.ingest_chunk_mb,
                 decode_threads=params.decode_threads,
                 prefetch_depth=params.prefetch_depth,
+                stage_timeout_s=params.stage_timeout_s,
+                epoch_policy=params.epoch_policy,
             )
         else:
             batch, _uids, _present = source.labeled_batch(
@@ -617,6 +621,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--prefetch-depth", type=int, default=None,
         help="ingest pipeline: chunks decode/staging may run ahead of "
         "the consumer; also sizes the staging ring (default 2)",
+    )
+    p.add_argument(
+        "--stage-timeout-s", type=float, default=None,
+        help="ingest pipeline watchdog: a decode/stage/transfer attempt "
+        "stalled past this many seconds is cancelled and re-run through "
+        "the retry seam (default: off — docs/ROBUSTNESS.md)",
+    )
+    p.add_argument(
+        "--epoch-policy", choices=["fail", "skip"], default=None,
+        help="what an exhausted ingest retry budget does to the epoch: "
+        "fail (default) raises; skip logs+counts the lost group and "
+        "continues with fewer rows",
     )
     p.add_argument("--overwrite", action="store_true", default=None)
     p.add_argument("--diagnostics", action="store_true", default=None)

@@ -22,6 +22,7 @@ from jax import lax
 
 from photon_ml_tpu import obs
 from photon_ml_tpu.core.tasks import TaskType
+from photon_ml_tpu.resilience import faults as _faults
 from photon_ml_tpu.ops import metrics as metrics_mod
 from photon_ml_tpu.solvers.common import ConvergenceReason
 
@@ -216,11 +217,19 @@ class _AsyncCheckpointWriter:
     disk (epoch time bounded by device math, not checkpoint I/O —
     docs/INGEST.md's overlap principle applied to the output side).
     ``submit`` joins any previous write first, so writes serialize in
-    step order and at most one is in flight; a failed background write
-    re-raises at the next ``submit``/``join`` — at the latest before
-    ``run()`` returns. An exception that unwinds ``run()`` between a
-    submit and its join can at worst lose that one overlapped write,
-    which resume tolerates by falling back to the previous VALID step
+    step order and at most one is in flight.
+
+    Failure contract: a background-write error SURFACES at the next
+    ``submit``/``join`` — at the latest before ``run()`` returns — and
+    ``join`` then falls back to re-running the retained write closure
+    SYNCHRONOUSLY (``resilience.ckpt_async_fallback`` event + counter),
+    so an async-path-only failure (the ``checkpoint.async_write`` chaos
+    site: a dying writer thread, an fd lost to the background context)
+    costs overlap, never durability. Only a fallback that ALSO fails
+    raises — at that point the step genuinely cannot be written. An
+    exception that unwinds ``run()`` between a submit and its join can
+    at worst lose that one overlapped write, which resume tolerates by
+    falling back to the previous VALID step
     (``io.checkpoint.latest_checkpoint``)."""
 
     def __init__(self):
@@ -228,13 +237,21 @@ class _AsyncCheckpointWriter:
 
         self._threading = threading
         self._thread = None
+        self._fn = None
         self._exc: Optional[BaseException] = None
 
     def submit(self, write_fn) -> None:
         self.join()
+        self._fn = write_fn
 
         def run():
             try:
+                # chaos seam: the background serialize/swap. Distinct
+                # from checkpoint.save (probed inside save_checkpoint,
+                # where the retry policy owns it): this site dies on the
+                # WRITER THREAD, exercising the surface-at-join +
+                # synchronous-fallback path.
+                _faults.fire("checkpoint.async_write")
                 write_fn()
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 self._exc = e
@@ -252,8 +269,19 @@ class _AsyncCheckpointWriter:
             self._thread = None
         if self._exc is not None:
             exc = self._exc
+            fn = self._fn
             self._exc = None
-            raise exc
+            obs.registry().inc("resilience.ckpt_async_fallbacks")
+            obs.emit_event(
+                "resilience.ckpt_async_fallback",
+                cat="resilience",
+                error=repr(exc),
+            )
+            # durability boundary: whoever called join() is standing on a
+            # point that PROMISED a checkpoint (next submit, preemption
+            # marker, run return). Re-run the failed write synchronously;
+            # only a double failure breaks the promise.
+            fn()
 
 
 class CoordinateDescent:
@@ -899,8 +927,6 @@ class CoordinateDescent:
             and validation_fn is None
             and has_surface
         )
-        from photon_ml_tpu.resilience import faults as _faults
-
         # Checkpoint writes OVERLAP the next dispatch chunk's device
         # math: the training state is snapshotted to host synchronously
         # (the write must capture THIS boundary, not whatever the next

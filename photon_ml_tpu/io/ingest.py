@@ -596,6 +596,8 @@ class IngestSource:
         chunk_mb: Optional[float] = None,
         decode_threads: int = 0,
         prefetch_depth: Optional[int] = None,
+        stage_timeout_s: Optional[float] = None,
+        epoch_policy: str = "fail",
     ):
         """-> (LabeledBatch, uids, label_present) fed to the DEVICE
         through the streaming ingest pipeline
@@ -634,6 +636,8 @@ class IngestSource:
                 if prefetch_depth is not None
                 else pipeline_mod.DEFAULT_PREFETCH_DEPTH
             ),
+            stage_timeout_s=stage_timeout_s or None,
+            epoch_policy=epoch_policy,
         )
         try:
             with pipeline_mod.IngestPipeline(
@@ -660,6 +664,8 @@ class IngestSource:
         chunk_mb: Optional[float] = None,
         decode_threads: int = 0,
         prefetch_depth: Optional[int] = None,
+        stage_timeout_s: Optional[float] = None,
+        epoch_policy: str = "fail",
     ):
         """-> (GameData, entity_vocabs, uids, label_present), decoded
         through the streaming pipeline's bounded parallel pool instead
@@ -688,6 +694,8 @@ class IngestSource:
                 if prefetch_depth is not None
                 else pipeline_mod.DEFAULT_PREFETCH_DEPTH
             ),
+            stage_timeout_s=stage_timeout_s or None,
+            epoch_policy=epoch_policy,
         )
         try:
             with pipeline_mod.IngestPipeline(

@@ -55,14 +55,83 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu import obs
+from photon_ml_tpu.resilience import faults as _faults
 
 DEFAULT_CHUNK_MB = 64.0
 DEFAULT_PREFETCH_DEPTH = 2
 
+EPOCH_POLICIES = ("fail", "skip")
+
+
+class StageStall(OSError):
+    """A pipeline stage blew past its watchdog deadline. Subclasses
+    OSError so the existing retry seam treats a stall exactly like a
+    transient read failure: the abandoned attempt is cancelled (its
+    worker thread is orphaned — daemon, never joined) and the stage
+    re-runs cleanly."""
+
+    def __init__(self, stage: str, label: str, timeout_s: float):
+        super().__init__(
+            f"pipeline stage {stage!r} stalled past {timeout_s}s "
+            f"({label})"
+        )
+        self.stage = stage
+        self.timeout_s = timeout_s
+
+
+def _with_watchdog(
+    fn,
+    timeout_s: Optional[float],
+    stage: str,
+    label: str,
+    on_abandon=None,
+):
+    """Run ``fn()`` under a stall deadline: the work moves to a daemon
+    thread and the caller waits at most ``timeout_s``. On stall the
+    attempt is abandoned and :class:`StageStall` raises into the retry
+    seam (cancel-and-redo semantics — the cleanest cancellation python
+    threads allow); ``on_abandon(thread)`` lets the owner track the
+    stray so shared native state isn't freed under it. ``timeout_s``
+    None/0 runs ``fn`` inline: unwatched stages pay nothing."""
+    if not timeout_s:
+        return fn()
+    box: Dict[str, object] = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["ok"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(
+        target=run, name=f"watchdog-{stage}", daemon=True
+    )
+    t.start()
+    if not done.wait(timeout_s):
+        if on_abandon is not None:
+            on_abandon(t)
+        reg = obs.registry()
+        reg.inc("ingest.pipeline.watchdog_stalls")
+        reg.inc(f"ingest.pipeline.watchdog_stalls.{stage}")
+        obs.emit_event(
+            "io.pipeline.stall",
+            cat="io",
+            stage=stage,
+            label=label,
+            timeout_s=timeout_s,
+        )
+        raise StageStall(stage, label, timeout_s)
+    if "err" in box:
+        raise box["err"]  # type: ignore[misc]
+    return box.get("ok")
+
 
 @dataclasses.dataclass(frozen=True)
 class PipelineConfig:
-    """The three ingest-pipeline knobs (``--ingest-chunk-mb`` /
+    """The ingest-pipeline knobs (``--ingest-chunk-mb`` /
     ``--decode-threads`` / ``--prefetch-depth`` on the train drivers).
 
     chunk_mb: target decoded-chunk size. Plans input files into decode
@@ -74,11 +143,21 @@ class PipelineConfig:
     prefetch_depth: how many chunks decode/staging may run ahead of the
     consumer; also sizes the staging ring (depth + 1 slots). 1 is the
     classic double buffer's minimum; 2 (default) absorbs decode jitter.
+    stage_timeout_s: per-stage watchdog deadline (decode / stage /
+    transfer). A stage that stalls past it is cancelled and re-run
+    through the retry seam; None (default) disables the watchdogs.
+    epoch_policy: what an EXHAUSTED retry budget does to the epoch —
+    ``"fail"`` (default) raises, ``"skip"`` logs the lost group, counts
+    it (``ingest.pipeline.groups_skipped``), and continues the epoch
+    without those rows (availability over completeness; the consumer
+    sees fewer rows, never wrong ones).
     """
 
     chunk_mb: float = DEFAULT_CHUNK_MB
     decode_threads: int = 0
     prefetch_depth: int = DEFAULT_PREFETCH_DEPTH
+    stage_timeout_s: Optional[float] = None
+    epoch_policy: str = "fail"
 
     def validate(self) -> None:
         if not self.chunk_mb > 0:
@@ -91,6 +170,16 @@ class PipelineConfig:
         if self.prefetch_depth < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {self.prefetch_depth}"
+            )
+        if self.stage_timeout_s is not None and not self.stage_timeout_s > 0:
+            raise ValueError(
+                f"stage_timeout_s must be > 0 or None, got "
+                f"{self.stage_timeout_s}"
+            )
+        if self.epoch_policy not in EPOCH_POLICIES:
+            raise ValueError(
+                f"epoch_policy must be one of {EPOCH_POLICIES}, got "
+                f"{self.epoch_policy!r}"
             )
 
 
@@ -143,6 +232,7 @@ class PipelineStats:
         self.bytes_to_device = 0
         self.stalls = 0
         self.retries = 0
+        self.groups_skipped = 0
         # counted stage intervals (stage, start, end) in perf_counter
         # time — the overlap evidence. Bounded: a pipeline emits a few
         # intervals per chunk.
@@ -223,6 +313,7 @@ class PipelineStats:
                 "bytes_to_device": float(self.bytes_to_device),
                 "stalls": float(self.stalls),
                 "retries": float(self.retries),
+                "groups_skipped": float(self.groups_skipped),
             }
         out["overlap_frac"] = self.overlap_frac()
         out["stall_frac"] = self.stall_frac()
@@ -399,6 +490,10 @@ class IngestPipeline:
             [v.intercept_index for v in self.vocabs],
         )
         self._closed = False
+        # decode attempts abandoned by the stage watchdog: they still
+        # hold the shared native vocab maps, so close() must not free
+        # those under them (tracked only on stall — zero steady cost)
+        self._stray_threads: List[threading.Thread] = []
         obs.emit_event(
             "io.pipeline.start",
             cat="io",
@@ -415,6 +510,22 @@ class IngestPipeline:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            # wait out watchdog-abandoned decode attempts: they read the
+            # shared native vocab maps, and freeing those under a live
+            # native call is a use-after-free. A still-hung stray after
+            # the grace period leaks the maps instead — a bounded leak
+            # beats a segfault.
+            for t in self._stray_threads:
+                t.join(timeout=30.0)
+            if any(t.is_alive() for t in self._stray_threads):
+                obs.emit_event(
+                    "io.pipeline.stray_leak",
+                    cat="io",
+                    threads=sum(
+                        t.is_alive() for t in self._stray_threads
+                    ),
+                )
+                return
             self._vocabset.close()
 
     def __enter__(self) -> "IngestPipeline":
@@ -436,6 +547,11 @@ class IngestPipeline:
         native = self._native
 
         def decode_once():
+            # chaos seam: the decode-pool stage. raise-mode restarts the
+            # group through the retry wrapper below (fresh readers —
+            # no duplicated or dropped chunk); delay-mode is the stalled-
+            # decoder drill the stage watchdog converts into a retry.
+            _faults.fire("pipeline.decode", key=str(index))
             parts = []
             for path in group:
                 with native.NativeAvroReader(
@@ -456,12 +572,24 @@ class IngestPipeline:
                     )
             return parts
 
+        def decode_attempt():
+            # watchdog: a stalled attempt (hung FS, wedged native call)
+            # is abandoned after stage_timeout_s and re-decoded — the
+            # StageStall is an OSError, so the retry seam owns the redo
+            return _with_watchdog(
+                decode_once,
+                self.config.stage_timeout_s,
+                "decode",
+                f"chunk {index}",
+                on_abandon=self._stray_threads.append,
+            )
+
         t0 = time.perf_counter()
         with obs.span(
             "ingest.decode", cat="io", chunk=index, files=len(group)
         ):
             parts = _resilient_read(
-                decode_once,
+                decode_attempt,
                 label=f"pipeline decode chunk {index} ({group[0]}...)",
                 paths=group,
             )
@@ -482,16 +610,42 @@ class IngestPipeline:
         reg.inc("ingest.pipeline.records", part["n"])
         return part
 
+    def _skip_group(self, index: int, err: BaseException) -> bool:
+        """Epoch policy on an exhausted decode-retry budget: ``skip``
+        logs + counts the lost group and lets the epoch continue (the
+        consumer sees fewer rows, never wrong ones); ``fail`` says no."""
+        from photon_ml_tpu.resilience.retry import RetryBudgetExceeded
+
+        if self.config.epoch_policy != "skip" or not isinstance(
+            err, RetryBudgetExceeded
+        ):
+            return False
+        self.stats.note("decode", 0.0, groups_skipped=1)
+        obs.registry().inc("ingest.pipeline.groups_skipped")
+        obs.emit_event(
+            "io.pipeline.group_skipped",
+            cat="io",
+            chunk=index,
+            files=self.groups[index],
+            error=repr(err),
+        )
+        return True
+
     def parts(self) -> Iterator[dict]:
         """Ordered iterator of decoded columnar parts (one per file
         group). Decode runs on a thread pool, bounded so it never gets
         more than ``prefetch_depth`` parts (plus one in flight per
         worker) ahead of the consumer; consumer-side waits are counted
-        as pipeline stalls."""
+        as pipeline stalls. A group whose retries exhaust follows
+        ``epoch_policy`` (fail the epoch, or skip-and-log the group)."""
         groups = self.groups
         nworkers = min(self.decode_workers, len(groups))
         if nworkers <= 1 and len(groups) == 1:
-            yield self._decode_group(0, groups[0])
+            try:
+                yield self._decode_group(0, groups[0])
+            except BaseException as e:  # noqa: BLE001 — policy gate
+                if not self._skip_group(0, e):
+                    raise
             return
         cond = threading.Condition()
         results: Dict[int, Tuple[str, object]] = {}
@@ -547,6 +701,8 @@ class IngestPipeline:
                     state["consumed"] = i + 1
                     cond.notify_all()
                 if kind == "error":
+                    if self._skip_group(i, payload):
+                        continue
                     raise payload
                 yield payload
         finally:
@@ -625,7 +781,12 @@ class IngestPipeline:
                 continue
             t0 = time.perf_counter()
             with obs.span("ingest.stage", cat="io", rows=n):
-                dense = _dense_part(part, vocab, vocab_index)
+                dense = _with_watchdog(
+                    lambda: _dense_part(part, vocab, vocab_index),
+                    self.config.stage_timeout_s,
+                    "stage",
+                    f"{n} rows",
+                )
                 cols = {
                     "labels": part["labels"],
                     "offsets": part["offsets"],
@@ -700,6 +861,8 @@ class IngestPipeline:
             yield pending
 
     def _transfer(self, staged: StagedChunk, ring: _StagingRing):
+        from photon_ml_tpu.resilience import retry as _retry
+
         t0 = time.perf_counter()
         nbytes = sum(
             a.nbytes
@@ -711,16 +874,42 @@ class IngestPipeline:
                 staged.mask,
             )
         )
-        with obs.span(
-            "ingest.transfer", cat="io", chunk=staged.index, bytes=nbytes
-        ):
-            dev = {
+
+        def copy_once():
+            # chaos seam: the host->device transfer stage. The staged
+            # ring slot is still owned by this chunk until the copies
+            # complete, so a retried transfer re-reads intact buffers.
+            _faults.fire("pipeline.transfer", key=str(staged.index))
+            return {
                 "features": _owned_device_copy(staged.features),
                 "labels": _owned_device_copy(staged.labels),
                 "offsets": _owned_device_copy(staged.offsets),
                 "weights": _owned_device_copy(staged.weights),
                 "mask": _owned_device_copy(staged.mask),
             }
+
+        def copy_attempt():
+            attempts["n"] += 1
+            return _with_watchdog(
+                copy_once,
+                self.config.stage_timeout_s,
+                "transfer",
+                f"chunk {staged.index}",
+            )
+
+        attempts = {"n": 0}
+        with obs.span(
+            "ingest.transfer", cat="io", chunk=staged.index, bytes=nbytes
+        ):
+            dev = _retry.retry_call(
+                copy_attempt,
+                retries=2,
+                base_delay=0.02,
+                max_delay=0.25,
+                label=f"pipeline transfer chunk {staged.index}",
+            )
+        if attempts["n"] > 1:
+            self.stats.note("transfer", 0.0, retries=attempts["n"] - 1)
         ring.note_transfer(staged.ring_slot, tuple(dev.values()))
         dt = time.perf_counter() - t0
         self.stats.note("transfer", dt, t0=t0, bytes_to_device=nbytes)

@@ -88,6 +88,12 @@ _DIRECTION_RULES = (
     (re.compile(r"_gbps$"), HIGHER_IS_BETTER),
     (re.compile(r"overlap_frac$"), HIGHER_IS_BETTER),
     (re.compile(r"stall_frac$"), LOWER_IS_BETTER),
+    # chaos-hardened serving (docs/ROBUSTNESS.md, bench_overload): the
+    # fraction of a FIXED offered overload turned away (expired + shed +
+    # rejected) falls as the serving path gets faster/smarter; the
+    # companion p99_under_overload_ms / breaker_recovery_s gate through
+    # the generic _ms/_s lower-is-better rules below
+    (re.compile(r"shed_frac$"), LOWER_IS_BETTER),
     (re.compile(r"(^|\.)mfu$"), HIGHER_IS_BETTER),
     (re.compile(r"hbm_util$"), HIGHER_IS_BETTER),
     (re.compile(r"achieved_tflops$"), HIGHER_IS_BETTER),

@@ -185,6 +185,13 @@ def allgather_host(x):
     and, when traced, a ``collective.allgather_host`` span."""
     import numpy as np
 
+    from photon_ml_tpu.resilience import faults as _faults
+
+    # chaos seam: the multihost collective boundary. Probed BEFORE the
+    # single-process early-return so drills exercise the seam without a
+    # pod: raise-mode simulates a peer dying mid-exchange (the error a
+    # real pod sees when a host drops), delay-mode a straggler host.
+    _faults.fire("collective.allreduce", key="allgather_host")
     if jax.process_count() == 1:
         return np.asarray(x)
     from jax.experimental import multihost_utils

@@ -16,14 +16,18 @@ the store in :mod:`photon_ml_tpu.io.checkpoint`; the divergence guard
 """
 
 from photon_ml_tpu.resilience.faults import (
+    KNOWN_SITES,
     FaultInjector,
     FaultSpec,
     InjectedFault,
+    UnknownFaultSite,
     arm_from_env,
     corrupt_file,
     fire,
     inject,
+    known_sites,
     parse_spec,
+    register_site,
     registry,
 )
 from photon_ml_tpu.resilience.retry import (
@@ -40,9 +44,13 @@ from photon_ml_tpu.resilience.shutdown import (
 )
 
 __all__ = [
+    "KNOWN_SITES",
     "FaultInjector",
     "FaultSpec",
     "InjectedFault",
+    "UnknownFaultSite",
+    "known_sites",
+    "register_site",
     "arm_from_env",
     "corrupt_file",
     "fire",

@@ -1,0 +1,808 @@
+"""Scripted chaos drills: fault schedules + asserted invariants.
+
+A fault site that nothing drills is a fault site that silently rots —
+the PR-1 harness proved the original four sites, and everything built
+since (serving engine/batcher/registry, the streaming ingest pipeline,
+the async checkpoint writer, the multihost collective seam) needs the
+same treatment. This module is the shared drill engine behind
+
+- ``benchmarks/chaos_lab.py`` — the scripted lab (``--smoke`` runs the
+  full schedule on CPU in seconds and emits a BENCH-style JSON report),
+- ``photon-chaos`` (``cli/chaos.py``) — the operator CLI (list sites,
+  validate a ``PHOTON_FAULTS`` schedule, run drills),
+- ``bench.py bench_overload`` — the sentinel-tracked overload metrics
+  (``serving_shed_frac``, ``p99_under_overload_ms``,
+  ``breaker_recovery_s``),
+- ``tests/test_chaos.py`` — the tier-1 ``chaos``-marked smoke drill.
+
+Every drill arms faults through :func:`photon_ml_tpu.resilience.faults.
+inject`, so the registry (and its counters) is restored whatever the
+drill does, and every drill asserts a RECOVERY invariant, not just that
+the fault fired: no request lost outside the shed budget, checkpoints
+always restorable, the breaker opens AND recloses, training results
+bit-equal where faults were fully recovered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.resilience.faults import (
+    FaultSpec,
+    InjectedFault,
+    UnknownFaultSite,
+    fire,
+    inject,
+    known_sites,
+)
+
+__all__ = [
+    "DrillResult",
+    "DRILLS",
+    "run_drills",
+    "overload_run",
+    "breaker_drill",
+]
+
+
+@dataclasses.dataclass
+class DrillResult:
+    """One scripted drill's outcome. ``skipped`` means an environment
+    prerequisite was missing (e.g. the native reader for pipeline
+    drills) — never a silent pass: the report says why."""
+
+    name: str
+    passed: bool
+    duration_s: float
+    skipped: bool = False
+    reason: str = ""
+    details: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Skip(Exception):
+    """Raised by a drill whose environment prerequisite is missing."""
+
+
+def _tolerance() -> float:
+    import jax
+
+    return 1e-10 if jax.config.jax_enable_x64 else 1e-5
+
+
+# ---------------------------------------------------------------------------
+# synthetic serving fixtures (self-contained: drills must not import tests)
+# ---------------------------------------------------------------------------
+
+
+def build_drill_engine(rng, d_fixed=16, d_user=6, n_users=64, dtype=None):
+    """Tiny in-memory GAME model behind a ScoringEngine: one fixed
+    effect, one random effect — enough to drill score/degrade paths."""
+    from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+    from photon_ml_tpu.serving.engine import ScoringEngine
+
+    g_vocab = FeatureVocabulary(
+        [feature_key(f"g{j}", "") for j in range(d_fixed)]
+    )
+    u_vocab = FeatureVocabulary(
+        [feature_key(f"u{j}", "") for j in range(d_user)]
+    )
+    params = {
+        "global": rng.normal(size=d_fixed),
+        "per-user": rng.normal(size=(n_users, d_user)),
+    }
+    return ScoringEngine(
+        params,
+        shards={"global": "g", "per-user": "u"},
+        random_effects={"global": None, "per-user": "userId"},
+        shard_vocabs={"g": g_vocab, "u": u_vocab},
+        re_vocabs={"userId": {f"user{i}": i for i in range(n_users)}},
+        **({"dtype": dtype} if dtype is not None else {}),
+    )
+
+
+def make_drill_request(rng, d_fixed=16, d_user=6, n_users=64):
+    from photon_ml_tpu.serving.engine import ScoreRequest
+
+    feats = {
+        f"g{int(j)}": float(rng.normal())
+        for j in rng.integers(0, d_fixed, size=4)
+    }
+    feats[f"u{int(rng.integers(0, d_user))}"] = float(rng.normal())
+    return ScoreRequest(
+        features=feats,
+        entities={"userId": f"user{int(rng.integers(0, n_users))}"},
+    )
+
+
+def _save_drill_export(root: str, rng, scale: float = 1.0) -> str:
+    """A verifiable GAME model export on disk (manifest included) — the
+    registry/breaker drill fixture."""
+    from photon_ml_tpu.io.models import save_game_model, write_model_manifest
+    from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+    d = 4
+    vocab = FeatureVocabulary([feature_key(f"f{j}", "") for j in range(d)])
+    save_game_model(
+        root,
+        params={
+            "global": scale * np.asarray(rng.normal(size=d)),
+            "per-user": scale * np.asarray(rng.normal(size=(5, d))),
+        },
+        shards={"global": "s", "per-user": "s"},
+        vocabs={"global": vocab, "per-user": vocab},
+        entity_vocabs={"per-user": {f"u{i}": i for i in range(5)}},
+        random_effects={"global": None, "per-user": "userId"},
+    )
+    vocab.save(os.path.join(root, "feature-index-s.txt"))
+    write_model_manifest(root)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# drill: site registry hygiene + unarmed probe overhead
+# ---------------------------------------------------------------------------
+
+
+def drill_site_registry(smoke: bool = True) -> dict:
+    """Arm-time validation rejects typo'd sites; unarmed probes stay a
+    dict lookup (the obs_overhead gate's chaos-layer share)."""
+    try:
+        with inject(FaultSpec("serving.scoer", "raise", nth=1)):
+            raise AssertionError("typo'd site armed without error")
+    except UnknownFaultSite as e:
+        assert "serving.score" in str(e), "error must list known sites"
+    # every site in the table is armable
+    for site in known_sites():
+        with inject(FaultSpec(site, "delay", nth=10**9, delay=0.0)):
+            pass
+    # unarmed probe cost: must be a dict miss, not an obs round trip
+    n = 20_000 if smoke else 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fire("serving.score")
+    per_probe_ns = (time.perf_counter() - t0) / n * 1e9
+    assert per_probe_ns < 20_000, (
+        f"unarmed probe costs {per_probe_ns:.0f}ns — the cheap-when-"
+        "unarmed contract is broken"
+    )
+    return {
+        "known_sites": len(known_sites()),
+        "unarmed_probe_ns": round(per_probe_ns, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# drill: serving.score faults surface to futures; engine recovers
+# ---------------------------------------------------------------------------
+
+
+def drill_serving_score(smoke: bool = True) -> dict:
+    from photon_ml_tpu.serving.batcher import MicroBatcher
+
+    rng = np.random.default_rng(7)
+    engine = build_drill_engine(rng)
+    engine.warmup(max_batch=8)
+    batcher = MicroBatcher(engine.score, max_batch=8, max_wait_ms=0.5)
+    try:
+        # clean call first (compile out of the way)
+        batcher.score_sync(make_drill_request(rng), timeout=30.0)
+        with inject(FaultSpec("serving.score", "raise", nth=1)):
+            try:
+                batcher.score_sync(make_drill_request(rng), timeout=30.0)
+                raise AssertionError("injected score fault did not surface")
+            except InjectedFault:
+                pass
+        # the NEXT request scores clean: the engine carries no poisoned
+        # state across a failed batch
+        s = batcher.score_sync(make_drill_request(rng), timeout=30.0)
+        assert np.isfinite(s), "engine did not recover after score fault"
+        # corrupt-mode: NaN scores must be OBSERVABLE (not silently
+        # served as numbers)
+        with inject(FaultSpec("serving.score", "corrupt", nth=1)):
+            bad = batcher.score_sync(make_drill_request(rng), timeout=30.0)
+        assert not np.isfinite(bad), "corrupt-mode scores must be non-finite"
+        ok = batcher.score_sync(make_drill_request(rng), timeout=30.0)
+        assert np.isfinite(ok)
+        errors = int(batcher.stats.errors)
+    finally:
+        batcher.drain(timeout=5.0)
+    return {"errors": errors, "recovered": True}
+
+
+# ---------------------------------------------------------------------------
+# drill: reload fault -> breaker opens -> last-good serves -> probe recloses
+# ---------------------------------------------------------------------------
+
+
+def breaker_drill(
+    threshold: int = 2, backoff_s: float = 0.25, smoke: bool = True
+) -> dict:
+    """The full breaker lifecycle under live traffic. Returns the
+    measured ``breaker_recovery_s`` (open -> successful probe reload)."""
+    import threading
+
+    from photon_ml_tpu.serving.registry import ModelRegistry
+
+    rng = np.random.default_rng(11)
+    out: Dict[str, object] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        watch = os.path.join(tmp, "watch")
+        v1 = _save_drill_export(os.path.join(watch, "v001"), rng, scale=1.0)
+        reg = ModelRegistry(
+            warmup_max_batch=8,
+            breaker_threshold=threshold,
+            breaker_backoff_s=backoff_s,
+            breaker_max_backoff_s=backoff_s * 8,
+        )
+        reg.load(v1, version_id="v001")
+
+        # background traffic for the WHOLE drill: zero dropped in-flight
+        # requests across quarantine and recovery
+        from photon_ml_tpu.serving.engine import ScoreRequest
+
+        stop = threading.Event()
+        client_errors: List[str] = []
+        client_scores = [0]
+
+        def client():
+            while not stop.is_set():
+                try:
+                    reg.score(
+                        [ScoreRequest(features={"f0": 1.0},
+                                      entities={"userId": "u1"})]
+                    )
+                    client_scores[0] += 1
+                except Exception as e:  # noqa: BLE001 — drill evidence
+                    client_errors.append(repr(e))
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        try:
+            # a broken v002 lands: manifest present but payload torn
+            v2 = _save_drill_export(
+                os.path.join(watch, "v002"), rng, scale=2.0
+            )
+            from photon_ml_tpu.resilience.faults import corrupt_file
+
+            corrupt_file(
+                os.path.join(
+                    v2,
+                    "fixed-effect", "global", "coefficients",
+                    "part-00000.avro",
+                )
+            )
+            # polls re-attempt until the breaker opens...
+            attempts = 0
+            for _ in range(threshold + 3):
+                assert reg.poll(watch) is None
+                attempts += 1
+                if reg.breaker.state(v2) in ("open", "half_open"):
+                    break
+            assert reg.breaker.state(v2) == "open", (
+                f"breaker did not open after {attempts} failing polls: "
+                f"{reg.breaker.snapshot()}"
+            )
+            t_open = time.perf_counter()
+            failures_at_open = int(reg.stats.reload_failures)
+            # ...and once open, polls SKIP the broken export (no more
+            # failure churn against live traffic)
+            assert reg.poll(watch) is None
+            assert int(reg.stats.reload_failures) == failures_at_open, (
+                "open breaker must skip the quarantined export"
+            )
+            assert reg.version() == "v001", "last-good stopped serving"
+
+            # publisher fixes the export; the next due probe recloses
+            from photon_ml_tpu.io.models import write_model_manifest
+
+            _save_drill_export(v2, rng, scale=2.0)
+            write_model_manifest(v2)
+            deadline = time.perf_counter() + 30.0
+            loaded = None
+            while loaded is None and time.perf_counter() < deadline:
+                loaded = reg.poll(watch)
+                if loaded is None:
+                    time.sleep(backoff_s / 4)
+            assert loaded == "v002", "probe did not recover the export"
+            recovery_s = time.perf_counter() - t_open
+            assert reg.breaker.state(v2) == "closed"
+            assert reg.version() == "v002"
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+        assert not client_errors, (
+            f"in-flight requests failed during quarantine/recovery: "
+            f"{client_errors[:3]}"
+        )
+        assert client_scores[0] > 0, "traffic thread never scored"
+        out = {
+            "breaker_recovery_s": round(recovery_s, 4),
+            "reload_failures": failures_at_open,
+            "client_scores": client_scores[0],
+            "client_errors": 0,
+        }
+    return out
+
+
+def drill_reload_breaker(smoke: bool = True) -> dict:
+    return breaker_drill(threshold=2, backoff_s=0.25, smoke=smoke)
+
+
+# ---------------------------------------------------------------------------
+# drill: overload -> deadlines expire, shed policy, degraded mode; no loss
+# ---------------------------------------------------------------------------
+
+
+def overload_run(
+    *,
+    total: int = 600,
+    queue_depth: int = 32,
+    max_batch: int = 8,
+    batch_cost_ms: float = 2.0,
+    deadline_floor_ms: float = 25.0,
+    priority_every: int = 10,
+    tight_deadline_every: int = 7,
+    degrade: bool = True,
+    rng=None,
+) -> dict:
+    """Open-loop overload against a real engine with a simulated device
+    cost: submit ``total`` requests as fast as the host can into a
+    ``queue_depth``-bounded batcher whose service rate is capped at
+    ``max_batch / batch_cost_ms``. Every ``priority_every``-th request
+    outranks the rest (exercising the shed policy); every
+    ``tight_deadline_every``-th carries a deadline shorter than the
+    loaded queue wait (exercising in-queue expiry). Every request is
+    accounted for: scored, expired (deadline passed in queue), shed
+    (evicted for a higher-priority request), or rejected at admission —
+    nothing is lost. Returns the sentinel-tracked overload metrics."""
+    from photon_ml_tpu.serving.batcher import (
+        Backpressure,
+        DeadlineExceeded,
+        MicroBatcher,
+        _DegradeController,
+    )
+
+    rng = rng if rng is not None else np.random.default_rng(23)
+    engine = build_drill_engine(rng)
+    engine.warmup(max_batch=max_batch, include_degraded=degrade)
+    cost_s = batch_cost_ms / 1e3
+
+    def slow_score(reqs):
+        time.sleep(cost_s)  # simulated device time: bounds service rate
+        return engine.score(reqs)
+
+    def slow_score_fixed(reqs):
+        time.sleep(cost_s / 2)
+        return engine.score(reqs, fixed_only=True)
+
+    # unloaded baseline: same scorer, idle queue
+    base = MicroBatcher(
+        slow_score, max_batch=max_batch, max_wait_ms=0.5,
+        queue_depth=queue_depth,
+    )
+    lat = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        base.score_sync(make_drill_request(rng), timeout=30.0)
+        lat.append(time.perf_counter() - t0)
+    base.drain(timeout=5.0)
+    lat.sort()
+    unloaded_p99_ms = lat[int(0.99 * (len(lat) - 1))] * 1e3
+    deadline_ms = max(2.0 * unloaded_p99_ms, deadline_floor_ms)
+
+    batcher = MicroBatcher(
+        slow_score,
+        max_batch=max_batch,
+        max_wait_ms=0.5,
+        queue_depth=queue_depth,
+        degraded_score_fn=slow_score_fixed if degrade else None,
+        degrade=(
+            _DegradeController(
+                high_water=0.5, low_water=0.2,
+                degrade_after_s=0.02, recover_after_s=0.5,
+            )
+            if degrade
+            else None
+        ),
+    )
+    futures: List[Optional[Future]] = []
+    rejected = 0
+    for i in range(total):
+        try:
+            tight = i % tight_deadline_every == 0
+            futures.append(
+                batcher.submit(
+                    make_drill_request(rng),
+                    # the tight slice expires in the loaded queue: the
+                    # expire-before-batch-assembly path under real load
+                    deadline_ms=batch_cost_ms / 2 if tight else deadline_ms,
+                    priority=1 if i % priority_every == 0 else 0,
+                )
+            )
+        except Backpressure:
+            rejected += 1
+            futures.append(None)
+    scored, expired, shed, errors = 0, 0, 0, 0
+    for fut in futures:
+        if fut is None:
+            continue
+        try:
+            fut.result(timeout=60.0)
+            scored += 1
+        except DeadlineExceeded:
+            expired += 1
+        except Backpressure:
+            shed += 1
+        except Exception:  # noqa: BLE001 — accounted, not lost
+            errors += 1
+    batcher.drain(timeout=10.0)
+    lost = total - (scored + expired + shed + rejected + errors)
+    # true enqueue->result latency of every SCORED request (the batcher
+    # stamps it at flush; a host-side collection loop would overcount)
+    p99_loaded_ms = batcher.stats.request_ms.snapshot()["p99_ms"]
+    return {
+        "submitted": total,
+        "scored": scored,
+        "expired": expired,
+        "shed": shed,
+        "rejected": rejected,
+        "errors": errors,
+        "lost": lost,
+        "unloaded_p99_ms": round(unloaded_p99_ms, 3),
+        "deadline_ms": round(deadline_ms, 3),
+        "p99_under_overload_ms": round(p99_loaded_ms, 3),
+        "serving_shed_frac": round(
+            (expired + shed + rejected) / max(total, 1), 4
+        ),
+        "degraded_batches": int(batcher.stats.degraded_batches),
+        "degraded_configured": degrade,
+    }
+
+
+def drill_overload(smoke: bool = True) -> dict:
+    out = overload_run(total=400 if smoke else 3000)
+    assert out["lost"] == 0, f"requests lost under overload: {out}"
+    assert out["errors"] == 0, f"scoring errors under overload: {out}"
+    # the whole point of deadlines: whatever DID score met the latency
+    # promise — overload shows up as shed fraction, not tail collapse.
+    # A scored request can exceed the deadline by at most the batch it
+    # rode (expiry gates run before the device call, not after); the
+    # slack covers that service time plus timeshared-host jitter.
+    slack_ms = 20.0
+    assert out["p99_under_overload_ms"] <= out["deadline_ms"] + slack_ms, (
+        f"scored p99 {out['p99_under_overload_ms']}ms blew past the "
+        f"deadline {out['deadline_ms']}ms + slack {slack_ms}ms"
+    )
+    assert out["expired"] > 0, (
+        "the tight-deadline slice never expired in queue — the "
+        "drop-before-batch-assembly path went undrilled"
+    )
+    assert out["shed"] + out["rejected"] > 0, (
+        "overload run never triggered admission control — not an overload"
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# drill: pipeline decode fault / stall -> retried group -> identical batch
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_fixture(tmp: str, nfiles: int = 3, rows: int = 40):
+    from photon_ml_tpu.io import native
+    from photon_ml_tpu.io.avro import write_avro_file
+    from photon_ml_tpu.io.ingest import make_training_example
+    from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_SCHEMA
+    from photon_ml_tpu.io.vocab import FeatureVocabulary
+
+    if native.get_lib() is None:
+        raise _Skip(f"native reader unavailable: {native.native_error()}")
+    d = 12
+    rng = np.random.default_rng(31)
+    paths = []
+    for i in range(nfiles):
+        recs = [
+            make_training_example(
+                label=float(rng.integers(0, 2)),
+                features={
+                    (f"f{int(j)}", "t"): float(rng.standard_normal())
+                    for j in rng.choice(d, 4, replace=False)
+                },
+            )
+            for _ in range(rows)
+        ]
+        p = os.path.join(tmp, f"part-{i}.avro")
+        write_avro_file(p, TRAINING_EXAMPLE_SCHEMA, recs, codec="null")
+        paths.append(p)
+    vocab = FeatureVocabulary(
+        [f"f{i}\x01t" for i in range(d)], add_intercept=True
+    )
+    return paths, vocab
+
+
+def _pipelined_batch(paths, vocab, **cfg_kw):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.io.pipeline import IngestPipeline, PipelineConfig
+
+    # ~3.4KB per fixture file vs a 2KB group budget: every file is its
+    # own decode group, so the key="0" skip drill drops ONE group
+    config = PipelineConfig(chunk_mb=0.002, **cfg_kw)
+    with IngestPipeline([*paths], [vocab], config=config) as pipe:
+        batch, uids, present = pipe.labeled_batch(dtype=jnp.float64)
+        return np.asarray(batch.features), np.asarray(batch.labels), pipe.stats
+
+
+def drill_pipeline_decode(smoke: bool = True) -> dict:
+    with tempfile.TemporaryDirectory() as tmp:
+        paths, vocab = _pipeline_fixture(tmp)
+        feats0, labels0, _ = _pipelined_batch(paths, vocab)
+        # raise-mode mid-epoch: the group restarts through the retry
+        # seam; the assembled batch is BIT-identical
+        with inject(FaultSpec("pipeline.decode", "raise", nth=2)):
+            feats1, labels1, _ = _pipelined_batch(paths, vocab)
+        np.testing.assert_array_equal(feats0, feats1)
+        np.testing.assert_array_equal(labels0, labels1)
+        # stalled decoder: the watchdog abandons the attempt, the retry
+        # redoes it — identical again
+        with inject(
+            FaultSpec("pipeline.decode", "delay", nth=1, delay=1.0)
+        ):
+            feats2, _, stats2 = _pipelined_batch(
+                paths, vocab, stage_timeout_s=0.2
+            )
+        np.testing.assert_array_equal(feats0, feats2)
+        # transfer fault: retried in place, staged ring intact
+        with inject(FaultSpec("pipeline.transfer", "raise", nth=1)):
+            feats3, _, _ = _pipelined_batch(paths, vocab)
+        np.testing.assert_array_equal(feats0, feats3)
+        # skip-and-log epoch policy: a permanently-failing group is
+        # dropped, the epoch survives with fewer rows
+        with inject(
+            FaultSpec("pipeline.decode", "raise", nth=1, count=-1, key="0")
+        ):
+            featsS, _, statsS = _pipelined_batch(
+                paths, vocab, epoch_policy="skip"
+            )
+        assert featsS.shape[0] < feats0.shape[0], (
+            "skip policy dropped nothing"
+        )
+        assert statsS.groups_skipped >= 1
+    return {
+        "rows": int(feats0.shape[0]),
+        "rows_after_skip": int(featsS.shape[0]),
+        "bit_identical_after_retry": True,
+        "watchdog_recovered": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# drill: checkpoint.async_write -> surfaces at join, sync fallback holds
+# ---------------------------------------------------------------------------
+
+
+def _tiny_game(rng, dtype=None):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.core.tasks import TaskType
+    from photon_ml_tpu.game import (
+        CoordinateConfig,
+        CoordinateDescent,
+        FixedEffectCoordinate,
+        GameData,
+        RandomEffectCoordinate,
+        build_random_effect_design,
+    )
+    from photon_ml_tpu.models.training import OptimizerType
+
+    dtype = dtype or (
+        jnp.float64 if jnp.zeros(()).dtype == jnp.float64 else jnp.float32
+    )
+    n_users, rows, d_g, d_u = 4, 8, 3, 2
+    n = n_users * rows
+    user = np.repeat(np.arange(n_users), rows)
+    xg = rng.normal(size=(n, d_g))
+    xu = rng.normal(size=(n, d_u))
+    y = (rng.uniform(size=n) < 0.5).astype(float)
+    data = GameData.create(
+        features={"global": xg, "per_user": xu},
+        labels=y,
+        entity_ids={"userId": user},
+    )
+    fe_cfg = CoordinateConfig(
+        shard="global", task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.TRON, reg_weight=0.1, max_iters=10,
+        tolerance=1e-8,
+    )
+    re_cfg = CoordinateConfig(
+        shard="per_user", task=TaskType.LOGISTIC_REGRESSION,
+        optimizer=OptimizerType.TRON, reg_weight=1.0, max_iters=10,
+        tolerance=1e-8, random_effect="userId",
+    )
+    fixed = FixedEffectCoordinate(
+        data.fixed_effect_batch("global", dtype), fe_cfg
+    )
+    design = build_random_effect_design(
+        data, "userId", "per_user", n_users, dtype=dtype
+    )
+    random = RandomEffectCoordinate(
+        design=design,
+        row_features=jnp.asarray(data.features["per_user"], dtype),
+        row_entities=jnp.asarray(data.entity_ids["userId"]),
+        full_offsets_base=jnp.asarray(data.offsets, dtype),
+        config=re_cfg,
+    )
+    return CoordinateDescent(
+        coordinates={"fixed": fixed, "per-user": random},
+        labels=jnp.asarray(data.labels, dtype),
+        base_offsets=jnp.asarray(data.offsets, dtype),
+        weights=jnp.asarray(data.weights, dtype),
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+
+
+def drill_async_checkpoint(smoke: bool = True) -> dict:
+    from photon_ml_tpu.io.checkpoint import latest_checkpoint
+
+    tol = _tolerance()
+    reg = obs.registry()
+    with tempfile.TemporaryDirectory() as tmp:
+        rng = np.random.default_rng(41)
+        model_a, _ = _tiny_game(np.random.default_rng(41)).run(
+            num_iterations=2, seed=3,
+            checkpoint_dir=os.path.join(tmp, "a"), checkpoint_every=1,
+        )
+        before = reg.counter("resilience.ckpt_async_fallbacks").value
+        rng = np.random.default_rng(41)
+        with inject(FaultSpec("checkpoint.async_write", "raise", nth=1)):
+            model_b, _ = _tiny_game(rng).run(
+                num_iterations=2, seed=3,
+                checkpoint_dir=os.path.join(tmp, "b"), checkpoint_every=1,
+            )
+        fallbacks = (
+            reg.counter("resilience.ckpt_async_fallbacks").value - before
+        )
+        assert fallbacks >= 1, (
+            "async-write fault never surfaced at a join"
+        )
+        # durability boundary held: the checkpoint is on disk AND loads
+        ck = latest_checkpoint(os.path.join(tmp, "b"))
+        assert ck is not None, "no restorable checkpoint after fallback"
+        # a fully-recovered fault leaves the training result untouched
+        for name in model_a.params:
+            np.testing.assert_allclose(
+                np.asarray(model_b.params[name]),
+                np.asarray(model_a.params[name]),
+                rtol=0, atol=tol, err_msg=name,
+            )
+    return {"fallbacks": int(fallbacks), "checkpoint_restorable": True}
+
+
+# ---------------------------------------------------------------------------
+# drill: the multihost collective seam fires
+# ---------------------------------------------------------------------------
+
+
+def drill_collective_seam(smoke: bool = True) -> dict:
+    from photon_ml_tpu.parallel import multihost
+
+    with inject(FaultSpec("collective.allreduce", "raise", nth=1)):
+        try:
+            multihost.allgather_host(np.arange(4))
+            raise AssertionError("collective fault did not fire")
+        except InjectedFault:
+            pass
+    # clean exchange afterwards (single-process identity)
+    out = multihost.allgather_host(np.arange(4))
+    np.testing.assert_array_equal(out, np.arange(4))
+    # delay-mode: the straggler-host drill adds measurable wall
+    t0 = time.perf_counter()
+    with inject(
+        FaultSpec("collective.allreduce", "delay", nth=1, delay=0.05)
+    ):
+        multihost.allgather_host(np.arange(4))
+    straggler_s = time.perf_counter() - t0
+    assert straggler_s >= 0.05
+    return {"straggler_s": round(straggler_s, 4)}
+
+
+# ---------------------------------------------------------------------------
+# drill: PR-1 legacy sites still hold their invariants
+# ---------------------------------------------------------------------------
+
+
+def drill_checkpoint_integrity(smoke: bool = True) -> dict:
+    from photon_ml_tpu.io.checkpoint import latest_checkpoint, save_checkpoint
+
+    with tempfile.TemporaryDirectory() as tmp:
+        key = np.zeros(2, np.uint32)
+        save_checkpoint(tmp, 1, {"w": np.arange(3.0)}, key)
+        # a CORRUPTED later step must fall back to the newest valid one
+        with inject(FaultSpec("checkpoint.save", "corrupt", nth=1)):
+            save_checkpoint(tmp, 2, {"w": np.arange(3.0) * 2}, key)
+        ck = latest_checkpoint(tmp)
+        assert ck is not None, "no valid checkpoint to fall back to"
+        assert ck.step == 1, f"fell back to step {ck.step}, wanted 1"
+    return {"fallback_step": int(ck.step)}
+
+
+# ---------------------------------------------------------------------------
+# the schedule
+# ---------------------------------------------------------------------------
+
+DRILLS: Dict[str, Callable[[bool], dict]] = {
+    "site_registry": drill_site_registry,
+    "serving_score": drill_serving_score,
+    "reload_breaker": drill_reload_breaker,
+    "overload_shed": drill_overload,
+    "pipeline_decode": drill_pipeline_decode,
+    "async_checkpoint": drill_async_checkpoint,
+    "collective_seam": drill_collective_seam,
+    "checkpoint_integrity": drill_checkpoint_integrity,
+}
+
+
+def run_drills(
+    smoke: bool = True,
+    include: Optional[List[str]] = None,
+    logger=None,
+) -> dict:
+    """Execute the scripted schedule; returns the drill report. A drill
+    failure is captured (the remaining drills still run) and flips
+    ``ok`` — the lab exits nonzero on any failed drill."""
+    results: List[DrillResult] = []
+    names = include if include else list(DRILLS)
+    unknown = [n for n in names if n not in DRILLS]
+    if unknown:
+        raise ValueError(
+            f"unknown drill(s) {unknown}; available: {sorted(DRILLS)}"
+        )
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            details = DRILLS[name](smoke)
+            res = DrillResult(
+                name=name,
+                passed=True,
+                duration_s=round(time.perf_counter() - t0, 3),
+                details=details,
+            )
+        except _Skip as e:
+            res = DrillResult(
+                name=name,
+                passed=True,
+                skipped=True,
+                duration_s=round(time.perf_counter() - t0, 3),
+                reason=str(e),
+            )
+        except BaseException as e:  # noqa: BLE001 — reported, not raised
+            res = DrillResult(
+                name=name,
+                passed=False,
+                duration_s=round(time.perf_counter() - t0, 3),
+                reason=f"{type(e).__name__}: {e}",
+            )
+        if logger is not None:
+            status = (
+                "SKIP" if res.skipped else "PASS" if res.passed else "FAIL"
+            )
+            logger(f"[{status}] {name} ({res.duration_s}s) {res.reason}")
+        results.append(res)
+    ran = [r for r in results if not r.skipped]
+    return {
+        "drills": [r.to_dict() for r in results],
+        "ran": len(ran),
+        "passed": sum(r.passed for r in ran),
+        "skipped": sum(r.skipped for r in results),
+        "ok": all(r.passed for r in ran),
+    }

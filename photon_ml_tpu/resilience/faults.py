@@ -11,10 +11,21 @@ with :func:`fire`, and that tests (or an operator drill via the
 
 Sites in-tree today::
 
-    checkpoint.save   between the temp-dir write and the atomic swap
-    checkpoint.load   per step-directory load attempt
-    ingest.read       per input-file decode
-    descent.update    per coordinate update (key = coordinate name)
+    checkpoint.save         between the temp-dir write and the atomic swap
+    checkpoint.load         per step-directory load attempt
+    checkpoint.async_write  the background serialize/swap of the overlapped
+                            GAME checkpoint writer (key = none)
+    ingest.read             per input-file decode
+    descent.update          per coordinate update (key = coordinate name)
+    serving.score           per device scoring call (key = padded bucket)
+    serving.reload          per registry load/warmup attempt (key = version)
+    pipeline.decode         per decode-pool group attempt (key = chunk index)
+    pipeline.transfer       per staged-chunk device transfer (key = chunk)
+    collective.allreduce    per multihost host-collective exchange
+
+Arming a site OUTSIDE this list raises at arm time: a typo'd drill that
+silently probes nothing would "pass" by testing nothing. Libraries that
+grow new seams register them via :func:`register_site`.
 
 Modes:
 
@@ -53,11 +64,46 @@ ENV_VAR = "PHOTON_FAULTS"
 KNOWN_SITES = (
     "checkpoint.save",
     "checkpoint.load",
+    "checkpoint.async_write",
     "ingest.read",
     "descent.update",
+    "serving.score",
+    "serving.reload",
+    "pipeline.decode",
+    "pipeline.transfer",
+    "collective.allreduce",
 )
 
 MODES = ("raise", "corrupt", "delay")
+
+# extension point: seams registered at runtime (plugins, tests for
+# not-yet-promoted sites) — validated exactly like KNOWN_SITES
+_EXTRA_SITES: set = set()
+
+
+def register_site(site: str) -> None:
+    """Declare an additional drillable site (idempotent). Arm-time
+    validation accepts KNOWN_SITES plus everything registered here."""
+    if not site or not isinstance(site, str):
+        raise ValueError(f"fault site must be a non-empty string: {site!r}")
+    _EXTRA_SITES.add(site)
+
+
+def known_sites() -> tuple:
+    """Every site an arm() will accept, sorted."""
+    return tuple(sorted(set(KNOWN_SITES) | _EXTRA_SITES))
+
+
+class UnknownFaultSite(ValueError):
+    """Armed a site no production code probes — the drill would test
+    nothing. Carries the valid-site list so the typo is obvious."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"unknown fault site {site!r}; known sites: "
+            f"{', '.join(known_sites())} (register_site() adds new seams)"
+        )
+        self.site = site
 
 
 def _note_injection(
@@ -144,6 +190,10 @@ class FaultInjector:
         self._calls: Dict[str, int] = {}
 
     def arm(self, spec: FaultSpec) -> None:
+        # validate at arm time: a typo'd site would otherwise sit inert
+        # in the registry and the drill would "pass" by testing nothing
+        if spec.site not in KNOWN_SITES and spec.site not in _EXTRA_SITES:
+            raise UnknownFaultSite(spec.site)
         self._specs.setdefault(spec.site, []).append(spec)
 
     def clear(self) -> None:

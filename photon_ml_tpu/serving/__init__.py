@@ -6,19 +6,30 @@ asks for:
 
 - :mod:`.engine`   — device-resident ScoringEngine; power-of-two padded
   buckets so steady-state traffic never recompiles; cold-start entities
-  score fixed-effect-only (cogroup-with-default-0 semantics).
+  score fixed-effect-only (cogroup-with-default-0 semantics); a
+  fixed-effect-only degraded mode for overload.
 - :mod:`.batcher`  — deadline micro-batching (max_batch / max_wait_ms),
-  bounded-queue backpressure, drain-on-SIGTERM.
+  per-request deadlines (expired requests drop before batch assembly),
+  bounded-queue admission control (priority shed policy), sustained-
+  pressure degrade-to-fixed-effects, drain-on-SIGTERM.
 - :mod:`.registry` — versioned models, sha256-manifest-gated atomic
-  hot-reload, drain-before-retire.
+  hot-reload, drain-before-retire, reload circuit breaker (repeatedly
+  failing exports quarantine; last-good keeps serving).
 - :mod:`.stats`    — latency histograms (p50/p95/p99), QPS, batch
-  occupancy, bucket/compile counters; JSON snapshots.
+  occupancy, bucket/compile counters, shed/expired/degraded counters;
+  JSON snapshots.
 
 Entry points: ``python -m photon_ml_tpu.cli.serve`` and
-``benchmarks/serving_lab.py`` (closed-loop load generator).
+``benchmarks/serving_lab.py`` (closed-loop load generator);
+``benchmarks/chaos_lab.py`` drills the failure paths
+(docs/ROBUSTNESS.md).
 """
 
-from photon_ml_tpu.serving.batcher import Backpressure, MicroBatcher
+from photon_ml_tpu.serving.batcher import (
+    Backpressure,
+    DeadlineExceeded,
+    MicroBatcher,
+)
 from photon_ml_tpu.serving.engine import (
     DEFAULT_MIN_BUCKET,
     ScoreRequest,
@@ -31,6 +42,8 @@ from photon_ml_tpu.serving.registry import (
     ModelRegistry,
     ModelVersion,
     NoModelLoaded,
+    ReloadCircuitBreaker,
+    ReloadQuarantined,
 )
 from photon_ml_tpu.serving.stats import (
     LatencyHistogram,
@@ -41,6 +54,7 @@ from photon_ml_tpu.serving.stats import (
 
 __all__ = [
     "Backpressure",
+    "DeadlineExceeded",
     "MicroBatcher",
     "DEFAULT_MIN_BUCKET",
     "ScoreRequest",
@@ -51,6 +65,8 @@ __all__ = [
     "ModelRegistry",
     "ModelVersion",
     "NoModelLoaded",
+    "ReloadCircuitBreaker",
+    "ReloadQuarantined",
     "LatencyHistogram",
     "ServingStats",
     "install_compile_listener",

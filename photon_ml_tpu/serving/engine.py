@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import obs
+from photon_ml_tpu.resilience import faults as _faults
 
 from photon_ml_tpu.game.data import GameData
 from photon_ml_tpu.game.scoring import (
@@ -223,8 +224,16 @@ class ScoringEngine:
         self._re_keys = sorted(
             {rk for rk in self.random_effects.values() if rk is not None}
         )
+        # fixed-effect-only coordinates: the degraded-mode scoring set
+        # (admission control's "cheaper answer for everyone" fallback)
+        self._fixed_coords = [
+            name
+            for name in self._coord_order
+            if self.random_effects.get(name) is None
+        ]
         self._scorer = jax.jit(self._score_padded)
-        self._compiled: Dict[int, object] = {}
+        self._scorer_fixed = jax.jit(self._score_padded_fixed)
+        self._compiled: Dict[object, object] = {}
         self._lock = threading.Lock()
         self.compile_count = 0
         # which ELL backend this engine's executables traced with
@@ -278,14 +287,34 @@ class ScoringEngine:
                 )
         return total
 
+    def _score_padded_fixed(self, params, feats):
+        """Degraded-mode traced body: ONLY the fixed-effect coordinates.
+        No entity gathers, no random-effect tables touched — the cheap
+        executable admission control falls back to under sustained
+        pressure. A model with no fixed coordinate scores 0 (the
+        cold-start value every random effect already returns)."""
+        n = feats[self._used_shards[0]].shape[0]
+        total = jnp.zeros((n,), self.dtype)
+        for name in self._fixed_coords:
+            total = total + _fixed_scores(
+                params[name], feats[self.shards[name]]
+            )
+        return total
+
     # -- compilation cache -------------------------------------------------
 
-    def _ensure_compiled(self, bucket: int, dims: Optional[Dict[str, int]] = None):
+    def _ensure_compiled(
+        self,
+        bucket: int,
+        dims: Optional[Dict[str, int]] = None,
+        fixed_only: bool = False,
+    ):
         """Executable for one padded bucket; ``dims`` (shard -> feature
         dim) defaults to the vocabularies' lengths. Shard dims are a fixed
-        property of the model, so the cache keys on bucket alone."""
+        property of the model, so the cache keys on (bucket, mode)."""
+        cache_key = (bucket, "fixed") if fixed_only else bucket
         with self._lock:
-            hit = self._compiled.get(bucket)
+            hit = self._compiled.get(cache_key)
         if hit is not None:
             self.stats.record_bucket(bucket, hit=True)
             return hit
@@ -295,13 +324,20 @@ class ScoringEngine:
             )
             for s in self._used_shards
         }
-        ents_s = {
-            rk: jax.ShapeDtypeStruct((bucket,), jnp.int32)
-            for rk in self._re_keys
-        }
-        compiled = self._scorer.lower(self._params, feats_s, ents_s).compile()
+        if fixed_only:
+            compiled = self._scorer_fixed.lower(
+                self._params, feats_s
+            ).compile()
+        else:
+            ents_s = {
+                rk: jax.ShapeDtypeStruct((bucket,), jnp.int32)
+                for rk in self._re_keys
+            }
+            compiled = self._scorer.lower(
+                self._params, feats_s, ents_s
+            ).compile()
         with self._lock:
-            prior = self._compiled.setdefault(bucket, compiled)
+            prior = self._compiled.setdefault(cache_key, compiled)
         if prior is compiled:
             self.compile_count += 1
             self.stats.record_compile()
@@ -310,7 +346,9 @@ class ScoringEngine:
             # this back for live MFU attribution; the analyses run on an
             # already-compiled object, so recording costs attribute reads
             obs.cost_book().record(
-                "serving.score", compiled, bucket=str(bucket)
+                "serving.score",
+                compiled,
+                bucket=f"{bucket}-fixed" if fixed_only else str(bucket),
             )
         self.stats.record_bucket(bucket, hit=False)
         return prior
@@ -338,11 +376,15 @@ class ScoringEngine:
         self,
         buckets: Optional[Sequence[int]] = None,
         max_batch: Optional[int] = None,
+        include_degraded: bool = False,
     ) -> Sequence[int]:
         """AOT-compile the executables for a fixed bucket set (default:
         the power-of-two ladder up to ``max_batch`` or ``max_bucket``).
         After this, any batch of at most the largest warmed bucket scores
-        with zero compiles. Returns the warmed buckets."""
+        with zero compiles. ``include_degraded`` also warms the
+        fixed-effect-only ladder, so the FIRST degraded batch under
+        overload doesn't pay a compile right when latency matters most.
+        Returns the warmed buckets."""
         if buckets is None:
             buckets = warmup_buckets(
                 max_batch or self.max_bucket, self.min_bucket
@@ -353,6 +395,8 @@ class ScoringEngine:
         with obs.hbm_watermark("serving.warmup"):
             for b in buckets:
                 self._ensure_compiled(int(b))
+                if include_degraded:
+                    self._ensure_compiled(int(b), fixed_only=True)
         return list(buckets)
 
     # -- featurization (host-side, numpy only: no tracing on this path) ----
@@ -418,10 +462,13 @@ class ScoringEngine:
         features: Dict[str, np.ndarray],
         entity_ids: Optional[Dict[str, np.ndarray]] = None,
         offsets: Optional[np.ndarray] = None,
+        fixed_only: bool = False,
     ) -> np.ndarray:
         """Score pre-featurized dense rows. ``features`` maps every shard
         the model uses to a (B, d_shard) array; ``entity_ids`` maps each
-        random-effect type to (B,) int32 indices (-1 = unknown). Returns
+        random-effect type to (B,) int32 indices (-1 = unknown). With
+        ``fixed_only`` the random-effect/factored coordinates are skipped
+        (degraded mode: every row scores as if cold-start). Returns
         (B,) float scores (+ offsets when given)."""
         entity_ids = entity_ids or {}
         missing = [s for s in self._used_shards if s not in features]
@@ -429,6 +476,12 @@ class ScoringEngine:
             raise KeyError(f"missing feature shard(s): {missing}")
         n = int(np.shape(features[self._used_shards[0]])[0])
         bucket = bucket_size(n, self.min_bucket)
+        # chaos seam: device scoring. raise-mode surfaces through the
+        # batcher to the request futures (engine state untouched, the
+        # NEXT batch scores clean); delay-mode is the tail-latency drill;
+        # corrupt-mode poisons the scores with NaN (a device/table
+        # corruption simulant callers must be able to observe).
+        action = _faults.fire("serving.score", key=str(bucket))
         feats_p = {
             s: _pad_rows(np.asarray(features[s], self.dtype), bucket)
             for s in self._used_shards
@@ -443,17 +496,27 @@ class ScoringEngine:
             )
             ents_p[rk] = _pad_rows(col, bucket, fill=-1)
         compiled = self._ensure_compiled(
-            bucket, {s: feats_p[s].shape[1] for s in self._used_shards}
+            bucket,
+            {s: feats_p[s].shape[1] for s in self._used_shards},
+            fixed_only=fixed_only,
         )
         with obs.span(
             "serving.score",
             cat="serving",
             bucket=bucket,
             rows=n,
+            fixed_only=fixed_only,
             sparse_kernel=self._sparse_kernel,
         ) as sp:
             t0 = time.perf_counter()
-            out = np.asarray(compiled(self._params, feats_p, ents_p))[:n]
+            if fixed_only:
+                out = np.asarray(compiled(self._params, feats_p))[:n]
+            else:
+                out = np.asarray(
+                    compiled(self._params, feats_p, ents_p)
+                )[:n]
+            if action.corrupt:
+                out = np.full_like(out, np.nan)
             elapsed = time.perf_counter() - t0
             # per-bucket device latency: the aggregate device_ms
             # histogram cannot say WHICH padded size is slow
@@ -464,18 +527,24 @@ class ScoringEngine:
                 # live MFU for this score bucket from the cost book
                 obs.annotate_span(
                     sp,
-                    obs.cost_book().lookup("serving.score", str(bucket)),
+                    obs.cost_book().lookup(
+                        "serving.score",
+                        f"{bucket}-fixed" if fixed_only else str(bucket),
+                    ),
                     seconds=elapsed,
                 )
         if offsets is not None:
             out = out + np.asarray(offsets, out.dtype)
         return out
 
-    def score(self, requests: Sequence[ScoreRequest]) -> np.ndarray:
+    def score(
+        self, requests: Sequence[ScoreRequest], fixed_only: bool = False
+    ) -> np.ndarray:
         """Featurize and score a batch of requests (scores include each
-        request's offset)."""
+        request's offset). ``fixed_only`` is the degraded serving mode:
+        random effects are skipped, every request scores like cold-start."""
         feats, ents, offsets = self.featurize(requests)
-        return self.score_arrays(feats, ents, offsets)
+        return self.score_arrays(feats, ents, offsets, fixed_only=fixed_only)
 
     def score_data(self, data: GameData) -> np.ndarray:
         """Score a dense-sharded :class:`GameData` through the bucketed

@@ -25,6 +25,16 @@ traffic. The registry owns that lifecycle:
   exports (subdirectories, lexically-newest last) and reloads when a new
   verified version lands — the push-by-filesystem protocol of the
   reference's HDFS model directories.
+
+- **Reload circuit breaker.** A reload/warmup failure used to be
+  re-attempted on EVERY poll forever — a broken export turned the watch
+  loop into a busy verify/compile loop competing with live traffic.
+  Now ``breaker_threshold`` consecutive failures of the same export dir
+  quarantine it: the breaker OPENS, polls skip it, and only an
+  exponentially-backed-off half-open probe re-attempts; a probe success
+  closes the breaker, a failure re-opens it with doubled backoff. The
+  last-good version serves throughout (:meth:`ModelRegistry.health`
+  exposes the state; ``{"cmd": "health"}`` on ``cli/serve.py``).
 """
 
 from __future__ import annotations
@@ -32,21 +42,139 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Callable, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu import obs
 from photon_ml_tpu.io.models import (
     MODEL_MANIFEST,
     ModelIntegrityError,
     verify_model_manifest,
 )
+from photon_ml_tpu.resilience import faults as _faults
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.serving.stats import ServingStats
 
 
 class NoModelLoaded(RuntimeError):
     """score/acquire before any version was loaded."""
+
+
+class ReloadQuarantined(RuntimeError):
+    """Load refused: the export dir's breaker is open (too many
+    consecutive reload/warmup failures; next probe not yet due)."""
+
+
+class ReloadCircuitBreaker:
+    """Per-export-dir breaker state machine (closed -> open -> half-open).
+
+    - **closed**: attempts allowed; ``threshold`` CONSECUTIVE failures
+      open the breaker.
+    - **open**: attempts refused until ``backoff_s`` (doubling per
+      re-open, capped at ``max_backoff_s``) has elapsed.
+    - **half-open**: the first :meth:`allow` after the backoff admits ONE
+      probe attempt; success closes the breaker and clears the failure
+      count, failure re-opens with doubled backoff.
+
+    Thread-safe; keyed by normalized export path so a republished export
+    at the same path probes through the same breaker.
+    """
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        backoff_s: float = 30.0,
+        max_backoff_s: float = 600.0,
+    ):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._lock = threading.Lock()
+        # key -> {failures, next_probe (monotonic), backoff, probing}
+        self._dirs: Dict[str, dict] = {}
+
+    @staticmethod
+    def _key(root: str) -> str:
+        return os.path.normpath(os.path.abspath(root))
+
+    def _entry(self, root: str) -> dict:
+        return self._dirs.setdefault(
+            self._key(root),
+            {"failures": 0, "next_probe": 0.0, "backoff": self.backoff_s,
+             "probing": False},
+        )
+
+    def state(self, root: str) -> str:
+        with self._lock:
+            e = self._dirs.get(self._key(root))
+            if e is None or e["failures"] < self.threshold:
+                return "closed"
+            if time.monotonic() >= e["next_probe"]:
+                return "half_open"
+            return "open"
+
+    def allow(self, root: str) -> bool:
+        """True when an attempt on ``root`` may proceed (closed, or
+        half-open with the probe slot free)."""
+        with self._lock:
+            e = self._entry(root)
+            if e["failures"] < self.threshold:
+                return True
+            if time.monotonic() < e["next_probe"]:
+                return False
+            # half-open: admit one probe at a time
+            if e["probing"]:
+                return False
+            e["probing"] = True
+            return True
+
+    def record_failure(self, root: str) -> bool:
+        """Count a failed attempt; returns True when this failure OPENED
+        (or re-opened) the breaker."""
+        with self._lock:
+            e = self._entry(root)
+            was_open = e["failures"] >= self.threshold
+            e["failures"] += 1
+            e["probing"] = False
+            if e["failures"] < self.threshold:
+                return False
+            if was_open:
+                # failed half-open probe: double the backoff
+                e["backoff"] = min(e["backoff"] * 2.0, self.max_backoff_s)
+            e["next_probe"] = time.monotonic() + e["backoff"]
+            return True
+
+    def record_success(self, root: str) -> None:
+        with self._lock:
+            self._dirs.pop(self._key(root), None)
+
+    def quarantined(self) -> Dict[str, dict]:
+        """Snapshot of every open/half-open dir (the health endpoint)."""
+        now = time.monotonic()
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for key, e in self._dirs.items():
+                if e["failures"] < self.threshold:
+                    continue
+                out[key] = {
+                    "failures": e["failures"],
+                    "backoff_s": round(e["backoff"], 3),
+                    "next_probe_in_s": round(
+                        max(0.0, e["next_probe"] - now), 3
+                    ),
+                }
+        return out
+
+    def snapshot(self) -> dict:
+        quarantined = self.quarantined()
+        return {
+            "threshold": self.threshold,
+            "open_dirs": quarantined,
+            "state": "open" if quarantined else "closed",
+        }
 
 
 class ModelVersion:
@@ -76,14 +204,20 @@ class ModelRegistry:
         engine_factory: Optional[Callable[[str], ScoringEngine]] = None,
         verify: bool = True,
         warmup_max_batch: Optional[int] = 64,
+        warmup_degraded: bool = False,
         retire_timeout_s: float = 60.0,
         stats: Optional[ServingStats] = None,
+        breaker: Optional[ReloadCircuitBreaker] = None,
+        breaker_threshold: int = 3,
+        breaker_backoff_s: float = 30.0,
+        breaker_max_backoff_s: float = 600.0,
         logger=None,
         **engine_kwargs,
     ):
         self.stats = stats if stats is not None else ServingStats()
         self._verify = verify
         self._warmup_max_batch = warmup_max_batch
+        self._warmup_degraded = warmup_degraded
         self._retire_timeout_s = retire_timeout_s
         self._logger = logger
         self._engine_kwargs = engine_kwargs
@@ -92,6 +226,15 @@ class ModelRegistry:
         self._current: Optional[ModelVersion] = None
         self._reload_lock = threading.Lock()  # one reload at a time
         self.retired_versions = []  # version ids, oldest first
+        self.breaker = (
+            breaker
+            if breaker is not None
+            else ReloadCircuitBreaker(
+                threshold=breaker_threshold,
+                backoff_s=breaker_backoff_s,
+                max_backoff_s=breaker_max_backoff_s,
+            )
+        )
 
     def _default_factory(self, root: str) -> ScoringEngine:
         return ScoringEngine.from_model_dir(
@@ -100,20 +243,64 @@ class ModelRegistry:
 
     # -- loading / hot-reload ----------------------------------------------
 
-    def load(self, root: str, version_id: Optional[str] = None) -> ModelVersion:
+    def load(
+        self,
+        root: str,
+        version_id: Optional[str] = None,
+        force: bool = False,
+    ) -> ModelVersion:
         """Verify, build, warm up, then atomically swap in a model export.
         Any failure (integrity, decode, compile) raises WITHOUT touching
-        the currently-served version. The superseded version is retired
-        after its in-flight requests drain."""
+        the currently-served version and counts against ``root``'s
+        circuit breaker; once open, further loads raise
+        :class:`ReloadQuarantined` until a backoff probe is due
+        (``force=True`` — the operator's explicit ``{"cmd": "reload"}`` —
+        bypasses the quarantine check but still records the outcome).
+        The superseded version is retired after its in-flight requests
+        drain."""
         version_id = version_id or os.path.basename(
             os.path.normpath(root)
         )
         with self._reload_lock:
-            if self._verify:
-                verify_model_manifest(root)
-            engine = self._factory(root)
-            if self._warmup_max_batch:
-                engine.warmup(max_batch=self._warmup_max_batch)
+            if not force and not self.breaker.allow(root):
+                raise ReloadQuarantined(
+                    f"export {root!r} is quarantined after "
+                    f"{self.breaker.threshold}+ consecutive reload "
+                    "failures; next probe pending"
+                )
+            try:
+                # chaos seam: registry load/warmup. raise-mode is the
+                # broken-export drill (breaker opens, last-good serves);
+                # delay-mode stretches the warmup window under load.
+                _faults.fire("serving.reload", key=version_id)
+                if self._verify:
+                    verify_model_manifest(root)
+                engine = self._factory(root)
+                if self._warmup_max_batch:
+                    engine.warmup(
+                        max_batch=self._warmup_max_batch,
+                        include_degraded=self._warmup_degraded,
+                    )
+            except BaseException as e:
+                self.stats.record_reload_failure()
+                opened = self.breaker.record_failure(root)
+                obs.emit_event(
+                    "serving.reload_failed",
+                    cat="serving",
+                    version=version_id,
+                    error=repr(e),
+                    breaker_opened=opened,
+                )
+                if opened:
+                    obs.registry().inc("serving.breaker_opened")
+                    if self._logger is not None:
+                        self._logger.warn(
+                            f"reload breaker OPEN for {root!r} after "
+                            f"repeated failures ({e!r}); last-good "
+                            "version keeps serving"
+                        )
+                raise
+            self.breaker.record_success(root)
             version = ModelVersion(version_id, root, engine)
             with self._cond:
                 old = self._current
@@ -185,15 +372,41 @@ class ModelRegistry:
         finally:
             self.release(v)
 
+    def score_fixed_only(self, requests: Sequence[object]) -> np.ndarray:
+        """Degraded-mode scorer (fixed effects only, no random-effect
+        gathers) — the ``degraded_score_fn`` for the batcher's
+        sustained-pressure fallback."""
+        v = self.acquire()
+        try:
+            return v.engine.score(requests, fixed_only=True)
+        finally:
+            self.release(v)
+
+    # -- health ------------------------------------------------------------
+
+    def health(self) -> dict:
+        """Version + breaker state for the serve ``{"cmd": "health"}``
+        endpoint."""
+        v = self.current
+        return {
+            "version": v.version_id if v is not None else None,
+            "inflight": v.inflight if v is not None else 0,
+            "reloads": int(self.stats.reloads),
+            "reload_failures": int(self.stats.reload_failures),
+            "retired_versions": list(self.retired_versions),
+            "breaker": self.breaker.snapshot(),
+        }
+
     # -- watch mode --------------------------------------------------------
 
     def poll(self, watch_root: str) -> Optional[str]:
         """Scan ``watch_root`` for version subdirectories carrying a model
         manifest; when the lexically newest differs from the current
         version, hot-reload it. Returns the newly-loaded version id, or
-        None (including when the candidate fails verification — the
-        current version keeps serving and the bad export is skipped until
-        it changes)."""
+        None — the current version keeps serving when the candidate fails
+        to load. A failing candidate counts against its breaker: once
+        open, subsequent polls SKIP it (no verify/compile churn against
+        live traffic) until a backoff probe is due."""
         if not os.path.isdir(watch_root):
             return None
         candidates = sorted(
@@ -208,9 +421,15 @@ class ModelRegistry:
         newest = candidates[-1]
         if self.version() == newest:
             return None
+        root = os.path.join(watch_root, newest)
+        if not self.breaker.allow(root):
+            return None  # quarantined; next backoff probe will re-try
         try:
-            self.load(os.path.join(watch_root, newest), version_id=newest)
-        except (ModelIntegrityError, OSError, ValueError) as e:
+            # force=True: allow() above already consumed the half-open
+            # probe slot; load() must not re-ask (it would refuse the
+            # probe it was granted)
+            self.load(root, version_id=newest, force=True)
+        except (ModelIntegrityError, OSError, ValueError, RuntimeError) as e:
             if self._logger is not None:
                 self._logger.warn(
                     f"candidate version {newest!r} failed to load ({e}); "

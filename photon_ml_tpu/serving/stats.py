@@ -64,7 +64,11 @@ class ServingStats:
         "bucket_hits",
         "bucket_misses",
         "reloads",
+        "reload_failures",
         "occupancy_sum",
+        "expired",
+        "shed",
+        "degraded_batches",
     )
 
     def __init__(
@@ -145,6 +149,30 @@ class ServingStats:
         with self._lock:
             self._inc("rejected")
 
+    def record_expired(self) -> None:
+        """A request whose deadline passed while it sat in the queue —
+        dropped BEFORE batch assembly, so it never burned device work."""
+        with self._lock:
+            self._inc("expired")
+
+    def record_shed(self) -> None:
+        """A queued request evicted by admission control to admit a
+        higher-priority one (the bounded queue was full)."""
+        with self._lock:
+            self._inc("shed")
+
+    def record_degraded(self, active: bool) -> None:
+        """Degraded-mode gauge: 1 while sustained pressure has switched
+        scoring to fixed-effect-only, 0 in full-fidelity mode."""
+        with self._lock:
+            self.registry.set_gauge(
+                "serving.degraded", 1.0 if active else 0.0
+            )
+
+    def record_degraded_batch(self) -> None:
+        with self._lock:
+            self._inc("degraded_batches")
+
     def record_error(self) -> None:
         with self._lock:
             self._inc("errors")
@@ -152,6 +180,10 @@ class ServingStats:
     def record_reload(self) -> None:
         with self._lock:
             self._inc("reloads")
+
+    def record_reload_failure(self) -> None:
+        with self._lock:
+            self._inc("reload_failures")
 
     # -- readout -----------------------------------------------------------
 
@@ -176,8 +208,15 @@ class ServingStats:
                 "requests": int(requests),
                 "batches": int(batches),
                 "rejected": int(self.rejected),
+                "expired": int(self.expired),
+                "shed": int(self.shed),
                 "errors": int(self.errors),
                 "reloads": int(self.reloads),
+                "reload_failures": int(self.reload_failures),
+                "degraded_batches": int(self.degraded_batches),
+                "degraded": int(
+                    self.registry.gauge("serving.degraded").value
+                ),
                 "qps": round(qps, 2),
                 "batch_occupancy_mean": (
                     self.occupancy_sum / batches if batches else 0.0

@@ -1,0 +1,499 @@
+"""Chaos-layer drills: runtime-wide fault sites + graceful degradation.
+
+The contract under test (docs/ROBUSTNESS.md): every subsystem seam has a
+drillable fault site that is CHEAP when unarmed and VALIDATED when armed;
+the serving path degrades by policy (deadlines expire before batch
+assembly, admission control sheds by priority, sustained pressure flips
+fixed-effect-only mode, a repeatedly-failing reload quarantines behind a
+circuit breaker while last-good serves); the ingest pipeline retries or
+skips by policy under decode faults and stalls; and the async checkpoint
+writer's failures surface at a join and fall back to a synchronous write
+that keeps the durability boundary.
+"""
+
+import os
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.resilience import (
+    FaultSpec,
+    InjectedFault,
+    UnknownFaultSite,
+    inject,
+    known_sites,
+    register_site,
+)
+from photon_ml_tpu.resilience.faults import KNOWN_SITES, _EXTRA_SITES
+from photon_ml_tpu.serving.batcher import (
+    Backpressure,
+    DeadlineExceeded,
+    MicroBatcher,
+    _DegradeController,
+)
+from photon_ml_tpu.serving.registry import (
+    ModelRegistry,
+    ReloadCircuitBreaker,
+    ReloadQuarantined,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# arm-time validation (the typo'd-drill satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestArmTimeValidation:
+    def test_unknown_site_raises_with_site_list(self):
+        with pytest.raises(UnknownFaultSite) as ei:
+            with inject(FaultSpec("serving.scoer", "raise", nth=1)):
+                pass
+        msg = str(ei.value)
+        for site in KNOWN_SITES:
+            assert site in msg
+
+    def test_env_arming_rejects_unknown_site(self, monkeypatch):
+        from photon_ml_tpu.resilience.faults import (
+            ENV_VAR,
+            FaultInjector,
+            arm_from_env,
+        )
+
+        monkeypatch.setenv(ENV_VAR, "checkpoint.sve:raise@n=1")
+        with pytest.raises(UnknownFaultSite):
+            arm_from_env(FaultInjector())
+
+    def test_every_known_site_arms(self):
+        for site in known_sites():
+            with inject(FaultSpec(site, "delay", nth=10**9, delay=0.0)):
+                pass
+
+    def test_register_site_extends_the_registry(self):
+        register_site("test.extra_seam")
+        try:
+            with inject(FaultSpec("test.extra_seam", "raise", nth=1)):
+                pass
+        finally:
+            _EXTRA_SITES.discard("test.extra_seam")
+
+    def test_new_sites_are_known(self):
+        for site in (
+            "serving.score",
+            "serving.reload",
+            "pipeline.decode",
+            "pipeline.transfer",
+            "checkpoint.async_write",
+            "collective.allreduce",
+        ):
+            assert site in KNOWN_SITES
+
+
+# ---------------------------------------------------------------------------
+# deadlines / admission control / degraded mode (the batcher tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _blocked_batcher(**kw):
+    """A batcher whose worker is WEDGED on a gate so queue state is
+    fully deterministic for admission-control drills."""
+    import threading
+
+    gate = threading.Event()
+    started = threading.Event()
+
+    def score_fn(reqs):
+        started.set()
+        gate.wait(10.0)
+        return np.zeros(len(reqs))
+
+    b = MicroBatcher(score_fn, max_batch=1, max_wait_ms=0.1, **kw)
+    return b, gate, started
+
+
+class TestDeadlinesAndAdmission:
+    def test_expired_request_drops_before_device_work(self):
+        calls = []
+
+        def score_fn(reqs):
+            calls.append(len(reqs))
+            time.sleep(0.05)
+            return np.zeros(len(reqs))
+
+        b = MicroBatcher(score_fn, max_batch=1, max_wait_ms=0.1)
+        try:
+            # wedge the (single-slot) worker with a long batch, then
+            # queue a request that expires while it waits
+            f_long = b.submit(object())
+            f_dead = b.submit(object(), deadline_ms=1.0)
+            f_long.result(timeout=5.0)
+            with pytest.raises(DeadlineExceeded):
+                f_dead.result(timeout=5.0)
+        finally:
+            b.drain(timeout=5.0)
+        # the expired request never reached score_fn: only the first
+        # request burned device work
+        assert sum(calls) == 1
+        assert int(b.stats.requests) == 1
+        assert int(b.stats.expired) == 1
+
+    def test_score_sync_timeout_is_a_deadline_now(self):
+        b, gate, started = _blocked_batcher()
+        try:
+            b.submit(object())  # wedges the worker
+            started.wait(5.0)
+            from concurrent.futures import TimeoutError as FutTimeout
+
+            t0 = time.perf_counter()
+            with pytest.raises((DeadlineExceeded, FutTimeout, TimeoutError)):
+                b.score_sync(object(), timeout=0.05)
+            assert time.perf_counter() - t0 < 5.0
+        finally:
+            gate.set()
+            b.drain(timeout=5.0)
+        # the timed-out request EXPIRES instead of burning device work
+        assert int(b.stats.expired) >= 1
+
+    def test_admission_expires_dead_entries_for_a_newcomer(self):
+        b, gate, started = _blocked_batcher(queue_depth=2)
+        try:
+            b.submit(object())  # wedge
+            started.wait(5.0)
+            f1 = b.submit(object(), deadline_ms=1.0)
+            f2 = b.submit(object(), deadline_ms=1.0)
+            time.sleep(0.01)  # both queued entries are now dead
+            f3 = b.submit(object())  # full queue -> expiry scan admits
+            for f in (f1, f2):
+                with pytest.raises(DeadlineExceeded):
+                    f.result(timeout=5.0)
+            gate.set()
+            assert isinstance(f3.result(timeout=5.0), float)
+        finally:
+            gate.set()
+            b.drain(timeout=5.0)
+        assert int(b.stats.expired) == 2
+
+    def test_priority_sheds_oldest_lowest_only_when_outranked(self):
+        b, gate, started = _blocked_batcher(queue_depth=2)
+        try:
+            b.submit(object())  # wedge
+            started.wait(5.0)
+            f_low_old = b.submit(object(), priority=0)
+            f_low_new = b.submit(object(), priority=0)
+            # same priority never sheds
+            with pytest.raises(Backpressure):
+                b.submit(object(), priority=0)
+            # higher priority sheds the OLDEST lowest-priority entry
+            f_hi = b.submit(object(), priority=5)
+            with pytest.raises(Backpressure):
+                f_low_old.result(timeout=5.0)
+            gate.set()
+            assert isinstance(f_hi.result(timeout=5.0), float)
+            assert isinstance(f_low_new.result(timeout=5.0), float)
+        finally:
+            gate.set()
+            b.drain(timeout=5.0)
+        assert int(b.stats.shed) == 1
+        assert int(b.stats.rejected) == 1
+
+    def test_degrade_controller_hysteresis(self):
+        c = _DegradeController(
+            high_water=0.8, low_water=0.25,
+            degrade_after_s=0.1, recover_after_s=0.1,
+        )
+        assert c.note(9, 10, now=0.0) is None  # above, timer starts
+        assert c.note(9, 10, now=0.05) is None  # not sustained yet
+        assert c.note(9, 10, now=0.15) is True  # sustained -> degraded
+        assert c.degraded
+        assert c.note(5, 10, now=0.2) is None  # hysteresis band: hold
+        assert c.degraded
+        assert c.note(1, 10, now=0.3) is None  # below, timer starts
+        assert c.note(1, 10, now=0.45) is False  # sustained -> recover
+        assert not c.degraded
+
+    def test_degraded_mode_routes_to_fixed_only_and_recovers(self):
+        full_calls, degraded_calls = [], []
+
+        def full(reqs):
+            full_calls.append(len(reqs))
+            return np.zeros(len(reqs))
+
+        def degraded(reqs):
+            degraded_calls.append(len(reqs))
+            return np.ones(len(reqs))
+
+        b = MicroBatcher(
+            full,
+            max_batch=4,
+            max_wait_ms=0.1,
+            queue_depth=10,
+            degraded_score_fn=degraded,
+            degrade=_DegradeController(
+                high_water=0.1, low_water=0.05,
+                degrade_after_s=0.0, recover_after_s=10.0,
+            ),
+        )
+        try:
+            # first submit observes depth>=1/10 >= high_water with a
+            # zero sustain window -> degraded engages immediately
+            futs = [b.submit(object()) for _ in range(8)]
+            vals = {f.result(timeout=5.0) for f in futs}
+            assert 1.0 in vals, "no batch routed to the degraded scorer"
+            assert b.degraded()
+            assert int(b.stats.degraded_batches) >= 1
+        finally:
+            b.drain(timeout=5.0)
+
+    def test_health_snapshot_keys(self):
+        b = MicroBatcher(lambda r: np.zeros(len(r)), max_batch=2)
+        try:
+            h = b.health()
+        finally:
+            b.drain(timeout=5.0)
+        for k in (
+            "queue_depth", "queue_capacity", "draining", "degraded",
+            "expired", "shed", "rejected", "errors", "requests",
+        ):
+            assert k in h
+
+
+# ---------------------------------------------------------------------------
+# serving.score fault site
+# ---------------------------------------------------------------------------
+
+
+class TestServingScoreFaults:
+    def test_raise_surfaces_to_future_and_engine_recovers(self):
+        from photon_ml_tpu.resilience.drills import (
+            build_drill_engine,
+            make_drill_request,
+        )
+
+        rng = np.random.default_rng(5)
+        engine = build_drill_engine(rng)
+        b = MicroBatcher(engine.score, max_batch=4, max_wait_ms=0.2)
+        try:
+            b.score_sync(make_drill_request(rng), timeout=30.0)
+            with inject(FaultSpec("serving.score", "raise", nth=1)):
+                with pytest.raises(InjectedFault):
+                    b.score_sync(make_drill_request(rng), timeout=30.0)
+            s = b.score_sync(make_drill_request(rng), timeout=30.0)
+            assert np.isfinite(s)
+        finally:
+            b.drain(timeout=5.0)
+        assert int(b.stats.errors) == 1
+
+
+# ---------------------------------------------------------------------------
+# reload circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestReloadCircuitBreaker:
+    def test_state_machine_and_backoff_doubling(self):
+        brk = ReloadCircuitBreaker(
+            threshold=2, backoff_s=0.05, max_backoff_s=0.2
+        )
+        root = "/tmp/export-v1"
+        assert brk.state(root) == "closed"
+        assert brk.allow(root)
+        assert not brk.record_failure(root)
+        assert brk.record_failure(root)  # threshold -> opens
+        assert brk.state(root) == "open"
+        assert not brk.allow(root)
+        time.sleep(0.06)
+        assert brk.state(root) == "half_open"
+        assert brk.allow(root)  # the probe slot
+        assert not brk.allow(root)  # only ONE probe at a time
+        assert brk.record_failure(root)  # probe failed -> reopen, 2x
+        snap = brk.quarantined()
+        (entry,) = snap.values()
+        assert entry["backoff_s"] == pytest.approx(0.1)
+        time.sleep(0.11)
+        assert brk.allow(root)
+        brk.record_success(root)
+        assert brk.state(root) == "closed"
+        assert brk.quarantined() == {}
+
+    def test_load_quarantines_and_raises(self, tmp_path):
+        from photon_ml_tpu.resilience.drills import _save_drill_export
+
+        rng = np.random.default_rng(9)
+        root = _save_drill_export(str(tmp_path / "v1"), rng)
+        reg = ModelRegistry(
+            warmup_max_batch=8, breaker_threshold=2, breaker_backoff_s=30.0
+        )
+        with inject(
+            FaultSpec("serving.reload", "raise", nth=1, count=-1)
+        ):
+            for _ in range(2):
+                with pytest.raises(InjectedFault):
+                    reg.load(root)
+            with pytest.raises(ReloadQuarantined):
+                reg.load(root)
+            # operator-explicit force bypasses quarantine (and fails
+            # through to the real error)
+            with pytest.raises(InjectedFault):
+                reg.load(root, force=True)
+        assert int(reg.stats.reload_failures) == 3
+        assert reg.health()["breaker"]["state"] == "open"
+
+    def test_full_breaker_lifecycle_under_traffic(self):
+        from photon_ml_tpu.resilience.drills import breaker_drill
+
+        out = breaker_drill(threshold=2, backoff_s=0.2)
+        assert out["client_errors"] == 0
+        assert out["breaker_recovery_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# overload: deadlines + shed + degrade, nothing lost
+# ---------------------------------------------------------------------------
+
+
+class TestOverload:
+    def test_overload_sheds_only_budgeted_requests(self):
+        from photon_ml_tpu.resilience.drills import drill_overload
+
+        out = drill_overload(True)
+        assert out["lost"] == 0
+        assert out["errors"] == 0
+        assert out["expired"] > 0 and out["shed"] + out["rejected"] > 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline chaos: decode retry, watchdog stall, skip policy
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineChaos:
+    def test_decode_fault_watchdog_and_skip_policy(self):
+        native = pytest.importorskip("photon_ml_tpu.io.native")
+        if not native.native_available():
+            pytest.skip(f"native reader: {native.native_error()}")
+        from photon_ml_tpu.resilience.drills import drill_pipeline_decode
+
+        out = drill_pipeline_decode(True)
+        assert out["bit_identical_after_retry"]
+        assert out["rows_after_skip"] < out["rows"]
+
+    def test_epoch_policy_validation(self):
+        from photon_ml_tpu.io.pipeline import PipelineConfig
+
+        with pytest.raises(ValueError):
+            PipelineConfig(epoch_policy="explode").validate()
+        with pytest.raises(ValueError):
+            PipelineConfig(stage_timeout_s=-1.0).validate()
+
+    def test_watchdog_inline_when_disabled(self):
+        from photon_ml_tpu.io.pipeline import StageStall, _with_watchdog
+
+        assert _with_watchdog(lambda: 42, None, "decode", "x") == 42
+        with pytest.raises(StageStall):
+            _with_watchdog(
+                lambda: time.sleep(1.0), 0.05, "decode", "stall"
+            )
+        with pytest.raises(KeyError):
+            _with_watchdog(
+                lambda: {}["missing"], 5.0, "decode", "error passthrough"
+            )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.async_write: surfaces at join, sync fallback holds
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncCheckpointChaos:
+    def test_writer_fallback_keeps_durability(self, tmp_path):
+        from photon_ml_tpu.game.descent import _AsyncCheckpointWriter
+        from photon_ml_tpu.io.checkpoint import (
+            latest_checkpoint,
+            save_checkpoint,
+        )
+
+        w = _AsyncCheckpointWriter()
+        key = np.zeros(2, np.uint32)
+        reg = obs.registry()
+        before = reg.counter("resilience.ckpt_async_fallbacks").value
+        with inject(FaultSpec("checkpoint.async_write", "raise", nth=1)):
+            w.submit(
+                lambda: save_checkpoint(
+                    str(tmp_path), 1, {"w": np.arange(3.0)}, key
+                )
+            )
+            w.join()  # fault surfaces here; fallback rewrites in-line
+        assert (
+            reg.counter("resilience.ckpt_async_fallbacks").value
+            == before + 1
+        )
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck is not None and ck.step == 1
+        np.testing.assert_array_equal(ck.params["w"], np.arange(3.0))
+
+    def test_double_failure_raises(self, tmp_path):
+        from photon_ml_tpu.game.descent import _AsyncCheckpointWriter
+
+        w = _AsyncCheckpointWriter()
+
+        def boom():
+            raise OSError("disk on fire")
+
+        w.submit(boom)
+        with pytest.raises(OSError):
+            w.join()
+
+    def test_game_run_equivalence_through_fault(self):
+        from photon_ml_tpu.resilience.drills import drill_async_checkpoint
+
+        out = drill_async_checkpoint(True)
+        assert out["fallbacks"] >= 1
+        assert out["checkpoint_restorable"]
+
+
+# ---------------------------------------------------------------------------
+# collective seam + smoke schedule + probe cost
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveSeam:
+    def test_seam_fires_and_recovers(self):
+        from photon_ml_tpu.resilience.drills import drill_collective_seam
+
+        out = drill_collective_seam(True)
+        assert out["straggler_s"] >= 0.05
+
+
+class TestChaosSmoke:
+    def test_site_registry_drill(self):
+        from photon_ml_tpu.resilience.drills import drill_site_registry
+
+        out = drill_site_registry(True)
+        assert out["known_sites"] == len(known_sites())
+
+    def test_smoke_schedule_runs_clean(self):
+        """The tier-1-safe smoke drill: the cheap drills end-to-end
+        through the lab's own runner (report shape + ok flag)."""
+        from photon_ml_tpu.resilience.drills import run_drills
+
+        report = run_drills(
+            smoke=True,
+            include=[
+                "site_registry",
+                "serving_score",
+                "checkpoint_integrity",
+                "collective_seam",
+            ],
+        )
+        assert report["ok"], report
+        assert report["ran"] == 4 and report["passed"] == 4
+
+    def test_unknown_drill_name_rejected(self):
+        from photon_ml_tpu.resilience.drills import run_drills
+
+        with pytest.raises(ValueError):
+            run_drills(include=["nonexistent_drill"])

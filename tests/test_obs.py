@@ -380,6 +380,11 @@ class TestServingStatsCompat:
         "bucket_hits", "bucket_misses", "compile_count",
         "request_latency", "device_latency",
         "queue_depth", "queue_depth_peak", "bucket_latency",
+        # chaos-hardened serving (docs/ROBUSTNESS.md): deadline expiry,
+        # admission-control shedding, degraded mode, breaker failures —
+        # additive keys; everything above is byte-compatible
+        "expired", "shed", "degraded", "degraded_batches",
+        "reload_failures",
     }
 
     def test_snapshot_schema_unchanged(self):
