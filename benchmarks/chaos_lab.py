@@ -6,7 +6,12 @@ invariants (docs/ROBUSTNESS.md): every fault site fires and recovers per
 its policy, an overload run sheds only expired/over-budget requests,
 breaker quarantine keeps the last-good model serving with zero dropped
 in-flight requests, checkpoints stay restorable, and training results
-are bit-equal where faults were fully recovered.
+are bit-equal where faults were fully recovered. The schedule includes
+the elastic multi-host drills (docs/MULTIHOST.md): a stalled collective
+times out + retries with straggler attribution, a host kill leaves a
+final shard set a SMALLER restart resumes bit-identically, and a torn
+or missing checkpoint shard falls back to the newest quorum step — all
+under the same exit-1-on-any-failed-drill gate.
 
     JAX_PLATFORMS=cpu python benchmarks/chaos_lab.py --smoke
 

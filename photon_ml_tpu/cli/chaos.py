@@ -15,6 +15,10 @@ suite on the deployment host.
     # run the scripted drills (the chaos_lab schedule) on this host
     python -m photon_ml_tpu.cli.chaos drill --smoke --report drills.json
 
+    # just the elastic multi-host schedule (docs/MULTIHOST.md):
+    # collective watchdog, heartbeat loss, host-kill recovery, torn shard
+    python -m photon_ml_tpu.cli.chaos drill --multihost-smoke
+
 ``plan`` exits 2 on a schedule that would not arm — an unknown site or
 bad grammar; since arm-time validation landed, a typo'd site raises
 instead of silently drilling nothing, and ``plan`` is the preflight
@@ -93,15 +97,20 @@ def _cmd_plan(args) -> int:
 def _cmd_drill(args) -> int:
     import jax
 
-    if args.smoke:
+    if args.smoke or args.multihost_smoke:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
     from photon_ml_tpu.resilience import drills
 
+    include = args.drills
+    if args.multihost_smoke:
+        # the elastic multi-host schedule (docs/MULTIHOST.md): collective
+        # watchdog, heartbeat loss, host-kill recovery, torn-shard quorum
+        include = list(drills.MULTIHOST_DRILLS) + (args.drills or [])
     report = drills.run_drills(
-        smoke=args.smoke,
-        include=args.drills,
+        smoke=args.smoke or args.multihost_smoke,
+        include=include,
         logger=lambda line: print(line, file=sys.stderr),
     )
     print(json.dumps(report, indent=2))
@@ -129,6 +138,10 @@ def main(argv=None) -> None:
     pd = sub.add_parser("drill", help="run the scripted drill schedule")
     pd.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe configuration")
+    pd.add_argument("--multihost-smoke", action="store_true",
+                    help="run the elastic multi-host schedule only "
+                    "(collective watchdog, heartbeat loss, host-kill "
+                    "recovery, torn-shard quorum — docs/MULTIHOST.md)")
     pd.add_argument("--drill", action="append", dest="drills",
                     help="run only this drill (repeatable)")
     pd.add_argument("--report", help="write the JSON report here")

@@ -26,6 +26,23 @@ from photon_ml_tpu.ops.objective import RegularizationContext
 MODEL_OUTPUT_MODES = ("ALL", "BEST", "NONE")
 
 
+def _validate_pod_resilience(params) -> None:
+    """Shared knob validation for the multi-host resilience surface
+    (both drivers carry the same three fields — docs/MULTIHOST.md)."""
+    if params.heartbeat_s < 0:
+        raise ValueError(
+            f"heartbeat_s must be >= 0 (0 = off), got {params.heartbeat_s}"
+        )
+    if (
+        params.collective_timeout_s is not None
+        and params.collective_timeout_s <= 0
+    ):
+        raise ValueError(
+            f"collective_timeout_s must be > 0 (or null = no watchdog), "
+            f"got {params.collective_timeout_s}"
+        )
+
+
 @dataclasses.dataclass
 class GLMDriverParams:
     """Core GLM train-driver knobs (``Params.scala:36-183``)."""
@@ -136,6 +153,14 @@ class GLMDriverParams:
     # dispatch (models/training._build_path_solver); "loop" keeps the
     # reference-shaped host loop of one dispatch per lambda
     path_mode: str = "scan"
+    # multi-host resilience (docs/MULTIHOST.md): pod heartbeat interval
+    # in seconds (0 = off; peers missing 3 intervals are declared lost
+    # and the run exits with the distinct host-loss code), a watchdog
+    # deadline for host-side collectives (None = block forever, the
+    # pre-existing behavior), and per-process sharded checkpoint writes
+    heartbeat_s: float = 0.0
+    collective_timeout_s: Optional[float] = None
+    sharded_ckpt: bool = False
 
     def validate(self) -> None:
         if not self.train_input:
@@ -246,6 +271,7 @@ class GLMDriverParams:
                 "diagnostics requires validate_input (the model diagnostics "
                 "run against validation data, Driver.scala:424-474)"
             )
+        _validate_pod_resilience(self)
         self.to_training_config().validate()
 
     def to_training_config(self) -> GLMTrainingConfig:
@@ -405,6 +431,17 @@ class GameDriverParams:
     # entry| between consecutive passes. 0 disables (every requested
     # pass runs — the reference behavior).
     convergence_tolerance: float = 0.0
+    # multi-host resilience (docs/MULTIHOST.md): pod heartbeat interval
+    # in seconds (0 = off; a peer missing 3 intervals is declared lost —
+    # survivors write a final shard set and exit HOST_LOSS_EXIT_CODE),
+    # a watchdog deadline on host-side collectives (None = block
+    # forever), and per-process sharded checkpoints (REQUIRED for
+    # checkpoint_every > 0 on a pod: the whole-model writer is
+    # single-process; entity-keyed shards restore onto a different
+    # world size)
+    heartbeat_s: float = 0.0
+    collective_timeout_s: Optional[float] = None
+    sharded_ckpt: bool = False
 
     def validate(self) -> None:
         if not self.train_input:
@@ -516,6 +553,7 @@ class GameDriverParams:
                 f"convergence_tolerance must be >= 0, got "
                 f"{self.convergence_tolerance}"
             )
+        _validate_pod_resilience(self)
 
     def grid(self) -> List[Dict[str, float]]:
         """Cartesian product over each coordinate's reg-weight grid

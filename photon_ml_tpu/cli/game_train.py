@@ -21,6 +21,7 @@ import argparse
 import dataclasses
 import json
 import os
+import sys
 from typing import Dict, List, Optional
 
 import jax
@@ -110,8 +111,14 @@ def _validate_multiprocess_params(params: GameDriverParams) -> None:
     if params.sparse_shards:
         problems.append("sparse_shards (the projected-sparse RE path is "
                         "per-process host work)")
-    if params.checkpoint_every > 0:
-        problems.append("checkpoint_every > 0")
+    if params.checkpoint_every > 0 and not params.sharded_ckpt:
+        problems.append(
+            "checkpoint_every > 0 without sharded_ckpt (the whole-model "
+            "save_checkpoint is single-writer: every process racing the "
+            "same step dir would trample the tmp/swap protocol — set "
+            "sharded_ckpt so each process writes only its shard, "
+            "docs/MULTIHOST.md)"
+        )
     for name, spec in params.coordinates.items():
         if spec.hot_columns:
             problems.append(f"coordinate {name!r}: hot_columns (the "
@@ -468,6 +475,26 @@ def run_game_training(params) -> GameTrainingRun:
         # models (fleet events additionally hit events.jsonl when
         # tracing)
         conv_tracker = obs.install_convergence_tracker()
+    # multi-host resilience envelope (docs/MULTIHOST.md): watchdog policy
+    # on every host collective + a pod heartbeat monitor whose losses
+    # surface at pass boundaries as the distinct host-loss exit
+    from photon_ml_tpu.parallel import (
+        configure_collective_resilience,
+        install_monitor,
+    )
+    from photon_ml_tpu.parallel.heartbeat import HeartbeatMonitor
+
+    prev_resilience = configure_collective_resilience(
+        timeout_s=params.collective_timeout_s
+    )
+    monitor = None
+    if params.heartbeat_s > 0:
+        monitor = HeartbeatMonitor(interval_s=params.heartbeat_s).start()
+        install_monitor(monitor)
+        logger.info(
+            f"pod heartbeat monitor: every {params.heartbeat_s}s over "
+            f"{monitor.process_count} process(es)"
+        )
     try:
         with obs.observe(
             trace_dir=params.trace_dir,
@@ -480,6 +507,12 @@ def run_game_training(params) -> GameTrainingRun:
         ):
             return _run_game_training(params, logger, shutdown)
     finally:
+        configure_collective_resilience(
+            prev_resilience.timeout_s, prev_resilience.retries
+        )
+        if monitor is not None:
+            install_monitor(None)
+            monitor.stop()
         if conv_tracker is not None:
             try:
                 path = conv_tracker.dump(
@@ -493,6 +526,14 @@ def run_game_training(params) -> GameTrainingRun:
             obs.uninstall_convergence_tracker()
         shutdown.uninstall()
         logger.close()
+
+
+def _current_heartbeat():
+    """The process-wide heartbeat monitor installed by the resilience
+    envelope in :func:`run_game_training` (None when heartbeat_s = 0)."""
+    from photon_ml_tpu.parallel import current_monitor
+
+    return current_monitor()
 
 
 def _run_game_training(
@@ -685,6 +726,21 @@ def _run_game_training(
         n: params.coordinates[n].random_effect
         for n in params.updating_sequence
     }
+    # entity-keyed checkpoint shards (docs/MULTIHOST.md): each random-
+    # effect coordinate's table rows are labeled with the ordered entity
+    # ids of its (globalized) vocabulary, so a sharded checkpoint can be
+    # restored onto a different process count or entity order by KEY
+    ckpt_entity_keys = None
+    if params.sharded_ckpt:
+        ckpt_entity_keys = {}
+        for n, re_key in res_by_coord.items():
+            if re_key is None:
+                continue
+            vocab = entity_vocabs[re_key]
+            ordered = [None] * len(vocab)
+            for raw, i in vocab.items():
+                ordered[i] = raw
+            ckpt_entity_keys[n] = ordered
 
     def validation_metric(model: GameModel) -> float:
         margins = score_game_data(
@@ -900,6 +956,13 @@ def _run_game_training(
                 # (checkpoints + preemption land on dispatch boundaries)
                 passes_per_dispatch=params.passes_per_dispatch,
                 convergence_tolerance=params.convergence_tolerance,
+                # pod resilience (docs/MULTIHOST.md): per-process shard
+                # writes + entity-keyed restore, and the pass-boundary
+                # heartbeat poll that turns a dead peer into a final
+                # shard set + distinct exit instead of a hang
+                sharded_checkpoints=params.sharded_ckpt,
+                entity_keys=ckpt_entity_keys,
+                heartbeat=_current_heartbeat(),
             )
             frozen_events = [
                 h for h in history if getattr(h, "event", None) == "frozen"
@@ -1152,6 +1215,26 @@ def main(argv=None) -> None:
         help="exhausted ingest retries: fail the run (default) or "
         "skip-and-log the lost group (docs/ROBUSTNESS.md)",
     )
+    p.add_argument(
+        "--heartbeat-s", type=float, default=None,
+        help="pod heartbeat interval in seconds (0 = off): a peer "
+        "missing 3 intervals is declared lost — survivors write a "
+        "final checkpoint shard set and exit with the distinct "
+        "host-loss code (docs/MULTIHOST.md)",
+    )
+    p.add_argument(
+        "--collective-timeout-s", type=float, default=None,
+        help="watchdog deadline on host-side collectives: a stalled "
+        "exchange times out, retries with backoff, and emits straggler "
+        "attribution instead of wedging the pod (default: no watchdog)",
+    )
+    p.add_argument(
+        "--sharded-ckpt", action="store_true", default=None,
+        help="per-process sharded checkpoints: each process writes "
+        "shard-<p>-of-<P> + process 0 publishes a quorum manifest; "
+        "entity-keyed shards restore onto a different world size "
+        "(required for checkpointing on a pod — docs/MULTIHOST.md)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1195,7 +1278,31 @@ def main(argv=None) -> None:
         base["stage_timeout_s"] = args.stage_timeout_s
     if args.epoch_policy is not None:
         base["epoch_policy"] = args.epoch_policy
-    run_game_training(base)
+    if args.heartbeat_s is not None:
+        base["heartbeat_s"] = args.heartbeat_s
+    if args.collective_timeout_s is not None:
+        base["collective_timeout_s"] = args.collective_timeout_s
+    if args.sharded_ckpt is not None:
+        base["sharded_ckpt"] = args.sharded_ckpt
+    try:
+        run_game_training(base)
+    except BaseException as e:
+        from photon_ml_tpu.resilience import (
+            HOST_LOSS_EXIT_CODE,
+            is_host_loss,
+        )
+
+        # host loss has a DISTINCT exit contract: the final shard set is
+        # on disk, so a cluster manager should restart (same or smaller
+        # world size) rather than treat this as a code failure
+        if is_host_loss(e):
+            print(
+                f"host loss: {e} — exiting {HOST_LOSS_EXIT_CODE} "
+                "(restart resumes from the sharded checkpoint)",
+                file=sys.stderr,
+            )
+            sys.exit(HOST_LOSS_EXIT_CODE)
+        raise
 
 
 if __name__ == "__main__":

@@ -182,6 +182,23 @@ def run_glm_training(params) -> GLMTrainingRun:
         # per-solve tape decode even without a tracer (obs.convergence);
         # the aggregated report lands next to the models below
         conv_tracker = obs.install_convergence_tracker()
+    # multi-host resilience envelope (docs/MULTIHOST.md): a watchdog
+    # deadline on every host collective (mesh solves globalize metadata
+    # through allgather_host) and a pod heartbeat monitor feeding the
+    # pod.heartbeat.* gauges + the watchdog's straggler attribution
+    from photon_ml_tpu.parallel import (
+        configure_collective_resilience,
+        install_monitor,
+    )
+    from photon_ml_tpu.parallel.heartbeat import HeartbeatMonitor
+
+    prev_resilience = configure_collective_resilience(
+        timeout_s=params.collective_timeout_s
+    )
+    monitor = None
+    if params.heartbeat_s > 0:
+        monitor = HeartbeatMonitor(interval_s=params.heartbeat_s).start()
+        install_monitor(monitor)
     try:
         with obs.observe(
             trace_dir=params.trace_dir,
@@ -194,6 +211,12 @@ def run_glm_training(params) -> GLMTrainingRun:
         ):
             return _run_glm_training(params)
     finally:
+        configure_collective_resilience(
+            prev_resilience.timeout_s, prev_resilience.retries
+        )
+        if monitor is not None:
+            install_monitor(None)
+            monitor.stop()
         if conv_tracker is not None:
             try:
                 conv_tracker.dump(
@@ -678,6 +701,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "whole descending-lambda path as ONE device-resident dispatch; "
         "'loop' keeps the host loop of one dispatch per lambda",
     )
+    p.add_argument(
+        "--heartbeat-s", type=float, default=None,
+        help="pod heartbeat interval in seconds (0 = off): feeds the "
+        "pod.heartbeat.* liveness gauges and the collective watchdog's "
+        "straggler attribution (docs/MULTIHOST.md)",
+    )
+    p.add_argument(
+        "--collective-timeout-s", type=float, default=None,
+        help="watchdog deadline on host-side collectives: a stalled "
+        "exchange times out, retries with backoff, and emits straggler "
+        "attribution instead of wedging the pod (default: no watchdog)",
+    )
+    p.add_argument(
+        "--sharded-ckpt", action="store_true", default=None,
+        help="per-process sharded checkpoint writes for any durability "
+        "point this driver reaches (parity with game_train; the GLM "
+        "path itself has no mid-run checkpoint cadence yet — "
+        "docs/MULTIHOST.md)",
+    )
     return p
 
 
@@ -700,7 +742,26 @@ def main(argv=None) -> None:
     from photon_ml_tpu.utils import enable_compilation_cache
 
     enable_compilation_cache()
-    run_glm_training(params_from_args(args, GLMDriverParams))
+    try:
+        run_glm_training(params_from_args(args, GLMDriverParams))
+    except BaseException as e:
+        import sys
+
+        from photon_ml_tpu.resilience import (
+            HOST_LOSS_EXIT_CODE,
+            is_host_loss,
+        )
+
+        # distinct exit contract: a dead peer (collective timeout past
+        # its retry budget, heartbeat loss) means "restart me", not
+        # "my code failed" (docs/MULTIHOST.md)
+        if is_host_loss(e):
+            print(
+                f"host loss: {e} — exiting {HOST_LOSS_EXIT_CODE}",
+                file=sys.stderr,
+            )
+            sys.exit(HOST_LOSS_EXIT_CODE)
+        raise
 
 
 if __name__ == "__main__":

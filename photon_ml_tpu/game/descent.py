@@ -626,6 +626,9 @@ class CoordinateDescent:
         stop_check=None,
         passes_per_dispatch: int = 1,
         convergence_tolerance: float = 0.0,
+        sharded_checkpoints=False,
+        entity_keys=None,
+        heartbeat=None,
     ):
         """Returns (model, history). Objective is logged after every
         coordinate update like ``CoordinateDescent.scala:160-170``;
@@ -682,7 +685,27 @@ class CoordinateDescent:
         wired to SIGTERM). When it turns true the loop writes a final
         checkpoint plus a ``preempted.json`` marker (with checkpoint_dir)
         and returns early; restarting with ``resume=True`` continues
-        bit-for-bit, reproducing the uninterrupted run."""
+        bit-for-bit, reproducing the uninterrupted run.
+
+        ``sharded_checkpoints`` (docs/MULTIHOST.md): True writes
+        per-process checkpoint shards (``io.checkpoint.
+        save_checkpoint_sharded`` — each process writes only its shard,
+        process 0 publishes the quorum manifest); an int N writes N
+        shards from a single process (the emulation / shrunk-restart
+        mode). ``entity_keys`` (coordinate -> global ordered entity-id
+        list) labels entity-table rows so those tables shard by row and
+        a restore onto a DIFFERENT process count or entity order
+        re-shards BY KEY (``reindex_entity_params``) instead of by
+        position. Resume accepts both formats interchangeably.
+
+        ``heartbeat`` (:class:`photon_ml_tpu.parallel.heartbeat.
+        HeartbeatMonitor`): polled at pass boundaries. On a detected
+        peer loss, the loop writes a FINAL checkpoint at the current
+        boundary plus a ``host-loss.json`` marker and re-raises
+        :class:`~photon_ml_tpu.resilience.hostloss.HostLossDetected` —
+        the drivers map it to the distinct host-loss exit code so a
+        restart (same or smaller world size) resumes from the shard
+        set."""
         names = list(self.coordinates)
         model = (
             initial_model.copy()
@@ -737,10 +760,23 @@ class CoordinateDescent:
                         f"num_iterations={num_iterations}; refusing to "
                         "return a longer run's state as if it were shorter"
                     )
+                restored = ckpt.params
+                if ckpt.entity_keys and entity_keys:
+                    # restore-with-resharding: entity tables re-key onto
+                    # THIS run's entity order (identical orders pass
+                    # through untouched — bit-for-bit resume)
+                    from photon_ml_tpu.io.checkpoint import (
+                        reindex_entity_params,
+                    )
+
+                    restored = reindex_entity_params(
+                        ckpt,
+                        {n: list(k) for n, k in entity_keys.items()},
+                    )
                 model = GameModel(
                     {
                         n: jax.tree_util.tree_map(
-                            jnp.asarray, ckpt.params[n]
+                            jnp.asarray, restored[n]
                         )
                         for n in names
                     }
@@ -941,7 +977,10 @@ class CoordinateDescent:
         ckpt_writer = _AsyncCheckpointWriter()
 
         def _save_ckpt(step, wait: bool = False):
-            from photon_ml_tpu.io.checkpoint import save_checkpoint
+            from photon_ml_tpu.io.checkpoint import (
+                save_checkpoint,
+                save_checkpoint_sharded,
+            )
 
             materialize()
             t0 = time.perf_counter()
@@ -955,24 +994,88 @@ class CoordinateDescent:
             key_host = np.asarray(key)
             hist_host = [dataclasses.asdict(h) for h in history]
             frozen_host = sorted(frozen)
-            ckpt_writer.submit(
-                lambda: save_checkpoint(
+            if sharded_checkpoints:
+                # per-process shard set + quorum manifest. On a pod the
+                # digest exchange + swap barrier are collective, so the
+                # write runs SYNCHRONOUSLY on the training thread (every
+                # process must reach the exchange together; a background
+                # thread would race the next pass's collectives).
+                num_shards = (
+                    None
+                    if sharded_checkpoints is True
+                    else int(sharded_checkpoints)
+                )
+                ekeys_host = (
+                    {
+                        n: [str(k) for k in v]
+                        for n, v in entity_keys.items()
+                    }
+                    if entity_keys
+                    else None
+                )
+                ckpt_writer.join()  # any legacy overlapped write first
+                save_checkpoint_sharded(
                     checkpoint_dir,
                     step,
-                    # save_checkpoint handles plain tables AND
-                    # FactoredParams
                     params_host,
                     key_host,
-                    hist_host,
+                    history=hist_host,
                     frozen=frozen_host,
+                    entity_keys=ekeys_host,
+                    num_shards=num_shards,
                 )
-            )
-            if wait:
-                ckpt_writer.join()
+            else:
+                ckpt_writer.submit(
+                    lambda: save_checkpoint(
+                        checkpoint_dir,
+                        step,
+                        # save_checkpoint handles plain tables AND
+                        # FactoredParams
+                        params_host,
+                        key_host,
+                        hist_host,
+                        frozen=frozen_host,
+                    )
+                )
+                if wait:
+                    ckpt_writer.join()
             obs.registry().observe(
                 "game.checkpoint.submit_ms",
                 (time.perf_counter() - t0) * 1e3,
             )
+
+        def _host_loss_boundary(step: int, saved: bool) -> None:
+            """Pass-boundary heartbeat poll: on a detected peer loss the
+            SURVIVORS' contract runs here — final durable checkpoint at
+            this boundary, host-loss marker, then surface the exception
+            for the driver's distinct-exit-code mapping."""
+            if heartbeat is None:
+                return
+            try:
+                heartbeat.check()
+            except Exception as e:
+                from photon_ml_tpu.resilience.hostloss import (
+                    HostLossDetected,
+                    write_host_loss_marker,
+                )
+
+                if not isinstance(e, HostLossDetected):
+                    raise
+                if checkpoint_dir is not None:
+                    if not saved:
+                        _save_ckpt(step, wait=True)
+                    else:
+                        ckpt_writer.join()
+                    write_host_loss_marker(
+                        checkpoint_dir, step, e.peers, reason=e.reason
+                    )
+                obs.emit_event(
+                    "resilience.host_loss",
+                    cat="resilience",
+                    iteration=step,
+                    peers=e.peers,
+                )
+                raise
 
         # count XLA backend compiles for the duration of the run: the
         # steady-state zero-recompile invariant of the cached pass/step
@@ -1142,6 +1245,7 @@ class CoordinateDescent:
                 ):
                     _save_ckpt(it)
                     saved = True
+                _host_loss_boundary(it, saved)
                 if stop_check is not None and stop_check():
                     stopped = True
                     if checkpoint_dir is not None:
@@ -1473,6 +1577,9 @@ class CoordinateDescent:
             ):
                 _save_ckpt(it + 1)
                 saved = True
+            # host-loss poll at the pass boundary — the only point where
+            # the survivors hold a complete, checkpointable snapshot
+            _host_loss_boundary(it + 1, saved)
             # preemption poll at the pass boundary — the only point where
             # the training state is a complete, checkpointable snapshot
             if stop_check is not None and stop_check():

@@ -14,6 +14,16 @@ keyed ``param/<coordinate>``; factored coordinates store two leaves,
 the kind recorded in the manifest) + ``manifest.json`` (counters, RNG key,
 history, frozen-coordinate list, and a sha256 digest per data file).
 
+SHARDED layout (pod-scale runs, docs/MULTIHOST.md): ``<dir>/step-<k>/``
+holding ``shard-<p>-of-<P>.npz`` + ``shard-<p>-of-<P>.json`` per writer
+process, plus ONE quorum ``manifest.json`` (``format: "sharded"``) with a
+sha256 digest per shard. Entity-keyed tables partition rows round-robin
+over shards WITH their entity keys, so a restore re-shards onto a
+different process count or entity order by KEY — never by position
+(:func:`reindex_entity_params`). :func:`latest_checkpoint` treats a step
+as valid only when its full, digest-verified shard set is present
+(quorum), falling back to the newest complete step otherwise.
+
 Failure model (docs/ROBUSTNESS.md):
 
 - The write is ATOMIC: temp dir + rename. A crash mid-write leaves a
@@ -77,6 +87,12 @@ class TrainingCheckpoint:
     # coordinates frozen by the divergence guard (game.descent): excluded
     # from further updates when the run resumes
     frozen: List[str] = dataclasses.field(default_factory=list)
+    # sharded checkpoints only: coordinate -> global ordered entity keys
+    # (str), the row labels that make restore-with-resharding possible
+    # (reindex_entity_params matches rows by key, never by position)
+    entity_keys: Optional[Dict[str, List[str]]] = None
+    # how many shard files held this step on disk (1 = whole-model)
+    shards: int = 1
 
 
 class CheckpointCorrupted(Exception):
@@ -96,13 +112,20 @@ def sha256_file(path: str) -> str:
 _sha256 = sha256_file
 
 
-def _prune_leftovers(directory: str) -> None:
-    """Remove ``*.tmp`` / ``*.old`` debris from prior crashes. A ``.tmp``
-    is an unfinished write (never valid); a ``.old`` is a superseded step
-    whose replacement already swapped in (delete was interrupted)."""
+def _prune_leftovers(directory: str, keep_name: Optional[str] = None) -> None:
+    """Remove ``*.tmp`` / ``*.old`` / ``*.shards`` debris from prior
+    crashes. A ``.tmp``/``.shards`` is an unfinished write (never valid);
+    a ``.old`` is a superseded step whose replacement already swapped in
+    (delete was interrupted). ``keep_name`` protects the CURRENT save's
+    staging dir — on a pod, peer processes may already be writing their
+    shards into it when this process starts its own save."""
     for name in os.listdir(directory):
+        if name == keep_name:
+            continue
         if name.startswith(_STEP_PREFIX) and (
-            name.endswith(".tmp") or name.endswith(".old")
+            name.endswith(".tmp")
+            or name.endswith(".old")
+            or name.endswith(".shards")
         ):
             shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
 
@@ -123,8 +146,22 @@ def save_checkpoint(
     Transient ``OSError`` during the write (including injected faults at
     site ``checkpoint.save``) is retried with backoff; each attempt
     restarts from a clean temp dir."""
+    import jax
+
     from photon_ml_tpu.game.factored import is_factored_params
 
+    if jax.process_count() > 1:
+        # N processes racing the same step-<k> dir would trample each
+        # other's tmp/swap protocol (torn renames, half-deleted .old
+        # dirs) — the whole-model writer is strictly single-process.
+        raise RuntimeError(
+            f"save_checkpoint(step={step}) called in a "
+            f"{jax.process_count()}-process run: every process would "
+            "race the same step directory and trample the atomic-swap "
+            "protocol. Use save_checkpoint_sharded — each process "
+            "writes only its shard-<p>-of-<P> files and process 0 "
+            "publishes the quorum manifest (docs/MULTIHOST.md)."
+        )
     for name in params:
         if "#" in name:
             # '#' is the factored-leaf separator in npz keys; a coordinate
@@ -215,6 +252,7 @@ def _list_steps(directory: str) -> List[int]:
             name.startswith(_STEP_PREFIX)
             and not name.endswith(".tmp")
             and not name.endswith(".old")
+            and not name.endswith(".shards")
         ):
             try:
                 out.append(int(name[len(_STEP_PREFIX):]))
@@ -235,6 +273,9 @@ def _load_step(directory: str, step: int) -> TrainingCheckpoint:
             manifest = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
         raise CheckpointCorrupted(f"{d}: unreadable manifest ({e})") from e
+    if manifest.get("format") == "sharded":
+        # pod-scale per-process shard set: quorum-verified reassembly
+        return _load_sharded_step(d, manifest, t0)
     digests = manifest.get("digests")
     if digests is not None:  # pre-digest checkpoints stay loadable
         for fname, want in digests.items():
@@ -307,3 +348,458 @@ def latest_checkpoint(
                 )
             continue
     return None
+
+
+# ---------------------------------------------------------------------------
+# sharded per-process checkpoints (docs/MULTIHOST.md)
+# ---------------------------------------------------------------------------
+#
+# One whole-model writer does not survive pod scale: the paper's regime is
+# "hundreds of billions of coefficients" whose random-effect tables only
+# ever exist sharded, and ROADMAP items 1/3 both flag per-process
+# checkpoint save/restore as the blocker. Protocol:
+#
+#   step-<k>.shards/           (staging; a recognized debris suffix)
+#     shard-<p>-of-<P>.npz     process p's rows (entity tables round-robin
+#                              row p::P; replicated params in shard 0)
+#     shard-<p>-of-<P>.json    per-shard manifest: digest + local entity keys
+#     manifest.json            QUORUM manifest, written by process 0 after
+#                              the digest exchange: per-shard sha256,
+#                              counters, RNG key, global entity-key order
+#   step-<k>/                  the staging dir, atomically swapped in by
+#                              process 0 (same swap-aside sequence as the
+#                              whole-model writer)
+#
+# A step is restorable iff the quorum manifest lists P shards and every
+# one is present with a matching digest — latest_checkpoint() falls back
+# to the newest step that satisfies quorum. Entity-keyed shards carry
+# their row labels, so a restart at a DIFFERENT process count (or a
+# re-ingested dataset with a different entity order) reassembles and
+# re-shards BY KEY via reindex_entity_params — the PR-4 positional-warm-
+# start lesson applied to restore.
+
+
+def _shard_rows(n: int, p: int, num_shards: int) -> range:
+    """Rows of a length-n entity axis owned by shard p: round-robin
+    ``p::P`` (balanced for any n, order-preserving on reassembly)."""
+    return range(p, n, num_shards)
+
+
+def _write_one_shard(
+    staging: str,
+    p: int,
+    num_shards: int,
+    step: int,
+    params: Dict[str, object],
+    entity_keys: Dict[str, List[str]],
+) -> str:
+    """Write shard p's npz + json into the staging dir; returns the npz
+    sha256. Probes fault site ``checkpoint.shard_write`` (key = shard
+    index) AFTER the digest is recorded, so corrupt-mode produces the
+    torn-shard shape the quorum verification must catch."""
+    from photon_ml_tpu.game.factored import is_factored_params
+
+    arrays: Dict[str, np.ndarray] = {}
+    local_keys: Dict[str, List[str]] = {}
+    for name, value in params.items():
+        keys = entity_keys.get(name)
+        if is_factored_params(value):
+            gamma = np.asarray(value.gamma)
+            if keys is not None:
+                rows = list(_shard_rows(gamma.shape[0], p, num_shards))
+                arrays[f"param/{name}#gamma"] = gamma[rows]
+                local_keys[name] = [keys[i] for i in rows]
+            elif p == 0:
+                arrays[f"param/{name}#gamma"] = gamma
+            if p == 0:
+                arrays[f"param/{name}#projection"] = np.asarray(
+                    value.projection
+                )
+        else:
+            table = np.asarray(value)
+            if keys is not None:
+                rows = list(_shard_rows(table.shape[0], p, num_shards))
+                arrays[f"param/{name}"] = table[rows]
+                local_keys[name] = [keys[i] for i in rows]
+            elif p == 0:
+                arrays[f"param/{name}"] = table
+    stem = f"shard-{p}-of-{num_shards}"
+    npz_path = os.path.join(staging, stem + ".npz")
+    np.savez(npz_path, **arrays)
+    digest = _sha256(npz_path)
+    with open(os.path.join(staging, stem + ".json"), "w") as f:
+        json.dump(
+            {
+                "shard": p,
+                "of": num_shards,
+                "step": step,
+                "digest": digest,
+                "entity_keys": local_keys,
+            },
+            f,
+        )
+    if faults.fire("checkpoint.shard_write", key=str(p)).corrupt:
+        faults.corrupt_file(npz_path)
+    return digest
+
+
+def _swap_in_step(staging: str, final: str) -> None:
+    """Atomic swap-aside: the same never-zero-copies sequence as the
+    whole-model writer (old aside -> staging in -> delete old)."""
+    old = final + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(final):
+        os.rename(final, old)
+    os.rename(staging, final)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+
+
+def save_checkpoint_sharded(
+    directory: str,
+    step: int,
+    params: Dict[str, object],
+    rng_key,
+    *,
+    history: Optional[List[dict]] = None,
+    frozen: Optional[List[str]] = None,
+    keep: int = 2,
+    entity_keys: Optional[Dict[str, List]] = None,
+    num_shards: Optional[int] = None,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    retries: int = 4,
+    logger=None,
+) -> str:
+    """Write this process's shard(s) of ``<directory>/step-<step>``.
+
+    - On a pod (``jax.process_count() > 1``): every process calls this at
+      the same pass boundary; each writes ONLY ``shard-<p>-of-<P>``, the
+      shard digests are exchanged over the (watchdogged) host allgather,
+      and process 0 publishes the quorum manifest + performs the atomic
+      swap. Returns after a completion barrier, so no process can start
+      the next step while the swap is in flight.
+    - Single process: writes ALL ``num_shards`` shards locally (default
+      1) — the drill/emulation mode, and the path a shrunk restart uses
+      to keep writing restorable shard sets at its new world size.
+
+    ``entity_keys`` maps coordinate name -> the GLOBAL ordered entity-id
+    list of that table's rows (identical on every process — entity
+    vocabularies are allgathered at startup); those tables shard
+    round-robin by row, everything else is treated as replicated and
+    stored in shard 0. Transient ``OSError`` (including injected
+    ``checkpoint.shard_write`` faults) retries through the backoff seam,
+    each attempt rewriting this process's shard files."""
+    import jax
+
+    for name in params:
+        if "#" in name:
+            raise ValueError(
+                f"coordinate name {name!r} contains '#' (reserved for the "
+                "checkpoint leaf encoding)"
+            )
+    if process_count is None:
+        process_count = jax.process_count()
+    if process_index is None:
+        process_index = jax.process_index() if process_count > 1 else 0
+    if process_count > 1:
+        if num_shards is not None and num_shards != process_count:
+            raise ValueError(
+                f"num_shards={num_shards} conflicts with "
+                f"process_count={process_count}: on a pod every process "
+                "writes exactly its own shard"
+            )
+        num_shards = process_count
+    else:
+        num_shards = int(num_shards or 1)
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    ekeys: Dict[str, List[str]] = {}
+    for name, keys in (entity_keys or {}).items():
+        if name not in params:
+            continue
+        table = params[name]
+        n_rows = (
+            np.asarray(table.gamma).shape[0]
+            if hasattr(table, "gamma")
+            else np.asarray(table).shape[0]
+        )
+        if len(keys) != n_rows:
+            raise ValueError(
+                f"coordinate {name!r}: {len(keys)} entity keys for "
+                f"{n_rows} table rows — the keys must label every row"
+            )
+        ekeys[name] = [str(k) for k in keys]
+
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"{_STEP_PREFIX}{step}")
+    staging = final + ".shards"
+    from photon_ml_tpu.game.factored import is_factored_params
+
+    param_kinds = {
+        n: "factored" if is_factored_params(p) else "array"
+        for n, p in params.items()
+    }
+    param_sharding = {
+        n: "entity" if n in ekeys else "replicated" for n in params
+    }
+
+    def _quorum_manifest(digests: Dict[str, str]) -> dict:
+        return {
+            "format": "sharded",
+            "step": step,
+            "shards": num_shards,
+            "rng_key": np.asarray(rng_key).tolist(),
+            "param_names": sorted(params),
+            "param_kinds": param_kinds,
+            "param_sharding": param_sharding,
+            "entity_keys": ekeys,
+            "history": history or [],
+            "frozen": sorted(frozen or []),
+            "digests": digests,
+        }
+
+    t0 = time.perf_counter()
+    with obs.span(
+        "io.checkpoint.save_sharded", cat="io", step=step,
+        shard=process_index, shards=num_shards,
+    ):
+        if process_count == 1:
+            # single writer: stage everything, publish quorum, swap —
+            # one retryable unit restarting from a clean staging dir
+            _prune_leftovers(directory)
+
+            def _write() -> None:
+                if os.path.exists(staging):
+                    shutil.rmtree(staging)
+                os.makedirs(staging)
+                digests = {}
+                for p in range(num_shards):
+                    digests[f"shard-{p}-of-{num_shards}.npz"] = (
+                        _write_one_shard(
+                            staging, p, num_shards, step, params, ekeys
+                        )
+                    )
+                with open(os.path.join(staging, "manifest.json"), "w") as f:
+                    json.dump(_quorum_manifest(digests), f)
+                _swap_in_step(staging, final)
+
+            retry.retry_call(
+                _write, retries=retries, logger=logger,
+                label=f"sharded checkpoint step {step}",
+            )
+        else:
+            # pod: write ONLY my shard (retried), exchange digests over
+            # the watchdogged host collective, process 0 publishes
+            from photon_ml_tpu.parallel import multihost
+
+            if process_index == 0:
+                _prune_leftovers(directory, keep_name=os.path.basename(staging))
+            os.makedirs(staging, exist_ok=True)
+
+            def _write_mine() -> str:
+                return _write_one_shard(
+                    staging, process_index, num_shards, step, params, ekeys
+                )
+
+            digest = retry.retry_call(
+                _write_mine, retries=retries, logger=logger,
+                label=f"checkpoint shard {process_index} step {step}",
+            )
+            entries = multihost.allgather_strings(
+                [json.dumps({"shard": process_index, "digest": digest})]
+            )
+            if process_index == 0:
+                digests = {}
+                for entry in entries:
+                    e = json.loads(entry)
+                    digests[
+                        f"shard-{e['shard']}-of-{num_shards}.npz"
+                    ] = e["digest"]
+                with open(os.path.join(staging, "manifest.json"), "w") as f:
+                    json.dump(_quorum_manifest(digests), f)
+                _swap_in_step(staging, final)
+            # completion barrier: the swap must land before any process
+            # starts the next step (whose prune would eat the staging)
+            multihost.allgather_host(np.zeros(1, np.int8))
+    reg = obs.registry()
+    reg.inc("io.checkpoint.shard_saves")
+    if os.path.isdir(final):
+        reg.inc("io.checkpoint.bytes_written", _dir_bytes(final))
+    reg.observe(
+        "io.checkpoint.shard_save_ms", (time.perf_counter() - t0) * 1e3
+    )
+    if process_count == 1 or process_index == 0:
+        steps = sorted(_list_steps(directory))
+        for old_step in steps[:-keep]:
+            shutil.rmtree(
+                os.path.join(directory, f"{_STEP_PREFIX}{old_step}")
+            )
+    return final
+
+
+def _load_sharded_step(
+    d: str, manifest: dict, t0: float
+) -> TrainingCheckpoint:
+    """Reassemble one sharded step, enforcing QUORUM: every shard the
+    manifest lists must be present with a matching sha256, and every
+    entity table must reassemble to exactly its manifest row count.
+    Anything less raises :class:`CheckpointCorrupted` so
+    :func:`latest_checkpoint` falls back to the previous complete step."""
+    num_shards = int(manifest.get("shards", 0))
+    digests = manifest.get("digests", {})
+    if num_shards < 1 or len(digests) != num_shards:
+        raise CheckpointCorrupted(
+            f"{d}: quorum manifest lists {len(digests)} digests for "
+            f"{num_shards} shards"
+        )
+    shard_arrays: List[dict] = []
+    for p in range(num_shards):
+        fname = f"shard-{p}-of-{num_shards}.npz"
+        want = digests.get(fname)
+        path = os.path.join(d, fname)
+        if want is None or not os.path.exists(path):
+            raise CheckpointCorrupted(f"{d}: missing {fname} (no quorum)")
+        got = _sha256(path)
+        if got != want:
+            raise CheckpointCorrupted(
+                f"{d}: {fname} digest mismatch "
+                f"(manifest {want[:12]}…, file {got[:12]}…)"
+            )
+        try:
+            shard_arrays.append(dict(np.load(path)))
+        except (OSError, ValueError, zipfile.BadZipFile) as e:
+            raise CheckpointCorrupted(
+                f"{d}: unreadable {fname} ({e})"
+            ) from e
+    kinds = manifest.get("param_kinds", {})
+    sharding = manifest.get("param_sharding", {})
+    ekeys = manifest.get("entity_keys", {})
+
+    def _assemble(leaf_key: str, name: str) -> np.ndarray:
+        if sharding.get(name) != "entity":
+            if leaf_key not in shard_arrays[0]:
+                raise CheckpointCorrupted(
+                    f"{d}: shard 0 lacks replicated leaf {leaf_key!r}"
+                )
+            return shard_arrays[0][leaf_key]
+        n = len(ekeys.get(name, ()))
+        parts = []
+        for p in range(num_shards):
+            if leaf_key not in shard_arrays[p]:
+                raise CheckpointCorrupted(
+                    f"{d}: shard {p} lacks entity leaf {leaf_key!r}"
+                )
+            part = shard_arrays[p][leaf_key]
+            if part.shape[0] != len(_shard_rows(n, p, num_shards)):
+                raise CheckpointCorrupted(
+                    f"{d}: shard {p} of {leaf_key!r} holds "
+                    f"{part.shape[0]} rows, quorum expects "
+                    f"{len(_shard_rows(n, p, num_shards))}"
+                )
+            parts.append(part)
+        out = np.empty((n,) + parts[0].shape[1:], parts[0].dtype)
+        for p, part in enumerate(parts):
+            out[p::num_shards] = part
+        return out
+
+    params: Dict[str, object] = {}
+    try:
+        for name in manifest["param_names"]:
+            if kinds.get(name, "array") == "factored":
+                from photon_ml_tpu.game.factored import FactoredParams
+
+                params[name] = FactoredParams(
+                    gamma=_assemble(f"param/{name}#gamma", name),
+                    projection=_assemble(f"param/{name}#projection", ""),
+                )
+            else:
+                params[name] = _assemble(f"param/{name}", name)
+    except KeyError as e:
+        raise CheckpointCorrupted(
+            f"{d}: manifest/shard mismatch ({e})"
+        ) from e
+    reg = obs.registry()
+    reg.inc("io.checkpoint.loads")
+    reg.inc("io.checkpoint.bytes_read", _dir_bytes(d))
+    reg.observe("io.checkpoint.load_ms", (time.perf_counter() - t0) * 1e3)
+    return TrainingCheckpoint(
+        step=manifest["step"],
+        params=params,
+        rng_key=np.asarray(manifest["rng_key"], np.uint32),
+        history=manifest["history"],
+        frozen=list(manifest.get("frozen", [])),
+        entity_keys={k: list(v) for k, v in ekeys.items()} or None,
+        shards=num_shards,
+    )
+
+
+def reindex_entity_params(
+    ckpt: TrainingCheckpoint,
+    entity_keys: Dict[str, List],
+) -> Dict[str, object]:
+    """Re-key a loaded checkpoint's entity tables onto a NEW entity-key
+    order — the restore-with-resharding step (restart at a different
+    process count, or a re-ingested dataset whose entity indexing
+    shifted). Rows are matched BY KEY, never by position (the PR-4
+    warm-start lesson): target keys absent from the checkpoint
+    initialize to zero, checkpoint rows whose key left the target are
+    dropped; both are counted in ``io.checkpoint.reindex.*`` metrics.
+    Tables without stored keys (and replicated params) pass through
+    unchanged. When the orders already match this is a no-op returning
+    the original arrays."""
+    if not ckpt.entity_keys:
+        return dict(ckpt.params)
+    out: Dict[str, object] = {}
+    matched = new = dropped = 0
+    for name, value in ckpt.params.items():
+        old_keys = ckpt.entity_keys.get(name)
+        target = entity_keys.get(name)
+        if old_keys is None or target is None:
+            out[name] = value
+            continue
+        target = [str(k) for k in target]
+        if target == old_keys:
+            out[name] = value  # identical layout: bit-for-bit resume
+            matched += len(target)
+            continue
+        index = {k: i for i, k in enumerate(old_keys)}
+
+        def _reorder(table: np.ndarray) -> np.ndarray:
+            nonlocal matched, new
+            fresh = np.zeros(
+                (len(target),) + table.shape[1:], table.dtype
+            )
+            for i, k in enumerate(target):
+                j = index.get(k)
+                if j is not None:
+                    fresh[i] = table[j]
+                    matched += 1
+                else:
+                    new += 1
+            return fresh
+
+        if hasattr(value, "gamma"):
+            import dataclasses as _dc
+
+            out[name] = _dc.replace(
+                value, gamma=_reorder(np.asarray(value.gamma))
+            )
+        else:
+            out[name] = _reorder(np.asarray(value))
+        dropped += len(set(old_keys) - set(target))
+    reg = obs.registry()
+    reg.inc("io.checkpoint.reindex.matched", matched)
+    reg.inc("io.checkpoint.reindex.new", new)
+    reg.inc("io.checkpoint.reindex.dropped", dropped)
+    if new or dropped:
+        obs.emit_event(
+            "io.checkpoint.resharded",
+            cat="io",
+            step=ckpt.step,
+            matched=matched,
+            new_entities=new,
+            dropped_entities=dropped,
+        )
+    return out

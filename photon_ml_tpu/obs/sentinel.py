@@ -84,8 +84,16 @@ _DIRECTION_RULES = (
     # counted-stage overlap fraction rise as the feed improves; the
     # epoch stall fraction (consumer time NOT covered by device math)
     # falls. These gate the decode/transfer/solve overlap directly —
-    # wall clocks on a timeshared bench host cannot.
+    # wall clocks on a timeshared bench host cannot. The _gbps rule also
+    # tracks ckpt_shard_write_gbps (bench_multihost_resilience): the
+    # per-process sharded checkpoint write path must not slow down.
     (re.compile(r"_gbps$"), HIGHER_IS_BETTER),
+    # elastic multi-host resilience (docs/MULTIHOST.md): the wall from a
+    # stalled collective to a clean retried exchange (watchdog deadline
+    # + backoff + redo) — explicit rather than via the generic _s rule
+    # so the recovery contract stays gated even if the generic ever
+    # narrows
+    (re.compile(r"recovery_s$"), LOWER_IS_BETTER),
     (re.compile(r"overlap_frac$"), HIGHER_IS_BETTER),
     (re.compile(r"stall_frac$"), LOWER_IS_BETTER),
     # chaos-hardened serving (docs/ROBUSTNESS.md, bench_overload): the

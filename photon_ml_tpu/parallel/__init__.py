@@ -27,9 +27,19 @@ from photon_ml_tpu.parallel.mesh import (
     shard_design,
     shard_map,
 )
+from photon_ml_tpu.parallel.heartbeat import (
+    HeartbeatMonitor,
+    InProcessHeartbeats,
+    current_monitor,
+    install_monitor,
+)
 from photon_ml_tpu.parallel.multihost import (
+    CollectiveResilience,
+    CollectiveTimeout,
     allgather_host,
     allgather_strings,
+    collective_resilience,
+    configure_collective_resilience,
     fetch_replicated,
     global_entity_space,
     initialize_multihost,
@@ -69,4 +79,12 @@ __all__ = [
     "make_global_re_design",
     "process_local_paths",
     "process_local_rows",
+    "CollectiveResilience",
+    "CollectiveTimeout",
+    "collective_resilience",
+    "configure_collective_resilience",
+    "HeartbeatMonitor",
+    "InProcessHeartbeats",
+    "current_monitor",
+    "install_monitor",
 ]

@@ -1,0 +1,311 @@
+"""Pod heartbeat monitor: detect a dead or straggling peer BEFORE a
+collective deadlocks on it.
+
+A multi-controller SPMD pod has no scheduler watching its processes: when
+one host dies, the survivors' next ``allgather_host`` / barrier simply
+blocks forever, and nothing in the job says WHY. The monitor is the
+out-of-band channel that does: every process publishes a timestamp beat
+on a small-key transport (the jax.distributed coordinator's KV store on a
+real pod; an in-process table for single-process drills), reads its
+peers' beats, and feeds the obs layer —
+
+- ``pod.heartbeat.age_s.h<i>``   — staleness of peer i's last beat (gauge)
+- ``pod.heartbeat.beats``        — beats this process published (counter)
+- ``pod.heartbeat.misses``       — stale-peer observations (counter)
+- ``pod.heartbeat.slowest_host`` / ``pod.heartbeat.slowest_age_s`` —
+  straggler attribution, also consumed by the collective watchdog when an
+  exchange times out (``parallel.multihost``)
+
+A peer whose beat goes stale past ``miss_intervals * interval_s`` is
+declared LOST: a ``heartbeat.peer_lost`` event fires (riding into the
+flight recorder when installed), and :meth:`HeartbeatMonitor.check` —
+polled by the descent loop at pass boundaries — raises
+:class:`~photon_ml_tpu.resilience.hostloss.HostLossDetected`, triggering
+the survivors' final-shard-set-and-exit contract (docs/MULTIHOST.md).
+
+Drillable without a pod: :class:`InProcessHeartbeats` simulates peers
+that beat on every read, EXCEPT peers whose ``heartbeat.miss`` fault
+(key = str(process index)) is armed — a raise-mode spec makes that peer
+go silent, a delay-mode spec makes it a straggler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from photon_ml_tpu.resilience import faults as _faults
+from photon_ml_tpu.resilience.hostloss import HostLossDetected
+
+__all__ = [
+    "HeartbeatMonitor",
+    "InProcessHeartbeats",
+    "DistributedKVHeartbeats",
+    "current_monitor",
+    "install_monitor",
+]
+
+
+class InProcessHeartbeats:
+    """Single-process emulation transport: ``num_processes`` synthetic
+    peers, all of which beat on every :meth:`read` unless an armed
+    ``heartbeat.miss`` fault (key = str(peer index)) suppresses the beat
+    (raise mode) or delays the read (delay mode). The tier-1/CPU stand-in
+    for the coordinator KV store — drills arm the fault and the monitor
+    sees exactly what it would see on a pod with a dead host."""
+
+    def __init__(self, num_processes: int, clock=time.monotonic):
+        self.num_processes = int(num_processes)
+        self._clock = clock
+        now = clock()
+        self._beats: Dict[int, float] = {
+            p: now for p in range(self.num_processes)
+        }
+        self._lock = threading.Lock()
+
+    def publish(self, pid: int, t: float) -> None:
+        with self._lock:
+            self._beats[int(pid)] = float(t)
+
+    def read(self, self_pid: int) -> Dict[int, float]:
+        now = self._clock()
+        with self._lock:
+            for p in range(self.num_processes):
+                if p == self_pid:
+                    continue
+                try:
+                    # the emulation seam: a raise-mode fault IS the dead
+                    # peer (its beat freezes); delay-mode IS the straggler
+                    _faults.fire("heartbeat.miss", key=str(p))
+                except _faults.InjectedFault:
+                    continue  # peer went silent: beat stays stale
+                self._beats[p] = now
+            return dict(self._beats)
+
+
+class DistributedKVHeartbeats:
+    """The pod transport: beats ride the jax.distributed coordinator's
+    key-value store (the same service every process already depends on
+    to exist), so reading a peer's beat never touches a device
+    collective — exactly the property a liveness channel needs when the
+    collectives themselves are what hang. Best-effort by design: a store
+    read that fails leaves the previous beat in place (staleness
+    accumulates, which IS the signal)."""
+
+    KEY_PREFIX = "photon/heartbeat/"
+
+    def __init__(self, num_processes: int, client=None):
+        self.num_processes = int(num_processes)
+        if client is None:
+            from jax._src import distributed as _dist
+
+            client = getattr(_dist.global_state, "client", None)
+        if client is None:
+            raise RuntimeError(
+                "DistributedKVHeartbeats needs the jax.distributed "
+                "coordinator client; call initialize_multihost() first "
+                "(single-process drills use InProcessHeartbeats)"
+            )
+        self._client = client
+        self._beats: Dict[int, float] = {}
+
+    def publish(self, pid: int, t: float) -> None:
+        try:
+            self._client.key_value_set(
+                f"{self.KEY_PREFIX}{int(pid)}", repr(float(t))
+            )
+        except Exception:  # noqa: BLE001 — liveness channel is best-effort
+            pass
+
+    def read(self, self_pid: int) -> Dict[int, float]:
+        for p in range(self.num_processes):
+            try:
+                # non-blocking-ish read: a 50ms budget per key keeps one
+                # dead coordinator from turning the monitor into a hang
+                raw = self._client.blocking_key_value_get(
+                    f"{self.KEY_PREFIX}{p}", 50
+                )
+                self._beats[p] = float(raw)
+            except Exception:  # noqa: BLE001 — stale beat IS the signal
+                continue
+        return dict(self._beats)
+
+
+class HeartbeatMonitor:
+    """Publishes this process's beat and watches the peers'.
+
+    Two drive modes share one code path: :meth:`start` runs
+    :meth:`poll_once` on a daemon thread every ``interval_s`` (the
+    production mode — detection latency is bounded by the interval, not
+    the pass length), while an un-started monitor polls lazily inside
+    :meth:`check` (deterministic for drills: one poll per pass
+    boundary). A peer whose beat is staler than
+    ``miss_intervals * interval_s`` is LOST — permanently, per monitor:
+    a host that "comes back" after detection must rejoin as a fresh
+    restart, not resurrect mid-run."""
+
+    def __init__(
+        self,
+        interval_s: float = 5.0,
+        miss_intervals: float = 3.0,
+        transport=None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+        clock=time.monotonic,
+    ):
+        import jax
+
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        if miss_intervals <= 0:
+            raise ValueError(
+                f"miss_intervals must be > 0, got {miss_intervals}"
+            )
+        self.interval_s = float(interval_s)
+        self.miss_intervals = float(miss_intervals)
+        self.process_index = (
+            jax.process_index() if process_index is None else int(process_index)
+        )
+        self.process_count = (
+            jax.process_count() if process_count is None else int(process_count)
+        )
+        if transport is None:
+            if self.process_count > 1 and jax.process_count() > 1:
+                transport = DistributedKVHeartbeats(self.process_count)
+            else:
+                transport = InProcessHeartbeats(
+                    self.process_count, clock=clock
+                )
+        self.transport = transport
+        self._clock = clock
+        self._lost: Dict[int, float] = {}  # peer -> age at detection
+        self._ages: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- polling -----------------------------------------------------------
+
+    def poll_once(self) -> Dict[int, float]:
+        """One beat + read cycle; returns peer -> beat age (seconds).
+        Updates the ``pod.heartbeat.*`` gauges and records newly lost
+        peers (``heartbeat.peer_lost`` event; lost peers never
+        un-lose)."""
+        from photon_ml_tpu import obs
+
+        now = self._clock()
+        self.transport.publish(self.process_index, now)
+        beats = self.transport.read(self.process_index)
+        reg = obs.registry()
+        reg.inc("pod.heartbeat.beats")
+        threshold = self.miss_intervals * self.interval_s
+        ages: Dict[int, float] = {}
+        newly_lost: List[int] = []
+        with self._lock:
+            for p in range(self.process_count):
+                if p == self.process_index:
+                    continue
+                age = now - beats.get(p, -float("inf"))
+                ages[p] = age
+                reg.set_gauge(f"pod.heartbeat.age_s.h{p}", round(age, 4))
+                if age > threshold:
+                    reg.inc("pod.heartbeat.misses")
+                    if p not in self._lost:
+                        self._lost[p] = age
+                        newly_lost.append(p)
+            self._ages = ages
+            if ages:
+                slow = max(ages, key=ages.get)
+                reg.set_gauge("pod.heartbeat.slowest_host", slow)
+                reg.set_gauge(
+                    "pod.heartbeat.slowest_age_s", round(ages[slow], 4)
+                )
+        for p in newly_lost:
+            obs.emit_event(
+                "heartbeat.peer_lost",
+                cat="resilience",
+                peer=p,
+                age_s=round(ages[p], 4),
+                threshold_s=round(threshold, 4),
+                host=self.process_index,
+            )
+        return ages
+
+    # -- queries -----------------------------------------------------------
+
+    def lost_peers(self) -> List[int]:
+        with self._lock:
+            return sorted(self._lost)
+
+    def slowest(self) -> Optional[Tuple[int, float]]:
+        """(peer index, beat age) of the most stale peer seen at the last
+        poll — the straggler attribution the collective watchdog reports
+        when an exchange times out. None with no peers polled yet."""
+        with self._lock:
+            if not self._ages:
+                return None
+            slow = max(self._ages, key=self._ages.get)
+            return slow, self._ages[slow]
+
+    def check(self) -> None:
+        """Raise :class:`HostLossDetected` if any peer is lost. The pass-
+        boundary poll of the descent loop; on an un-started monitor this
+        also performs the poll (deterministic drill mode)."""
+        if self._thread is None:
+            self.poll_once()
+        if self._lost:
+            raise HostLossDetected(self.lost_peers(), reason="heartbeat")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "HeartbeatMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — monitor must not die
+                    pass
+
+        t = threading.Thread(
+            target=loop, name="photon-heartbeat", daemon=True
+        )
+        self._thread = t
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "HeartbeatMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# one process-wide monitor handle: the collective watchdog asks it for
+# straggler attribution when an exchange times out, without the call
+# sites having to thread the monitor everywhere
+_MONITOR: Optional[HeartbeatMonitor] = None
+
+
+def install_monitor(monitor: Optional[HeartbeatMonitor]):
+    """Set (or clear, with None) the process-wide monitor; returns the
+    previous one so drivers can restore it."""
+    global _MONITOR
+    prev = _MONITOR
+    _MONITOR = monitor
+    return prev
+
+
+def current_monitor() -> Optional[HeartbeatMonitor]:
+    return _MONITOR

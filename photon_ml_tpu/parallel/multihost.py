@@ -32,12 +32,174 @@ multi-host generalization of ``shard_batch``.
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Optional
+import threading
+import time
+from typing import Callable, Optional
 
 import jax
 
+from photon_ml_tpu.resilience import faults as _faults
+
 _INITIALIZED = False
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog (docs/MULTIHOST.md)
+# ---------------------------------------------------------------------------
+#
+# Every host-side collective in this module blocks until EVERY process
+# arrives — which means one dead or wedged peer turns the whole pod into
+# a silent hang. The watchdog bounds that: a configured deadline runs the
+# exchange on a worker thread, abandons an attempt that outlives it
+# (same abandon-the-thread shape as the ingest-pipeline stage watchdog —
+# a hung gRPC exchange cannot be cancelled, only orphaned), records the
+# stall (``collective.stalls`` counter, ``collective.stall_ms``
+# histogram, a ``collective.stall`` event with straggler attribution
+# from the heartbeat monitor when one is installed), and retries through
+# the resilience backoff seam. A stall that survives the retry budget
+# surfaces as RetryBudgetExceeded whose cause is CollectiveTimeout —
+# which the drivers map to the host-loss exit contract
+# (resilience.hostloss) instead of hanging until the scheduler's
+# preemption timer fires.
+
+
+class CollectiveTimeout(OSError):
+    """A host collective exceeded its watchdog deadline. Subclasses
+    OSError so the retry seam classifies it as transient — a straggler
+    host may still arrive on the retry; a DEAD host exhausts the budget
+    and escalates to the host-loss contract."""
+
+    def __init__(self, label: str, timeout_s: float, attempt: int):
+        super().__init__(
+            f"collective {label!r} exceeded its {timeout_s:.3g}s watchdog "
+            f"deadline (attempt {attempt})"
+        )
+        self.label = label
+        self.timeout_s = timeout_s
+        self.attempt = attempt
+
+
+@dataclasses.dataclass
+class CollectiveResilience:
+    """Watchdog policy for host-side collectives. ``timeout_s`` None
+    (default) keeps the bare blocking exchange — zero thread overhead,
+    the pre-existing behavior."""
+
+    timeout_s: Optional[float] = None
+    retries: int = 2
+
+
+_RESILIENCE = CollectiveResilience()
+
+
+def configure_collective_resilience(
+    timeout_s: Optional[float] = None, retries: int = 2
+) -> CollectiveResilience:
+    """Install the watchdog policy for every host collective in this
+    module (the ``--collective-timeout-s`` surface). Returns the
+    PREVIOUS policy so drivers can restore it."""
+    global _RESILIENCE
+    if timeout_s is not None and timeout_s <= 0:
+        raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    prev = _RESILIENCE
+    _RESILIENCE = CollectiveResilience(timeout_s=timeout_s, retries=retries)
+    return prev
+
+
+def collective_resilience() -> CollectiveResilience:
+    return _RESILIENCE
+
+
+def _note_stall(label: str, waited_s: float, attempt: int) -> None:
+    """Record one watchdog trip: metrics + a straggler-attributed event
+    (riding the flight recorder when installed) BEFORE the pod would
+    otherwise deadlock in silence."""
+    from photon_ml_tpu import obs
+
+    reg = obs.registry()
+    reg.inc("collective.stalls")
+    reg.observe("collective.stall_ms", waited_s * 1e3)
+    slowest_host, slowest_age = None, None
+    try:
+        from photon_ml_tpu.parallel.heartbeat import current_monitor
+
+        mon = current_monitor()
+        if mon is not None and mon.slowest() is not None:
+            slowest_host, slowest_age = mon.slowest()
+            reg.set_gauge("pod.heartbeat.slowest_host", slowest_host)
+            reg.set_gauge(
+                "pod.heartbeat.slowest_age_s", round(slowest_age, 4)
+            )
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        pass
+    obs.emit_event(
+        "collective.stall",
+        cat="collective",
+        label=label,
+        waited_s=round(waited_s, 4),
+        attempt=attempt,
+        slowest_host=slowest_host,
+        slowest_age_s=(
+            round(slowest_age, 4) if slowest_age is not None else None
+        ),
+    )
+
+
+def _resilient_exchange(label: str, fn: Callable):
+    """Run one host collective under the configured watchdog + retry
+    policy. Probes fault site ``collective.stall`` (key = label) inside
+    each attempt, so a delay-mode drill stalls the attempt exactly like
+    a straggler host and a raise-mode ``collective.allreduce`` spec (the
+    PR-10 seam, probed by the call sites themselves) exercises the same
+    retry path a dying peer does."""
+    cfg = _RESILIENCE
+
+    def attempt_body():
+        _faults.fire("collective.stall", key=label)
+        return fn()
+
+    if cfg.timeout_s is None:
+        return attempt_body()
+
+    from photon_ml_tpu.resilience import retry as _retry
+
+    attempts = [0]
+
+    def deadline_attempt():
+        attempts[0] += 1
+        result: list = []
+        error: list = []
+
+        def work():
+            try:
+                result.append(attempt_body())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                error.append(e)
+
+        t = threading.Thread(
+            target=work, name=f"collective-{label}", daemon=True
+        )
+        t0 = time.perf_counter()
+        t.start()
+        t.join(cfg.timeout_s)
+        if t.is_alive():
+            # the attempt is ABANDONED (a hung exchange has no cancel);
+            # the orphan thread's eventual result is discarded
+            _note_stall(label, time.perf_counter() - t0, attempts[0])
+            raise CollectiveTimeout(label, cfg.timeout_s, attempts[0])
+        if error:
+            raise error[0]
+        return result[0]
+
+    return _retry.retry_call(
+        deadline_attempt,
+        retries=cfg.retries,
+        label=f"collective {label}",
+    )
 
 
 def initialize_multihost(
@@ -100,7 +262,14 @@ def emit_pod_sync() -> None:
         from jax.experimental import multihost_utils
 
         def barrier():
-            multihost_utils.sync_global_devices("photon-obs-clock-sync")
+            # barriers are collectives too: a dead peer would wedge the
+            # sync forever, so it rides the same watchdog/retry seam
+            _resilient_exchange(
+                "pod_sync",
+                lambda: multihost_utils.sync_global_devices(
+                    "photon-obs-clock-sync"
+                ),
+            )
 
     obs_dist.emit_clock_sync(sync_id="startup", barrier=barrier)
 
@@ -182,32 +351,40 @@ def allgather_host(x):
     Host-blocking by construction, so the collective profiler
     (``obs.collectives``) gets a TRUE per-exchange wall: every call
     records ``collective.allgather_host.w<nproc>.{count,bytes,wall_ms}``
-    and, when traced, a ``collective.allgather_host`` span."""
+    and, when traced, a ``collective.allgather_host`` span.
+
+    With a watchdog configured (:func:`configure_collective_resilience`
+    / ``--collective-timeout-s``), the exchange runs under a deadline
+    and retries through the resilience backoff seam instead of wedging
+    the pod on a dead peer; exhaustion surfaces the host-loss contract
+    (docs/MULTIHOST.md)."""
     import numpy as np
 
-    from photon_ml_tpu.resilience import faults as _faults
+    def exchange():
+        # chaos seam: the multihost collective boundary. Probed INSIDE
+        # the watchdogged attempt and BEFORE the single-process
+        # early-return so drills exercise the seam without a pod:
+        # raise-mode simulates a peer dying mid-exchange (the error a
+        # real pod sees when a host drops), delay-mode a straggler host
+        # that the watchdog times out.
+        _faults.fire("collective.allreduce", key="allgather_host")
+        if jax.process_count() == 1:
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
 
-    # chaos seam: the multihost collective boundary. Probed BEFORE the
-    # single-process early-return so drills exercise the seam without a
-    # pod: raise-mode simulates a peer dying mid-exchange (the error a
-    # real pod sees when a host drops), delay-mode a straggler host.
-    _faults.fire("collective.allreduce", key="allgather_host")
-    if jax.process_count() == 1:
-        return np.asarray(x)
-    from jax.experimental import multihost_utils
+        from photon_ml_tpu.obs import collectives as obs_coll
 
-    from photon_ml_tpu.obs import collectives as obs_coll
+        arr = np.asarray(x)
+        with obs_coll.collective_span(
+            "allgather_host",
+            mesh_width=jax.process_count(),
+            nbytes=int(arr.nbytes),
+        ):
+            return np.asarray(
+                multihost_utils.process_allgather(arr, tiled=True)
+            )
 
-    x = np.asarray(x)
-    with obs_coll.collective_span(
-        "allgather_host",
-        mesh_width=jax.process_count(),
-        nbytes=int(x.nbytes),
-    ):
-        out = np.asarray(
-            multihost_utils.process_allgather(x, tiled=True)
-        )
-    return out
+    return _resilient_exchange("allgather_host", exchange)
 
 
 def allgather_strings(strs):

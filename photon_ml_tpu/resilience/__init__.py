@@ -8,6 +8,8 @@ subsystem makes failure handling explicit and *testable*:
 - :mod:`.faults`   — deterministic fault injection at named sites
 - :mod:`.retry`    — bounded exponential backoff for transient I/O
 - :mod:`.shutdown` — SIGTERM/SIGINT -> checkpoint + resumable exit
+- :mod:`.hostloss` — dead-peer detection -> final shard set + distinct
+  exit code -> elastic restart (docs/MULTIHOST.md)
 
 Checkpoint integrity (sha256 manifests, newest-VALID fallback) lives with
 the store in :mod:`photon_ml_tpu.io.checkpoint`; the divergence guard
@@ -29,6 +31,15 @@ from photon_ml_tpu.resilience.faults import (
     parse_spec,
     register_site,
     registry,
+)
+from photon_ml_tpu.resilience.hostloss import (
+    HOST_LOSS_EXIT_CODE,
+    HOST_LOSS_MARKER,
+    HostLossDetected,
+    clear_host_loss_marker,
+    is_host_loss,
+    read_host_loss_marker,
+    write_host_loss_marker,
 )
 from photon_ml_tpu.resilience.retry import (
     RetryBudgetExceeded,
@@ -60,6 +71,13 @@ __all__ = [
     "RetryBudgetExceeded",
     "backoff_delays",
     "retry_call",
+    "HOST_LOSS_EXIT_CODE",
+    "HOST_LOSS_MARKER",
+    "HostLossDetected",
+    "clear_host_loss_marker",
+    "is_host_loss",
+    "read_host_loss_marker",
+    "write_host_loss_marker",
     "PREEMPTED_MARKER",
     "GracefulShutdown",
     "clear_preempted_marker",

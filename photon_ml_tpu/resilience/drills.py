@@ -47,6 +47,7 @@ from photon_ml_tpu.resilience.faults import (
 __all__ = [
     "DrillResult",
     "DRILLS",
+    "MULTIHOST_DRILLS",
     "run_drills",
     "overload_run",
     "breaker_drill",
@@ -717,6 +718,278 @@ def drill_collective_seam(smoke: bool = True) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# drill: collective watchdog — stall times out, retries, attributes
+# ---------------------------------------------------------------------------
+
+
+def drill_collective_stall(smoke: bool = True) -> dict:
+    """A stalled ``allgather_host`` must TIME OUT and retry through the
+    backoff seam instead of hanging the pod, recording the stall
+    (``collective.stalls`` / ``collective.stall_ms``) with straggler
+    attribution; a stall outliving the retry budget surfaces the
+    host-loss contract (docs/MULTIHOST.md)."""
+    from photon_ml_tpu import obs as _obs
+    from photon_ml_tpu.parallel import multihost
+    from photon_ml_tpu.parallel.heartbeat import (
+        HeartbeatMonitor,
+        InProcessHeartbeats,
+        install_monitor,
+    )
+    from photon_ml_tpu.resilience.hostloss import is_host_loss
+    from photon_ml_tpu.resilience.retry import RetryBudgetExceeded
+
+    reg = _obs.registry()
+    stalls_before = reg.counter("collective.stalls").value
+    # a monitor so the stall event carries slowest-host attribution
+    mon = HeartbeatMonitor(
+        interval_s=0.01, miss_intervals=1e6,
+        transport=InProcessHeartbeats(2), process_index=0, process_count=2,
+    )
+    mon.poll_once()
+    prev_mon = install_monitor(mon)
+    prev = multihost.configure_collective_resilience(
+        timeout_s=0.1, retries=2
+    )
+    try:
+        # one stalled attempt -> watchdog timeout -> retry succeeds;
+        # wall stays bounded by the deadline, NOT the stall length
+        t0 = time.perf_counter()
+        with inject(
+            FaultSpec("collective.stall", "delay", nth=1, delay=2.0)
+        ):
+            out = multihost.allgather_host(np.arange(8))
+        recovery_s = time.perf_counter() - t0
+        np.testing.assert_array_equal(out, np.arange(8))
+        assert recovery_s < 1.9, (
+            f"watchdog waited out the stall ({recovery_s:.2f}s) instead "
+            "of abandoning the attempt"
+        )
+        stalls = reg.counter("collective.stalls").value - stalls_before
+        assert stalls >= 1, "watchdog trip never recorded"
+        # a PERSISTENT stall (dead peer) exhausts the budget and maps to
+        # the host-loss exit contract instead of hanging
+        try:
+            with inject(
+                FaultSpec(
+                    "collective.stall", "delay", nth=1, count=-1, delay=0.5
+                )
+            ):
+                multihost.allgather_host(np.arange(2))
+            raise AssertionError("persistent stall did not surface")
+        except RetryBudgetExceeded as e:
+            assert isinstance(e.__cause__, multihost.CollectiveTimeout)
+            assert is_host_loss(e), (
+                "exhausted collective budget must map to host loss"
+            )
+    finally:
+        multihost.configure_collective_resilience(
+            prev.timeout_s, prev.retries
+        )
+        install_monitor(prev_mon)
+    return {
+        "collective_timeout_recovery_s": round(recovery_s, 4),
+        "stalls_recorded": int(stalls),
+    }
+
+
+# ---------------------------------------------------------------------------
+# drill: heartbeat monitor detects a silent peer
+# ---------------------------------------------------------------------------
+
+
+def drill_heartbeat_loss(smoke: bool = True) -> dict:
+    """A peer that stops beating must be declared LOST within the miss
+    threshold — gauges updated, ``heartbeat.peer_lost`` emitted, and
+    ``check()`` raising :class:`HostLossDetected` (the pass-boundary
+    signal the descent loop converts into a final shard set)."""
+    from photon_ml_tpu import obs as _obs
+    from photon_ml_tpu.parallel.heartbeat import (
+        HeartbeatMonitor,
+        InProcessHeartbeats,
+    )
+    from photon_ml_tpu.resilience.hostloss import HostLossDetected
+
+    mon = HeartbeatMonitor(
+        interval_s=1e-3, miss_intervals=1.0,
+        transport=InProcessHeartbeats(3), process_index=0, process_count=3,
+    )
+    mon.poll_once()
+    assert mon.lost_peers() == [], "healthy pod reported losses"
+    time.sleep(0.01)
+    with inject(
+        FaultSpec("heartbeat.miss", "raise", nth=1, count=-1, key="2")
+    ):
+        time.sleep(0.01)
+        mon.poll_once()
+    assert mon.lost_peers() == [2], (
+        f"expected peer 2 lost, got {mon.lost_peers()}"
+    )
+    try:
+        mon.check()
+        raise AssertionError("check() did not raise on a lost peer")
+    except HostLossDetected as e:
+        assert e.peers == [2]
+    # losses latch: a zombie peer beating again must NOT resurrect
+    mon.poll_once()
+    assert mon.lost_peers() == [2], "lost peer un-lost itself"
+    reg = _obs.registry()
+    assert reg.counter("pod.heartbeat.misses").value >= 1
+    return {
+        "lost_peers": mon.lost_peers(),
+        "slowest": list(mon.slowest() or ()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# drill: host kill -> final shard set + marker -> shrunk restart resumes
+# ---------------------------------------------------------------------------
+
+
+def drill_host_loss_recovery(smoke: bool = True) -> dict:
+    """The full elastic contract (docs/MULTIHOST.md): a peer dies
+    mid-run -> survivors write a FINAL sharded checkpoint + host-loss
+    marker and surface the distinct exit code -> a restart at a SMALLER
+    world size resumes from the shard set and matches the uninterrupted
+    run to solver tolerance."""
+    from photon_ml_tpu.io.checkpoint import latest_checkpoint
+    from photon_ml_tpu.parallel.heartbeat import (
+        HeartbeatMonitor,
+        InProcessHeartbeats,
+    )
+    from photon_ml_tpu.resilience.hostloss import (
+        HOST_LOSS_EXIT_CODE,
+        HostLossDetected,
+        read_host_loss_marker,
+    )
+
+    tol = _tolerance()
+    ekeys = {"per-user": [f"user{i}" for i in range(4)]}
+    with tempfile.TemporaryDirectory() as tmp:
+        # oracle: uninterrupted 4-pass run (sharded checkpoints too, so
+        # the formats match end to end)
+        model_a, _ = _tiny_game(np.random.default_rng(41)).run(
+            num_iterations=4, seed=3,
+            checkpoint_dir=os.path.join(tmp, "a"), checkpoint_every=1,
+            sharded_checkpoints=2, entity_keys=ekeys,
+        )
+        # "host kill": peer 1 goes silent from the third boundary poll
+        mon = HeartbeatMonitor(
+            interval_s=1e-4, miss_intervals=1.0,
+            transport=InProcessHeartbeats(2),
+            process_index=0, process_count=2,
+        )
+        ckdir = os.path.join(tmp, "b")
+        died = None
+        with inject(
+            FaultSpec("heartbeat.miss", "raise", nth=3, count=-1, key="1")
+        ):
+            try:
+                _tiny_game(np.random.default_rng(41)).run(
+                    num_iterations=4, seed=3,
+                    checkpoint_dir=ckdir, checkpoint_every=1,
+                    sharded_checkpoints=2, entity_keys=ekeys,
+                    heartbeat=mon,
+                )
+                raise AssertionError("peer loss never surfaced")
+            except HostLossDetected as e:
+                died = e
+        assert died.peers == [1]
+        marker = read_host_loss_marker(ckdir)
+        assert marker is not None, "no host-loss marker"
+        assert marker["exit_code"] == HOST_LOSS_EXIT_CODE
+        assert HOST_LOSS_EXIT_CODE not in (0, 1, 2, 3), (
+            "host-loss exit code must be distinct from the existing "
+            "exit taxonomy"
+        )
+        ck = latest_checkpoint(ckdir)
+        assert ck is not None and ck.shards == 2, (
+            "survivors left no restorable shard set"
+        )
+        assert ck.step == marker["step"], (
+            f"marker step {marker['step']} != final shard set {ck.step}"
+        )
+        # elastic restart at a SMALLER world size (2 shards -> 1):
+        # entity-keyed shards reassemble + re-shard by key
+        model_b, _ = _tiny_game(np.random.default_rng(41)).run(
+            num_iterations=4, seed=3,
+            checkpoint_dir=ckdir, checkpoint_every=1,
+            sharded_checkpoints=1, entity_keys=ekeys, resume=True,
+        )
+        for name in model_a.params:
+            np.testing.assert_allclose(
+                np.asarray(model_b.params[name]),
+                np.asarray(model_a.params[name]),
+                rtol=0, atol=tol, err_msg=name,
+            )
+    return {
+        "died_at_step": marker["step"],
+        "lost_peers": died.peers,
+        "exit_code": HOST_LOSS_EXIT_CODE,
+        "resumed_world_size": 1,
+        "bit_identical_resume": True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# drill: torn / missing shard -> quorum falls back to newest complete step
+# ---------------------------------------------------------------------------
+
+
+def drill_torn_shard(smoke: bool = True) -> dict:
+    """Sharded-checkpoint quorum: a step whose shard set is torn
+    (corrupt-mode ``checkpoint.shard_write``) or incomplete must NEVER
+    restore — ``latest_checkpoint`` falls back to the newest step with a
+    complete, digest-verified shard set."""
+    from photon_ml_tpu.io.checkpoint import (
+        latest_checkpoint,
+        save_checkpoint_sharded,
+    )
+
+    rng = np.random.default_rng(17)
+    params = {
+        "fixed": rng.normal(size=6),
+        "per-user": rng.normal(size=(5, 3)),
+    }
+    ekeys = {"per-user": [f"u{i}" for i in range(5)]}
+    key = np.zeros(2, np.uint32)
+    with tempfile.TemporaryDirectory() as tmp:
+        save_checkpoint_sharded(
+            tmp, 1, params, key, entity_keys=ekeys, num_shards=2, keep=5
+        )
+        # torn shard: digest recorded, payload flipped afterwards
+        with inject(FaultSpec("checkpoint.shard_write", "corrupt", nth=2)):
+            save_checkpoint_sharded(
+                tmp, 2, params, key, entity_keys=ekeys, num_shards=2,
+                keep=5,
+            )
+        ck = latest_checkpoint(tmp)
+        assert ck is not None and ck.step == 1, (
+            f"torn step 2 restored (got step {ck and ck.step})"
+        )
+        # missing shard: the quorum manifest lists it but it's gone
+        save_checkpoint_sharded(
+            tmp, 3, params, key, entity_keys=ekeys, num_shards=2, keep=5
+        )
+        os.remove(os.path.join(tmp, "step-3", "shard-1-of-2.npz"))
+        ck = latest_checkpoint(tmp)
+        assert ck is not None and ck.step == 1, (
+            f"incomplete step 3 restored (got step {ck and ck.step})"
+        )
+        # raise-mode shard write retries through the backoff seam and
+        # still produces a loadable step
+        with inject(FaultSpec("checkpoint.shard_write", "raise", nth=1)):
+            save_checkpoint_sharded(
+                tmp, 4, params, key, entity_keys=ekeys, num_shards=2,
+                keep=5,
+            )
+        ck = latest_checkpoint(tmp)
+        assert ck.step == 4
+        np.testing.assert_array_equal(ck.params["per-user"],
+                                      params["per-user"])
+    return {"fallback_step": 1, "retried_step_restored": 4}
+
+
+# ---------------------------------------------------------------------------
 # drill: PR-1 legacy sites still hold their invariants
 # ---------------------------------------------------------------------------
 
@@ -749,7 +1022,25 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     "async_checkpoint": drill_async_checkpoint,
     "collective_seam": drill_collective_seam,
     "checkpoint_integrity": drill_checkpoint_integrity,
+    # multi-host resilience (docs/MULTIHOST.md) — all run single-process
+    # on CPU via armed collective.stall / heartbeat.miss /
+    # checkpoint.shard_write faults
+    "collective_stall": drill_collective_stall,
+    "heartbeat_loss": drill_heartbeat_loss,
+    "host_loss_recovery": drill_host_loss_recovery,
+    "torn_shard": drill_torn_shard,
 }
+
+# the subset `photon-chaos drill --multihost-smoke` runs: every drill of
+# the elastic multi-host layer, nothing else — the schedule an operator
+# points at a pod deployment host before trusting it with a long run
+MULTIHOST_DRILLS = (
+    "collective_seam",
+    "collective_stall",
+    "heartbeat_loss",
+    "host_loss_recovery",
+    "torn_shard",
+)
 
 
 def run_drills(

@@ -22,6 +22,13 @@ Sites in-tree today::
     pipeline.decode         per decode-pool group attempt (key = chunk index)
     pipeline.transfer       per staged-chunk device transfer (key = chunk)
     collective.allreduce    per multihost host-collective exchange
+    collective.stall        inside each watchdogged collective attempt
+                            (key = exchange label; delay = straggler host,
+                            raise = peer died mid-exchange)
+    heartbeat.miss          per peer per heartbeat poll (key = peer index;
+                            raise = peer went silent, delay = straggler)
+    checkpoint.shard_write  per per-process checkpoint shard write
+                            (key = shard index; corrupt = torn shard)
 
 Arming a site OUTSIDE this list raises at arm time: a typo'd drill that
 silently probes nothing would "pass" by testing nothing. Libraries that
@@ -72,6 +79,9 @@ KNOWN_SITES = (
     "pipeline.decode",
     "pipeline.transfer",
     "collective.allreduce",
+    "collective.stall",
+    "heartbeat.miss",
+    "checkpoint.shard_write",
 )
 
 MODES = ("raise", "corrupt", "delay")

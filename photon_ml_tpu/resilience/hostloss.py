@@ -1,0 +1,121 @@
+"""Host-loss recovery contract for elastic multi-host training.
+
+The reference outlives a dead executor because Spark reschedules its
+tasks; a multi-controller SPMD pod has no scheduler — when one process
+dies or wedges, every surviving process's next collective blocks
+forever. This module defines what the survivors do instead
+(docs/MULTIHOST.md):
+
+1. DETECT — the heartbeat monitor (:mod:`photon_ml_tpu.parallel.
+   heartbeat`) or a collective watchdog timeout (:mod:`photon_ml_tpu.
+   parallel.multihost`) raises :class:`HostLossDetected` at a pass
+   boundary.
+2. CHECKPOINT — the training loop writes a FINAL sharded checkpoint
+   (each survivor writes its shard; the quorum manifest makes the step
+   restorable) plus a ``host-loss.json`` marker.
+3. EXIT — the driver exits with :data:`HOST_LOSS_EXIT_CODE`, distinct
+   from success (0), generic failure (1), config errors (2), and the
+   failed-drain serving exit (3), so a cluster manager can tell "restart
+   me, possibly smaller" from "do not retry".
+4. RESUME — a restart at the SAME OR SMALLER world size loads the
+   sharded checkpoint (entity-keyed shards re-shard onto the new
+   layout) and reproduces the uninterrupted run bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+# Distinct restart-me exit status (see module doc for the taxonomy).
+# Past the conventional shell/signal codes so it cannot collide with a
+# Python traceback exit (1), argparse (2), or the serving drain exit (3).
+HOST_LOSS_EXIT_CODE = 43
+
+HOST_LOSS_MARKER = "host-loss.json"
+
+
+class HostLossDetected(RuntimeError):
+    """A peer process is dead or unreachable (missed heartbeats, or a
+    collective that timed out past its retry budget). Carries the lost
+    peer indices so markers/logs can attribute the loss."""
+
+    def __init__(self, peers: Sequence[int], reason: str = "heartbeat"):
+        peers = sorted(int(p) for p in peers)
+        super().__init__(
+            f"host loss detected ({reason}): peer process(es) {peers} "
+            "missing — survivors checkpoint and exit "
+            f"{HOST_LOSS_EXIT_CODE} for an elastic restart"
+        )
+        self.peers: List[int] = list(peers)
+        self.reason = reason
+
+
+def is_host_loss(exc: BaseException) -> bool:
+    """True when ``exc`` should map to the host-loss exit contract:
+    a :class:`HostLossDetected`, or a collective failure whose cause
+    chain bottoms out in one (retry wrappers re-raise with the original
+    as ``__cause__``)."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, HostLossDetected):
+            return True
+        # CollectiveTimeout subclasses OSError; import lazily to keep
+        # resilience free of a parallel dependency at import time
+        if type(exc).__name__ == "CollectiveTimeout":
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def write_host_loss_marker(
+    checkpoint_dir: str,
+    step: int,
+    peers: Sequence[int],
+    reason: str = "heartbeat",
+) -> str:
+    """Record that the run exited on host loss but left a restorable
+    final checkpoint at ``step``. Advisory, like ``preempted.json`` —
+    resume works off the checkpoints alone — but tells operators and
+    restart tooling WHY the run ended and which peers were lost."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    path = os.path.join(checkpoint_dir, HOST_LOSS_MARKER)
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "step": int(step),
+                "peers": sorted(int(p) for p in peers),
+                "reason": reason,
+                "exit_code": HOST_LOSS_EXIT_CODE,
+            },
+            f,
+        )
+    from photon_ml_tpu import obs
+
+    obs.registry().inc("resilience.host_losses")
+    obs.emit_event(
+        "resilience.host_loss_marker_written",
+        cat="resilience",
+        step=int(step),
+        peers=sorted(int(p) for p in peers),
+        reason=reason,
+    )
+    return path
+
+
+def read_host_loss_marker(checkpoint_dir: str) -> Optional[dict]:
+    path = os.path.join(checkpoint_dir, HOST_LOSS_MARKER)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def clear_host_loss_marker(checkpoint_dir: str) -> None:
+    try:
+        os.remove(os.path.join(checkpoint_dir, HOST_LOSS_MARKER))
+    except FileNotFoundError:
+        pass

@@ -1,0 +1,661 @@
+"""Elastic multi-host resilience (docs/MULTIHOST.md): sharded quorum
+checkpoints, collective watchdogs, heartbeat-driven host-loss detection,
+and the survivors' final-shard-set + distinct-exit + shrunk-restart
+contract — all exercised single-process on CPU through the armed
+``collective.stall`` / ``collective.allreduce`` / ``heartbeat.miss`` /
+``checkpoint.shard_write`` fault sites (the jax<0.5 CPU backend cannot
+run real two-process collectives; see tests/test_parallel.py)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.checkpoint import (
+    CheckpointCorrupted,
+    latest_checkpoint,
+    reindex_entity_params,
+    save_checkpoint,
+    save_checkpoint_sharded,
+    verify_checkpoint,
+)
+from photon_ml_tpu.parallel import multihost
+from photon_ml_tpu.parallel.heartbeat import (
+    HeartbeatMonitor,
+    InProcessHeartbeats,
+    current_monitor,
+    install_monitor,
+)
+from photon_ml_tpu.resilience import (
+    HOST_LOSS_EXIT_CODE,
+    HostLossDetected,
+    RetryBudgetExceeded,
+    is_host_loss,
+    read_host_loss_marker,
+)
+from photon_ml_tpu.resilience.faults import FaultSpec, InjectedFault, inject
+
+pytestmark = pytest.mark.multihost
+
+
+@pytest.fixture
+def watchdog():
+    """Install a tight collective watchdog for the test, restoring the
+    previous policy afterwards."""
+    prev = multihost.configure_collective_resilience(
+        timeout_s=0.1, retries=2
+    )
+    try:
+        yield multihost.collective_resilience()
+    finally:
+        multihost.configure_collective_resilience(
+            prev.timeout_s, prev.retries
+        )
+
+
+def _params(rng, n_entities=7, d=3):
+    from photon_ml_tpu.game.factored import FactoredParams
+
+    return {
+        "fixed": rng.normal(size=5),
+        "per-user": rng.normal(size=(n_entities, d)),
+        "fact": FactoredParams(
+            gamma=rng.normal(size=(n_entities, 2)),
+            projection=rng.normal(size=(2, d)),
+        ),
+    }
+
+
+def _keys(n, prefix="u"):
+    return [f"{prefix}{i}" for i in range(n)]
+
+
+class TestShardedCheckpointStore:
+    @pytest.mark.parametrize("num_shards", [1, 2, 3, 5])
+    def test_round_trip_any_shard_count(self, tmp_path, rng, num_shards):
+        params = _params(rng)
+        ekeys = {"per-user": _keys(7), "fact": _keys(7)}
+        key = np.asarray([3, 4], np.uint32)
+        hist = [{"iteration": 0, "coordinate": "fixed", "objective": 1.0}]
+        path = save_checkpoint_sharded(
+            str(tmp_path), 2, params, key,
+            history=hist, frozen=["fact"],
+            entity_keys=ekeys, num_shards=num_shards,
+        )
+        files = sorted(os.listdir(path))
+        assert "manifest.json" in files
+        assert (
+            sum(f.endswith(".npz") for f in files) == num_shards
+        ), files
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck.step == 2
+        assert ck.shards == num_shards
+        assert ck.frozen == ["fact"]
+        assert ck.history == hist
+        np.testing.assert_array_equal(ck.rng_key, key)
+        np.testing.assert_array_equal(ck.params["fixed"], params["fixed"])
+        np.testing.assert_array_equal(
+            ck.params["per-user"], params["per-user"]
+        )
+        np.testing.assert_array_equal(
+            ck.params["fact"].gamma, params["fact"].gamma
+        )
+        np.testing.assert_array_equal(
+            ck.params["fact"].projection, params["fact"].projection
+        )
+        assert ck.entity_keys == {
+            "per-user": _keys(7), "fact": _keys(7)
+        }
+
+    def test_quorum_manifest_carries_per_shard_digests(self, tmp_path, rng):
+        path = save_checkpoint_sharded(
+            str(tmp_path), 1, _params(rng), np.zeros(2, np.uint32),
+            entity_keys={"per-user": _keys(7), "fact": _keys(7)},
+            num_shards=3,
+        )
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["format"] == "sharded"
+        assert manifest["shards"] == 3
+        assert sorted(manifest["digests"]) == [
+            f"shard-{p}-of-3.npz" for p in range(3)
+        ]
+        # per-shard manifests agree with the quorum digests
+        for p in range(3):
+            with open(os.path.join(path, f"shard-{p}-of-3.json")) as f:
+                side = json.load(f)
+            assert side["digest"] == manifest["digests"][
+                f"shard-{p}-of-3.npz"
+            ]
+        # replicated params live in shard 0 only
+        assert manifest["param_sharding"] == {
+            "fixed": "replicated", "per-user": "entity", "fact": "entity"
+        }
+
+    def test_torn_shard_falls_back_to_quorum_step(self, tmp_path, rng):
+        params = _params(rng)
+        ekeys = {"per-user": _keys(7), "fact": _keys(7)}
+        key = np.zeros(2, np.uint32)
+        save_checkpoint_sharded(
+            str(tmp_path), 1, params, key, entity_keys=ekeys,
+            num_shards=2, keep=5,
+        )
+        with inject(FaultSpec("checkpoint.shard_write", "corrupt", nth=2)):
+            save_checkpoint_sharded(
+                str(tmp_path), 2, params, key, entity_keys=ekeys,
+                num_shards=2, keep=5,
+            )
+        with pytest.raises(CheckpointCorrupted, match="digest mismatch"):
+            verify_checkpoint(str(tmp_path), 2)
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_missing_shard_is_no_quorum(self, tmp_path, rng):
+        params = _params(rng)
+        ekeys = {"per-user": _keys(7), "fact": _keys(7)}
+        key = np.zeros(2, np.uint32)
+        save_checkpoint_sharded(
+            str(tmp_path), 1, params, key, entity_keys=ekeys,
+            num_shards=2, keep=5,
+        )
+        save_checkpoint_sharded(
+            str(tmp_path), 2, params, key, entity_keys=ekeys,
+            num_shards=2, keep=5,
+        )
+        os.remove(str(tmp_path / "step-2" / "shard-0-of-2.npz"))
+        with pytest.raises(CheckpointCorrupted, match="no quorum"):
+            verify_checkpoint(str(tmp_path), 2)
+        assert latest_checkpoint(str(tmp_path)).step == 1
+
+    def test_shard_write_fault_retries(self, tmp_path, rng):
+        params = _params(rng)
+        with inject(FaultSpec("checkpoint.shard_write", "raise", nth=1)):
+            save_checkpoint_sharded(
+                str(tmp_path), 1, params, np.zeros(2, np.uint32),
+                entity_keys={"per-user": _keys(7), "fact": _keys(7)},
+                num_shards=2,
+            )
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck is not None and ck.step == 1
+        np.testing.assert_array_equal(
+            ck.params["per-user"], params["per-user"]
+        )
+
+    def test_legacy_and_sharded_steps_coexist(self, tmp_path, rng):
+        params = _params(rng)
+        key = np.zeros(2, np.uint32)
+        save_checkpoint(str(tmp_path), 1, params, key, keep=5)
+        save_checkpoint_sharded(
+            str(tmp_path), 2, params, key,
+            entity_keys={"per-user": _keys(7)}, num_shards=2, keep=5,
+        )
+        assert latest_checkpoint(str(tmp_path)).step == 2
+        # torn sharded step 2 -> the LEGACY step 1 is the quorum fallback
+        import shutil
+
+        shutil.rmtree(str(tmp_path / "step-2"))
+        save_checkpoint_sharded(
+            str(tmp_path), 3, params, key,
+            entity_keys={"per-user": _keys(7)}, num_shards=2, keep=5,
+        )
+        os.remove(str(tmp_path / "step-3" / "shard-1-of-2.npz"))
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck.step == 1 and ck.shards == 1
+
+    def test_entity_key_count_must_match_rows(self, tmp_path, rng):
+        with pytest.raises(ValueError, match="entity keys"):
+            save_checkpoint_sharded(
+                str(tmp_path), 1, {"t": rng.normal(size=(4, 2))},
+                np.zeros(2, np.uint32),
+                entity_keys={"t": _keys(3)}, num_shards=2,
+            )
+
+    def test_reserved_hash_name_rejected(self, tmp_path, rng):
+        with pytest.raises(ValueError, match="#"):
+            save_checkpoint_sharded(
+                str(tmp_path), 1, {"a#b": rng.normal(size=3)},
+                np.zeros(2, np.uint32),
+            )
+
+    def test_whole_model_writer_rejects_multiprocess(
+        self, tmp_path, rng, monkeypatch
+    ):
+        """The satellite guard: on a pod, save_checkpoint must refuse
+        loudly (every process racing one step dir tramples the swap
+        protocol) and point at the sharded writer."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        with pytest.raises(RuntimeError, match="save_checkpoint_sharded"):
+            save_checkpoint(
+                str(tmp_path), 1, {"w": rng.normal(size=3)},
+                np.zeros(2, np.uint32),
+            )
+        # pod sharded saves pin num_shards to the process count
+        with pytest.raises(ValueError, match="num_shards"):
+            save_checkpoint_sharded(
+                str(tmp_path), 1, {"w": rng.normal(size=3)},
+                np.zeros(2, np.uint32), num_shards=2, process_index=0,
+            )
+
+
+class TestRestoreWithResharding:
+    def test_reindex_permuted_entity_order(self, tmp_path, rng):
+        params = _params(rng)
+        save_checkpoint_sharded(
+            str(tmp_path), 1, params, np.zeros(2, np.uint32),
+            entity_keys={"per-user": _keys(7), "fact": _keys(7)},
+            num_shards=3,
+        )
+        ck = latest_checkpoint(str(tmp_path))
+        perm = [3, 1, 0, 2, 6, 5, 4]
+        new_keys = [f"u{i}" for i in perm]
+        out = reindex_entity_params(
+            ck, {"per-user": new_keys, "fact": new_keys}
+        )
+        for row, old in enumerate(perm):
+            np.testing.assert_array_equal(
+                out["per-user"][row], params["per-user"][old]
+            )
+            np.testing.assert_array_equal(
+                out["fact"].gamma[row], params["fact"].gamma[old]
+            )
+        # replicated leaves pass through untouched
+        np.testing.assert_array_equal(out["fixed"], params["fixed"])
+        np.testing.assert_array_equal(
+            out["fact"].projection, params["fact"].projection
+        )
+
+    def test_reindex_new_and_dropped_entities(self, tmp_path, rng):
+        params = {"re": rng.normal(size=(4, 2))}
+        save_checkpoint_sharded(
+            str(tmp_path), 1, params, np.zeros(2, np.uint32),
+            entity_keys={"re": ["a", "b", "c", "d"]}, num_shards=2,
+        )
+        ck = latest_checkpoint(str(tmp_path))
+        # "b" dropped; "e" is new (zero-initialized, never positional)
+        out = reindex_entity_params(ck, {"re": ["d", "a", "e", "c"]})
+        np.testing.assert_array_equal(out["re"][0], params["re"][3])
+        np.testing.assert_array_equal(out["re"][1], params["re"][0])
+        np.testing.assert_array_equal(out["re"][2], np.zeros(2))
+        np.testing.assert_array_equal(out["re"][3], params["re"][2])
+
+    def test_identical_order_is_passthrough(self, tmp_path, rng):
+        params = {"re": rng.normal(size=(3, 2))}
+        save_checkpoint_sharded(
+            str(tmp_path), 1, params, np.zeros(2, np.uint32),
+            entity_keys={"re": ["x", "y", "z"]}, num_shards=3,
+        )
+        ck = latest_checkpoint(str(tmp_path))
+        out = reindex_entity_params(ck, {"re": ["x", "y", "z"]})
+        assert out["re"] is ck.params["re"]  # no copy on the resume path
+
+
+class TestCollectiveWatchdog:
+    def test_no_watchdog_is_passthrough(self):
+        assert multihost.collective_resilience().timeout_s is None
+        np.testing.assert_array_equal(
+            multihost.allgather_host(np.arange(5)), np.arange(5)
+        )
+
+    def test_stall_times_out_retries_and_recovers(self, watchdog):
+        from photon_ml_tpu import obs
+
+        reg = obs.registry()
+        before = reg.counter("collective.stalls").value
+        t0 = time.perf_counter()
+        with inject(
+            FaultSpec("collective.stall", "delay", nth=1, delay=2.0)
+        ):
+            out = multihost.allgather_host(np.arange(6))
+        wall = time.perf_counter() - t0
+        np.testing.assert_array_equal(out, np.arange(6))
+        assert wall < 1.9, f"watchdog waited out the stall ({wall:.2f}s)"
+        assert reg.counter("collective.stalls").value - before >= 1
+
+    def test_peer_death_retries_through_backoff(self, watchdog):
+        with inject(FaultSpec("collective.allreduce", "raise", nth=1)):
+            out = multihost.allgather_host(np.arange(3))
+        np.testing.assert_array_equal(out, np.arange(3))
+
+    def test_exhausted_budget_is_host_loss(self, watchdog):
+        with inject(
+            FaultSpec(
+                "collective.stall", "delay", nth=1, count=-1, delay=0.4
+            )
+        ):
+            with pytest.raises(RetryBudgetExceeded) as ei:
+                multihost.allgather_host(np.arange(2))
+        assert isinstance(ei.value.__cause__, multihost.CollectiveTimeout)
+        assert isinstance(ei.value.__cause__, OSError)
+        assert is_host_loss(ei.value)
+
+    def test_stall_event_carries_straggler_attribution(self, watchdog):
+        from photon_ml_tpu import obs
+
+        mon = HeartbeatMonitor(
+            interval_s=0.01, miss_intervals=1e6,
+            transport=InProcessHeartbeats(3),
+            process_index=0, process_count=3,
+        )
+        mon.poll_once()
+        prev = install_monitor(mon)
+        try:
+            with inject(
+                FaultSpec("collective.stall", "delay", nth=1, delay=2.0)
+            ):
+                multihost.allgather_host(np.arange(2))
+            g = obs.registry().gauge("pod.heartbeat.slowest_host")
+            assert g.value in (1, 2)
+        finally:
+            install_monitor(prev)
+
+    def test_configure_validates(self):
+        with pytest.raises(ValueError):
+            multihost.configure_collective_resilience(timeout_s=-1.0)
+        with pytest.raises(ValueError):
+            multihost.configure_collective_resilience(retries=-1)
+
+
+class TestHeartbeatMonitor:
+    def test_silent_peer_declared_lost_and_latched(self):
+        mon = HeartbeatMonitor(
+            interval_s=1e-3, miss_intervals=1.0,
+            transport=InProcessHeartbeats(2),
+            process_index=0, process_count=2,
+        )
+        mon.poll_once()
+        assert mon.lost_peers() == []
+        time.sleep(0.01)
+        with inject(
+            FaultSpec("heartbeat.miss", "raise", nth=1, count=-1, key="1")
+        ):
+            time.sleep(0.01)
+            mon.poll_once()
+        assert mon.lost_peers() == [1]
+        with pytest.raises(HostLossDetected) as ei:
+            mon.check()
+        assert ei.value.peers == [1]
+        # a zombie beat after detection must NOT resurrect the peer
+        mon.poll_once()
+        assert mon.lost_peers() == [1]
+
+    def test_background_thread_detects_without_boundary_polls(self):
+        mon = HeartbeatMonitor(
+            interval_s=5e-3, miss_intervals=2.0,
+            transport=InProcessHeartbeats(2),
+            process_index=0, process_count=2,
+        )
+        with inject(
+            FaultSpec("heartbeat.miss", "raise", nth=1, count=-1, key="1")
+        ):
+            with mon:
+                deadline = time.time() + 5.0
+                while not mon.lost_peers() and time.time() < deadline:
+                    time.sleep(5e-3)
+        assert mon.lost_peers() == [1]
+
+    def test_gauges_and_slowest(self):
+        from photon_ml_tpu import obs
+
+        mon = HeartbeatMonitor(
+            interval_s=0.01, miss_intervals=1e6,
+            transport=InProcessHeartbeats(3),
+            process_index=0, process_count=3,
+        )
+        mon.poll_once()
+        reg = obs.registry()
+        assert reg.gauge("pod.heartbeat.age_s.h1") is not None
+        slow = mon.slowest()
+        assert slow is not None and slow[0] in (1, 2)
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            HeartbeatMonitor(interval_s=0.0)
+        with pytest.raises(ValueError):
+            HeartbeatMonitor(interval_s=1.0, miss_intervals=0.0)
+
+    def test_install_current_roundtrip(self):
+        mon = HeartbeatMonitor(
+            interval_s=1.0, transport=InProcessHeartbeats(1),
+            process_index=0, process_count=1,
+        )
+        prev = install_monitor(mon)
+        try:
+            assert current_monitor() is mon
+        finally:
+            install_monitor(prev)
+
+
+class TestHostLossRecoveryE2E:
+    """The acceptance drill: kill -> final shard set -> distinct exit ->
+    shrunk restart == uninterrupted run (also scripted as the chaos-lab
+    ``host_loss_recovery`` drill; duplicated here so tier-1 carries the
+    invariant directly)."""
+
+    def test_kill_checkpoint_resume_smaller_world(self, tmp_path):
+        from photon_ml_tpu.resilience.drills import _tiny_game
+
+        ekeys = {"per-user": _keys(4, "user")}
+        model_a, _ = _tiny_game(np.random.default_rng(41)).run(
+            num_iterations=3, seed=3,
+            checkpoint_dir=str(tmp_path / "a"), checkpoint_every=1,
+            sharded_checkpoints=2, entity_keys=ekeys,
+        )
+        mon = HeartbeatMonitor(
+            interval_s=1e-4, miss_intervals=1.0,
+            transport=InProcessHeartbeats(2),
+            process_index=0, process_count=2,
+        )
+        ckdir = str(tmp_path / "b")
+        with inject(
+            FaultSpec("heartbeat.miss", "raise", nth=2, count=-1, key="1")
+        ):
+            with pytest.raises(HostLossDetected):
+                _tiny_game(np.random.default_rng(41)).run(
+                    num_iterations=3, seed=3,
+                    checkpoint_dir=ckdir, checkpoint_every=1,
+                    sharded_checkpoints=2, entity_keys=ekeys,
+                    heartbeat=mon,
+                )
+        marker = read_host_loss_marker(ckdir)
+        assert marker is not None
+        assert marker["peers"] == [1]
+        assert marker["exit_code"] == HOST_LOSS_EXIT_CODE
+        ck = latest_checkpoint(ckdir)
+        assert ck is not None and ck.shards == 2
+        assert ck.step == marker["step"] >= 1
+        # restart at world size 1 reproduces the uninterrupted run
+        model_b, _ = _tiny_game(np.random.default_rng(41)).run(
+            num_iterations=3, seed=3,
+            checkpoint_dir=ckdir, checkpoint_every=1,
+            sharded_checkpoints=1, entity_keys=ekeys, resume=True,
+        )
+        for name in model_a.params:
+            np.testing.assert_allclose(
+                np.asarray(model_b.params[name]),
+                np.asarray(model_a.params[name]),
+                rtol=0, atol=1e-10, err_msg=name,
+            )
+
+    def test_exit_code_is_distinct(self):
+        assert HOST_LOSS_EXIT_CODE not in (0, 1, 2, 3)
+        assert is_host_loss(HostLossDetected([1]))
+        assert not is_host_loss(ValueError("boom"))
+
+
+class TestFactoredShardedRoundTrip:
+    """ROADMAP coverage-audit satellite: factored random effects survive
+    the sharded format — gamma entity-sharded + re-keyed, projection
+    replicated — through an actual training checkpoint/resume."""
+
+    def test_factored_training_sharded_resume(self, tmp_path, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.core.tasks import TaskType
+        from photon_ml_tpu.game import (
+            CoordinateConfig,
+            CoordinateDescent,
+            FactoredConfig,
+            FactoredRandomEffectCoordinate,
+            GameData,
+            build_random_effect_design,
+        )
+        from photon_ml_tpu.models.training import OptimizerType
+
+        n_users, rows, d = 6, 25, 4
+        user = np.repeat(np.arange(n_users), rows)
+        x = rng.normal(size=(n_users * rows, d))
+        y = (rng.uniform(size=user.size) < 0.5).astype(float)
+        data = GameData.create(
+            features={"s": x}, labels=y, entity_ids={"u": user}
+        )
+        design = build_random_effect_design(
+            data, "u", "s", n_users, dtype=jnp.float64
+        )
+
+        def make_cd():
+            coord = FactoredRandomEffectCoordinate(
+                design=design,
+                row_features=jnp.asarray(x),
+                row_entities=jnp.asarray(user, jnp.int32),
+                full_offsets_base=jnp.zeros(user.size),
+                re_config=CoordinateConfig(
+                    shard="s",
+                    task=TaskType.LOGISTIC_REGRESSION,
+                    optimizer=OptimizerType.LBFGS,
+                    reg_weight=1.0,
+                    max_iters=8,
+                    tolerance=1e-8,
+                    random_effect="u",
+                ),
+                factored=FactoredConfig(latent_dim=2),
+            )
+            return CoordinateDescent(
+                coordinates={"fact": coord},
+                labels=jnp.asarray(y),
+                base_offsets=jnp.zeros(user.size),
+                weights=jnp.ones(user.size),
+                task=TaskType.LOGISTIC_REGRESSION,
+            )
+
+        ekeys = {"fact": _keys(n_users)}
+        ckpt = str(tmp_path / "fck")
+        make_cd().run(
+            num_iterations=1, checkpoint_dir=ckpt, checkpoint_every=1,
+            sharded_checkpoints=3, entity_keys=ekeys,
+        )
+        ck = latest_checkpoint(ckpt)
+        assert ck.shards == 3
+        assert hasattr(ck.params["fact"], "gamma")
+        resumed, _ = make_cd().run(
+            num_iterations=2, checkpoint_dir=ckpt, checkpoint_every=1,
+            sharded_checkpoints=2,  # different world size on resume
+            entity_keys=ekeys, resume=True,
+        )
+        straight, _ = make_cd().run(num_iterations=2)
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params["fact"].gamma),
+            np.asarray(straight.params["fact"].gamma),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params["fact"].projection),
+            np.asarray(straight.params["fact"].projection),
+        )
+
+
+class TestDriverKnobs:
+    def test_game_config_validates_pod_knobs(self):
+        from photon_ml_tpu.cli.config import (
+            CoordinateSpec,
+            GameDriverParams,
+        )
+
+        def make(**kw):
+            return GameDriverParams(
+                train_input=["x"], output_dir="o",
+                coordinates={"g": CoordinateSpec(shard="s")},
+                updating_sequence=["g"], **kw,
+            )
+
+        make(heartbeat_s=5.0, collective_timeout_s=30.0,
+             sharded_ckpt=True).validate()
+        with pytest.raises(ValueError, match="heartbeat_s"):
+            make(heartbeat_s=-1.0).validate()
+        with pytest.raises(ValueError, match="collective_timeout_s"):
+            make(collective_timeout_s=0.0).validate()
+
+    def test_glm_config_validates_pod_knobs(self):
+        from photon_ml_tpu.cli.config import GLMDriverParams
+
+        def make(**kw):
+            return GLMDriverParams(
+                train_input=["x"], output_dir="o", **kw
+            )
+
+        make(heartbeat_s=5.0, collective_timeout_s=30.0).validate()
+        with pytest.raises(ValueError, match="heartbeat_s"):
+            make(heartbeat_s=-0.5).validate()
+        with pytest.raises(ValueError, match="collective_timeout_s"):
+            make(collective_timeout_s=-3.0).validate()
+
+    def test_multiprocess_gate_requires_sharded_ckpt(self):
+        from photon_ml_tpu.cli.config import (
+            CoordinateSpec,
+            GameDriverParams,
+        )
+        from photon_ml_tpu.cli.game_train import (
+            _validate_multiprocess_params,
+        )
+
+        base = dict(
+            train_input=["x"], output_dir="o",
+            coordinates={"g": CoordinateSpec(shard="s")},
+            updating_sequence=["g"],
+        )
+        with pytest.raises(ValueError, match="sharded_ckpt"):
+            _validate_multiprocess_params(
+                GameDriverParams(**base, checkpoint_every=1)
+            )
+        # sharded checkpoints lift the PR-4-era pod checkpoint ban
+        _validate_multiprocess_params(
+            GameDriverParams(
+                **base, checkpoint_every=1, sharded_ckpt=True
+            )
+        )
+
+    def test_cli_flags_reach_params(self):
+        from photon_ml_tpu.cli.train import build_arg_parser
+
+        args = build_arg_parser().parse_args(
+            [
+                "--train-input", "x", "--output-dir", "o",
+                "--heartbeat-s", "2.5", "--collective-timeout-s", "60",
+                "--sharded-ckpt",
+            ]
+        )
+        assert args.heartbeat_s == 2.5
+        assert args.collective_timeout_s == 60.0
+        assert args.sharded_ckpt is True
+
+
+class TestMultihostSmokeSchedule:
+    def test_multihost_drills_registered(self):
+        from photon_ml_tpu.resilience.drills import DRILLS, MULTIHOST_DRILLS
+
+        assert set(MULTIHOST_DRILLS) <= set(DRILLS)
+        assert "host_loss_recovery" in MULTIHOST_DRILLS
+        assert "torn_shard" in MULTIHOST_DRILLS
+
+    def test_new_fault_sites_armable(self):
+        for site in (
+            "collective.stall", "heartbeat.miss", "checkpoint.shard_write"
+        ):
+            with inject(FaultSpec(site, "delay", nth=10**9, delay=0.0)):
+                pass
+
+    def test_collective_allreduce_seam_still_fires(self):
+        with inject(FaultSpec("collective.allreduce", "raise", nth=1)):
+            with pytest.raises(InjectedFault):
+                multihost.allgather_host(np.arange(4))

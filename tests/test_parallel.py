@@ -24,14 +24,21 @@ from photon_ml_tpu.parallel import (
 # 0.4.x CPU backend has no multiprocess collectives implementation
 # ("Multiprocess computations aren't implemented on the CPU backend";
 # the gloo option exists but deadlocks), so they can only run on newer
-# jax lines — skip fast instead of failing (or hanging) tier-1
+# jax lines — skip fast instead of failing (or hanging) tier-1. The
+# single-process emulation drills in tests/test_multihost_resilience.py
+# (armed collective.allreduce / collective.stall / heartbeat.miss
+# faults) keep the recovery paths exercised on CPU regardless.
 _JAX_VERSION = tuple(
     int(p) for p in jax.__version__.split(".")[:3] if p.isdigit()
 )
 two_process = pytest.mark.skipif(
     _JAX_VERSION < (0, 5),
     reason="CPU multiprocess collectives unsupported on jax "
-    f"{jax.__version__} (< 0.5)",
+    f"{jax.__version__} (< 0.5): the CPU backend has no multiprocess "
+    "collectives implementation and the gloo cross-host transport "
+    "DEADLOCKS in process_allgather, which would hang tier-1 rather "
+    "than fail it; single-process fault-site emulation covers the "
+    "recovery paths instead",
 )
 
 
