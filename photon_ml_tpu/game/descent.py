@@ -1044,11 +1044,56 @@ class CoordinateDescent:
                 (time.perf_counter() - t0) * 1e3,
             )
 
+        def _save_final_shards(step: int) -> None:
+            """The pod survivors' final save — collective-free by
+            contract: the normal sharded writer exchanges digests and
+            barriers over the FULL world, which includes the peer just
+            declared dead (it would hang forever without a watchdog, or
+            exhaust its retries with one). One elected survivor writes
+            the complete quorum step instead
+            (``save_checkpoint_sharded_final``). The pending device
+            stats are NOT materialized here — their drain may need a
+            device collective (reshard of non-addressable trackers) the
+            dead peer can no longer complete, and history is replay
+            metadata, not math state."""
+            from photon_ml_tpu.io.checkpoint import (
+                save_checkpoint_sharded_final,
+            )
+
+            params_host = {
+                n: jax.tree_util.tree_map(
+                    lambda a: np.asarray(a), model.params[n]
+                )
+                for n in names
+            }
+            ckpt_writer.join()
+            save_checkpoint_sharded_final(
+                checkpoint_dir,
+                step,
+                params_host,
+                np.asarray(key),
+                history=[dataclasses.asdict(h) for h in history],
+                frozen=sorted(frozen),
+                entity_keys=(
+                    {
+                        n: [str(k) for k in v]
+                        for n, v in entity_keys.items()
+                    }
+                    if entity_keys
+                    else None
+                ),
+                num_shards=jax.process_count(),
+            )
+
         def _host_loss_boundary(step: int, saved: bool) -> None:
             """Pass-boundary heartbeat poll: on a detected peer loss the
             SURVIVORS' contract runs here — final durable checkpoint at
-            this boundary, host-loss marker, then surface the exception
-            for the driver's distinct-exit-code mapping."""
+            this boundary (collective-free on a pod: the dead peer can
+            no longer complete an exchange), host-loss marker, then
+            surface the exception for the driver's distinct-exit-code
+            mapping. The marker is written even when the final save
+            FAILS — the restart then resumes from the newest complete
+            quorum step instead."""
             if heartbeat is None:
                 return
             try:
@@ -1062,12 +1107,29 @@ class CoordinateDescent:
                 if not isinstance(e, HostLossDetected):
                     raise
                 if checkpoint_dir is not None:
-                    if not saved:
-                        _save_ckpt(step, wait=True)
-                    else:
-                        ckpt_writer.join()
+                    final_ok = True
+                    try:
+                        if saved:
+                            # this boundary's cadence checkpoint already
+                            # landed (all peers alive at that point)
+                            ckpt_writer.join()
+                        elif (
+                            jax.process_count() > 1 and sharded_checkpoints
+                        ):
+                            _save_final_shards(step)
+                        else:
+                            _save_ckpt(step, wait=True)
+                    except Exception as save_err:  # noqa: BLE001
+                        final_ok = False
+                        obs.emit_event(
+                            "resilience.host_loss_save_failed",
+                            cat="resilience",
+                            iteration=step,
+                            error=repr(save_err),
+                        )
                     write_host_loss_marker(
-                        checkpoint_dir, step, e.peers, reason=e.reason
+                        checkpoint_dir, step, e.peers, reason=e.reason,
+                        final_checkpoint=final_ok,
                     )
                 obs.emit_event(
                     "resilience.host_loss",

@@ -112,22 +112,33 @@ def sha256_file(path: str) -> str:
 _sha256 = sha256_file
 
 
-def _prune_leftovers(directory: str, keep_name: Optional[str] = None) -> None:
-    """Remove ``*.tmp`` / ``*.old`` / ``*.shards`` debris from prior
-    crashes. A ``.tmp``/``.shards`` is an unfinished write (never valid);
-    a ``.old`` is a superseded step whose replacement already swapped in
-    (delete was interrupted). ``keep_name`` protects the CURRENT save's
-    staging dir — on a pod, peer processes may already be writing their
-    shards into it when this process starts its own save."""
+def _prune_leftovers(directory: str, keep=()) -> None:
+    """Remove ``*.tmp`` / ``*.old`` / ``*.shards`` / ``*.publisher``
+    debris from prior crashes. A ``.tmp``/``.shards`` is an unfinished
+    write (never valid); a ``.old`` is a superseded step whose
+    replacement already swapped in (delete was interrupted); a
+    ``.publisher`` is the election claim of a host-loss final save
+    whose publisher died mid-write. ``keep`` protects the CURRENT
+    save's staging dir — on a pod, peer processes may already be
+    writing their shards into it when this process starts its own
+    save."""
+    if isinstance(keep, str):
+        keep = (keep,)
     for name in os.listdir(directory):
-        if name == keep_name:
+        if name in keep or not name.startswith(_STEP_PREFIX):
             continue
-        if name.startswith(_STEP_PREFIX) and (
+        path = os.path.join(directory, name)
+        if name.endswith(".publisher"):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        elif (
             name.endswith(".tmp")
             or name.endswith(".old")
             or name.endswith(".shards")
         ):
-            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+            shutil.rmtree(path, ignore_errors=True)
 
 
 def save_checkpoint(
@@ -237,9 +248,7 @@ def save_checkpoint(
         "io.checkpoint.save_ms", (time.perf_counter() - t0) * 1e3
     )
     # prune all but the newest `keep` steps
-    steps = sorted(_list_steps(directory))
-    for old_step in steps[:-keep]:
-        shutil.rmtree(os.path.join(directory, f"{_STEP_PREFIX}{old_step}"))
+    _prune_old_steps(directory, keep)
     return final
 
 
@@ -456,6 +465,136 @@ def _swap_in_step(staging: str, final: str) -> None:
         shutil.rmtree(old)
 
 
+def _validated_entity_keys(
+    params: Dict[str, object], entity_keys
+) -> Dict[str, List[str]]:
+    """Validate coordinate names and the entity-key labelling BEFORE any
+    filesystem mutation; returns the stringified key lists for the
+    params they label."""
+    for name in params:
+        if "#" in name:
+            raise ValueError(
+                f"coordinate name {name!r} contains '#' (reserved for the "
+                "checkpoint leaf encoding)"
+            )
+    ekeys: Dict[str, List[str]] = {}
+    for name, keys in (entity_keys or {}).items():
+        if name not in params:
+            continue
+        table = params[name]
+        n_rows = (
+            np.asarray(table.gamma).shape[0]
+            if hasattr(table, "gamma")
+            else np.asarray(table).shape[0]
+        )
+        if len(keys) != n_rows:
+            raise ValueError(
+                f"coordinate {name!r}: {len(keys)} entity keys for "
+                f"{n_rows} table rows — the keys must label every row"
+            )
+        ekeys[name] = [str(k) for k in keys]
+    return ekeys
+
+
+def _quorum_manifest_dict(
+    *,
+    step: int,
+    num_shards: int,
+    rng_key,
+    params: Dict[str, object],
+    ekeys: Dict[str, List[str]],
+    history,
+    frozen,
+    digests: Dict[str, str],
+) -> dict:
+    from photon_ml_tpu.game.factored import is_factored_params
+
+    return {
+        "format": "sharded",
+        "step": step,
+        "shards": num_shards,
+        "rng_key": np.asarray(rng_key).tolist(),
+        "param_names": sorted(params),
+        "param_kinds": {
+            n: "factored" if is_factored_params(p) else "array"
+            for n, p in params.items()
+        },
+        "param_sharding": {
+            n: "entity" if n in ekeys else "replicated" for n in params
+        },
+        "entity_keys": ekeys,
+        "history": history or [],
+        "frozen": sorted(frozen or []),
+        "digests": digests,
+    }
+
+
+def _write_full_shard_set(
+    staging: str,
+    final: str,
+    num_shards: int,
+    step: int,
+    params: Dict[str, object],
+    ekeys: Dict[str, List[str]],
+    manifest_fn,
+    retries: int,
+    logger,
+    label: str,
+) -> None:
+    """Single-writer publish: stage ALL ``num_shards`` shards + the
+    quorum manifest, then atomic swap — one retryable unit restarting
+    from a clean staging dir. Used by the single-process writer and by
+    the collective-free host-loss final save."""
+
+    def _write() -> None:
+        if os.path.exists(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        digests = {}
+        for p in range(num_shards):
+            digests[f"shard-{p}-of-{num_shards}.npz"] = _write_one_shard(
+                staging, p, num_shards, step, params, ekeys
+            )
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
+            json.dump(manifest_fn(digests), f)
+        _swap_in_step(staging, final)
+
+    retry.retry_call(_write, retries=retries, logger=logger, label=label)
+
+
+def _prune_old_steps(directory: str, keep: int) -> None:
+    """Keep only the newest ``keep`` published steps."""
+    steps = sorted(_list_steps(directory))
+    for old_step in steps[:-keep]:
+        shutil.rmtree(os.path.join(directory, f"{_STEP_PREFIX}{old_step}"))
+
+
+def _prune_foreign_shard_files(staging: str, num_shards: int) -> None:
+    """Drop staging files that do not belong to the CURRENT shard set —
+    debris from a crashed earlier attempt (possibly at a different
+    world size) that the pod path's ``exist_ok`` staging reuse would
+    otherwise swap into the published step (loads ignore unlisted
+    files, but the debris persists and inflates
+    ``io.checkpoint.bytes_written``). Runs on process 0 after the
+    digest exchange, when every peer's shard files are already on
+    disk."""
+    expected = {"manifest.json"}
+    for p in range(num_shards):
+        expected.add(f"shard-{p}-of-{num_shards}.npz")
+        expected.add(f"shard-{p}-of-{num_shards}.json")
+    for name in os.listdir(staging):
+        if name in expected:
+            continue
+        path = os.path.join(staging, name)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.remove(path)
+        except OSError:
+            pass  # best-effort: unlisted files are ignored by loads
+
+
 def save_checkpoint_sharded(
     directory: str,
     step: int,
@@ -493,12 +632,6 @@ def save_checkpoint_sharded(
     each attempt rewriting this process's shard files."""
     import jax
 
-    for name in params:
-        if "#" in name:
-            raise ValueError(
-                f"coordinate name {name!r} contains '#' (reserved for the "
-                "checkpoint leaf encoding)"
-            )
     if process_count is None:
         process_count = jax.process_count()
     if process_index is None:
@@ -515,50 +648,18 @@ def save_checkpoint_sharded(
         num_shards = int(num_shards or 1)
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
-    ekeys: Dict[str, List[str]] = {}
-    for name, keys in (entity_keys or {}).items():
-        if name not in params:
-            continue
-        table = params[name]
-        n_rows = (
-            np.asarray(table.gamma).shape[0]
-            if hasattr(table, "gamma")
-            else np.asarray(table).shape[0]
-        )
-        if len(keys) != n_rows:
-            raise ValueError(
-                f"coordinate {name!r}: {len(keys)} entity keys for "
-                f"{n_rows} table rows — the keys must label every row"
-            )
-        ekeys[name] = [str(k) for k in keys]
+    ekeys = _validated_entity_keys(params, entity_keys)
 
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"{_STEP_PREFIX}{step}")
     staging = final + ".shards"
-    from photon_ml_tpu.game.factored import is_factored_params
-
-    param_kinds = {
-        n: "factored" if is_factored_params(p) else "array"
-        for n, p in params.items()
-    }
-    param_sharding = {
-        n: "entity" if n in ekeys else "replicated" for n in params
-    }
 
     def _quorum_manifest(digests: Dict[str, str]) -> dict:
-        return {
-            "format": "sharded",
-            "step": step,
-            "shards": num_shards,
-            "rng_key": np.asarray(rng_key).tolist(),
-            "param_names": sorted(params),
-            "param_kinds": param_kinds,
-            "param_sharding": param_sharding,
-            "entity_keys": ekeys,
-            "history": history or [],
-            "frozen": sorted(frozen or []),
-            "digests": digests,
-        }
+        return _quorum_manifest_dict(
+            step=step, num_shards=num_shards, rng_key=rng_key,
+            params=params, ekeys=ekeys, history=history, frozen=frozen,
+            digests=digests,
+        )
 
     t0 = time.perf_counter()
     with obs.span(
@@ -569,24 +670,9 @@ def save_checkpoint_sharded(
             # single writer: stage everything, publish quorum, swap —
             # one retryable unit restarting from a clean staging dir
             _prune_leftovers(directory)
-
-            def _write() -> None:
-                if os.path.exists(staging):
-                    shutil.rmtree(staging)
-                os.makedirs(staging)
-                digests = {}
-                for p in range(num_shards):
-                    digests[f"shard-{p}-of-{num_shards}.npz"] = (
-                        _write_one_shard(
-                            staging, p, num_shards, step, params, ekeys
-                        )
-                    )
-                with open(os.path.join(staging, "manifest.json"), "w") as f:
-                    json.dump(_quorum_manifest(digests), f)
-                _swap_in_step(staging, final)
-
-            retry.retry_call(
-                _write, retries=retries, logger=logger,
+            _write_full_shard_set(
+                staging, final, num_shards, step, params, ekeys,
+                _quorum_manifest, retries=retries, logger=logger,
                 label=f"sharded checkpoint step {step}",
             )
         else:
@@ -595,7 +681,7 @@ def save_checkpoint_sharded(
             from photon_ml_tpu.parallel import multihost
 
             if process_index == 0:
-                _prune_leftovers(directory, keep_name=os.path.basename(staging))
+                _prune_leftovers(directory, keep=os.path.basename(staging))
             os.makedirs(staging, exist_ok=True)
 
             def _write_mine() -> str:
@@ -617,6 +703,11 @@ def save_checkpoint_sharded(
                     digests[
                         f"shard-{e['shard']}-of-{num_shards}.npz"
                     ] = e["digest"]
+                # the exist_ok staging reuse may have inherited a
+                # crashed attempt's files (even a different world
+                # size's); drop anything outside the current shard set
+                # before it gets swapped into the published step
+                _prune_foreign_shard_files(staging, num_shards)
                 with open(os.path.join(staging, "manifest.json"), "w") as f:
                     json.dump(_quorum_manifest(digests), f)
                 _swap_in_step(staging, final)
@@ -631,12 +722,115 @@ def save_checkpoint_sharded(
         "io.checkpoint.shard_save_ms", (time.perf_counter() - t0) * 1e3
     )
     if process_count == 1 or process_index == 0:
-        steps = sorted(_list_steps(directory))
-        for old_step in steps[:-keep]:
-            shutil.rmtree(
-                os.path.join(directory, f"{_STEP_PREFIX}{old_step}")
-            )
+        _prune_old_steps(directory, keep)
     return final
+
+
+def save_checkpoint_sharded_final(
+    directory: str,
+    step: int,
+    params: Dict[str, object],
+    rng_key,
+    *,
+    history: Optional[List[dict]] = None,
+    frozen: Optional[List[str]] = None,
+    keep: int = 2,
+    entity_keys: Optional[Dict[str, List]] = None,
+    num_shards: Optional[int] = None,
+    process_index: Optional[int] = None,
+    retries: int = 4,
+    logger=None,
+) -> Optional[str]:
+    """Survivors' host-loss final save: a COMPLETE quorum step with NO
+    collectives (docs/MULTIHOST.md).
+
+    The normal pod writer (:func:`save_checkpoint_sharded`) exchanges
+    shard digests over ``allgather_strings`` and ends on an allgather
+    barrier — full-world collectives that include the peer just
+    declared dead, so running it from the host-loss handler would hang
+    forever (no watchdog) or exhaust its retries (watchdog) and the
+    promised final shard set would never land. This writer instead
+    exploits the fact that every process passes the FULL global tables
+    into the save (the pod writer merely slices rows ``p::P`` out of
+    them): any single survivor can produce the whole shard set alone.
+
+    Election: survivors race an ``O_EXCL`` claim file
+    (``step-<k>.publisher``). The winner writes all ``num_shards``
+    shards into a PRIVATE staging dir (``step-<k>.h<i>.shards`` — a
+    concurrently-publishing survivor, e.g. after a crashed claim, can
+    never trample it), publishes the quorum manifest, swaps the step in
+    atomically, prunes old steps, and removes the claim. Losers return
+    None: the step they would have written is already being published.
+    A claim left behind by a publisher that died mid-write is pruned by
+    the next save into the directory, and restore falls back to the
+    newest complete quorum step regardless."""
+    import jax
+
+    if num_shards is None:
+        num_shards = max(jax.process_count(), 1)
+    num_shards = int(num_shards)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if process_index is None:
+        process_index = jax.process_index()
+    ekeys = _validated_entity_keys(params, entity_keys)
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"{_STEP_PREFIX}{step}")
+    if os.path.isdir(final):
+        try:
+            # another survivor already published this boundary (or the
+            # cadence save landed before the loss was detected)
+            verify_checkpoint(directory, step)
+            return final
+        except (CheckpointCorrupted, OSError):
+            pass  # torn step: publish over it via the swap-aside
+    claim = final + ".publisher"
+    try:
+        fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        obs.emit_event(
+            "io.checkpoint.final_save_yielded",
+            cat="io", step=step, process=int(process_index),
+        )
+        return None
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(str(int(process_index)))
+        staging = f"{final}.h{int(process_index)}.shards"
+        t0 = time.perf_counter()
+        with obs.span(
+            "io.checkpoint.save_sharded_final", cat="io", step=step,
+            shards=num_shards, publisher=int(process_index),
+        ):
+            _write_full_shard_set(
+                staging, final, num_shards, step, params, ekeys,
+                lambda digests: _quorum_manifest_dict(
+                    step=step, num_shards=num_shards, rng_key=rng_key,
+                    params=params, ekeys=ekeys, history=history,
+                    frozen=frozen, digests=digests,
+                ),
+                retries=retries, logger=logger,
+                label=f"final sharded checkpoint step {step}",
+            )
+        reg = obs.registry()
+        reg.inc("io.checkpoint.final_saves")
+        reg.inc("io.checkpoint.bytes_written", _dir_bytes(final))
+        reg.observe(
+            "io.checkpoint.shard_save_ms",
+            (time.perf_counter() - t0) * 1e3,
+        )
+        obs.emit_event(
+            "io.checkpoint.final_save_published",
+            cat="io", step=step, shards=num_shards,
+            publisher=int(process_index),
+        )
+        _prune_old_steps(directory, keep)
+        return final
+    finally:
+        try:
+            os.remove(claim)
+        except OSError:
+            pass
 
 
 def _load_sharded_step(

@@ -34,6 +34,7 @@ from photon_ml_tpu.parallel.heartbeat import (
     install_monitor,
 )
 from photon_ml_tpu.parallel.multihost import (
+    CollectiveAbandoned,
     CollectiveResilience,
     CollectiveTimeout,
     allgather_host,
@@ -80,6 +81,7 @@ __all__ = [
     "process_local_paths",
     "process_local_rows",
     "CollectiveResilience",
+    "CollectiveAbandoned",
     "CollectiveTimeout",
     "collective_resilience",
     "configure_collective_resilience",

@@ -179,6 +179,13 @@ class HeartbeatMonitor:
                 )
         self.transport = transport
         self._clock = clock
+        # staleness baseline for peers with NO observed beat yet: ages
+        # measure from monitor start, never from -inf — otherwise any
+        # startup skew (a peer whose first KV publish lands after this
+        # process's first poll) is declared LOST on sight and falsely
+        # aborts the whole run. A peer that never publishes still goes
+        # lost once the threshold elapses from start.
+        self._baseline = clock()
         self._lost: Dict[int, float] = {}  # peer -> age at detection
         self._ages: Dict[int, float] = {}
         self._lock = threading.Lock()
@@ -206,7 +213,7 @@ class HeartbeatMonitor:
             for p in range(self.process_count):
                 if p == self.process_index:
                     continue
-                age = now - beats.get(p, -float("inf"))
+                age = now - beats.get(p, self._baseline)
                 ages[p] = age
                 reg.set_gauge(f"pod.heartbeat.age_s.h{p}", round(age, 4))
                 if age > threshold:

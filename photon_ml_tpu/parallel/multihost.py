@@ -58,7 +58,13 @@ _INITIALIZED = False
 # stall (``collective.stalls`` counter, ``collective.stall_ms``
 # histogram, a ``collective.stall`` event with straggler attribution
 # from the heartbeat monitor when one is installed), and retries through
-# the resilience backoff seam. A stall that survives the retry budget
+# the resilience backoff seam. On a REAL POD the retry is gated on the
+# abandoned attempt having terminated: an orphan still in flight could
+# be matched by peers against a reissued exchange, desyncing collective
+# issue-order across processes — so a live orphan escalates as
+# CollectiveAbandoned (fatal, straight to the host-loss contract)
+# instead of retrying, and an orphan that completed late has its result
+# consumed rather than reissued. A stall that survives the retry budget
 # surfaces as RetryBudgetExceeded whose cause is CollectiveTimeout —
 # which the drivers map to the host-loss exit contract
 # (resilience.hostloss) instead of hanging until the scheduler's
@@ -79,6 +85,28 @@ class CollectiveTimeout(OSError):
         self.label = label
         self.timeout_s = timeout_s
         self.attempt = attempt
+
+
+class CollectiveAbandoned(RuntimeError):
+    """A watchdog-abandoned collective attempt was STILL in flight when
+    the retry came due on a real pod. Reissuing the exchange while the
+    orphaned attempt may yet match a peer's collective would desync
+    issue-order across processes (peers could pair the orphan with this
+    process's new exchange — mismatched data or a permanent wedge), so
+    instead of retrying this escalates straight to the host-loss
+    contract (``resilience.is_host_loss`` recognizes it). Deliberately
+    NOT an ``OSError``: the retry seam must not classify it as
+    transient."""
+
+    def __init__(self, label: str, waited_s: float):
+        super().__init__(
+            f"collective {label!r} abandoned: a timed-out attempt was "
+            f"still in flight {waited_s:.3g}s after issue — reissuing "
+            "would desync collective order across processes; escalating "
+            "to the host-loss contract"
+        )
+        self.label = label
+        self.waited_s = waited_s
 
 
 @dataclasses.dataclass
@@ -168,9 +196,46 @@ def _resilient_exchange(label: str, fn: Callable):
     from photon_ml_tpu.resilience import retry as _retry
 
     attempts = [0]
+    # the last abandoned attempt: (thread, result cell, error cell,
+    # issue time). Multi-process, a retry must not reissue the exchange
+    # while this may still be in flight — peers could match the orphan
+    # against the new issue and every host's collective stream desyncs.
+    orphan: list = [None]
 
     def deadline_attempt():
         attempts[0] += 1
+        prev = orphan[0]
+        if prev is not None:
+            orphan[0] = None
+            p_thread, p_result, p_error, p_t0 = prev
+            if jax.process_count() > 1:
+                # gate the reissue on the orphan terminating: give the
+                # straggler one more deadline to arrive
+                p_thread.join(cfg.timeout_s)
+                if p_thread.is_alive():
+                    waited = time.perf_counter() - p_t0
+                    from photon_ml_tpu import obs
+
+                    obs.registry().inc("collective.abandoned")
+                    obs.emit_event(
+                        "collective.abandoned",
+                        cat="collective",
+                        label=label,
+                        waited_s=round(waited, 4),
+                        attempt=attempts[0],
+                    )
+                    raise CollectiveAbandoned(label, waited)
+                if p_result:
+                    # the straggler arrived after all: the exchange
+                    # COMPLETED with this process's contribution, so
+                    # consuming its result (instead of issuing a fresh
+                    # exchange) keeps every host's stream aligned
+                    return p_result[0]
+                # orphan failed cleanly — nothing of this attempt is in
+                # flight any more; a fresh issue is safe (fall through)
+            # single-process emulation: there is no cross-process stream
+            # to desync — drills keep the abandon-and-retry shape
+
         result: list = []
         error: list = []
 
@@ -188,8 +253,10 @@ def _resilient_exchange(label: str, fn: Callable):
         t.join(cfg.timeout_s)
         if t.is_alive():
             # the attempt is ABANDONED (a hung exchange has no cancel);
-            # the orphan thread's eventual result is discarded
+            # whether its eventual result may be used is decided at the
+            # top of the NEXT attempt (pod: only if it terminated)
             _note_stall(label, time.perf_counter() - t0, attempts[0])
+            orphan[0] = (t, result, error, t0)
             raise CollectiveTimeout(label, cfg.timeout_s, attempts[0])
         if error:
             raise error[0]

@@ -57,14 +57,25 @@ def is_host_loss(exc: BaseException) -> bool:
     a :class:`HostLossDetected`, or a collective failure whose cause
     chain bottoms out in one (retry wrappers re-raise with the original
     as ``__cause__``)."""
+    # import the real classes lazily (keeps resilience free of a
+    # parallel dependency at import time) and match by isinstance — a
+    # name match would misclassify an unrelated library's
+    # "CollectiveTimeout" as host loss and trigger the restart-me exit
+    try:
+        from photon_ml_tpu.parallel.multihost import (
+            CollectiveAbandoned,
+            CollectiveTimeout,
+        )
+
+        collective_types: tuple = (CollectiveTimeout, CollectiveAbandoned)
+    except ImportError:
+        collective_types = ()
     seen = set()
     while exc is not None and id(exc) not in seen:
         seen.add(id(exc))
         if isinstance(exc, HostLossDetected):
             return True
-        # CollectiveTimeout subclasses OSError; import lazily to keep
-        # resilience free of a parallel dependency at import time
-        if type(exc).__name__ == "CollectiveTimeout":
+        if collective_types and isinstance(exc, collective_types):
             return True
         exc = exc.__cause__ or exc.__context__
     return False
@@ -75,11 +86,14 @@ def write_host_loss_marker(
     step: int,
     peers: Sequence[int],
     reason: str = "heartbeat",
+    final_checkpoint: bool = True,
 ) -> str:
-    """Record that the run exited on host loss but left a restorable
-    final checkpoint at ``step``. Advisory, like ``preempted.json`` —
-    resume works off the checkpoints alone — but tells operators and
-    restart tooling WHY the run ended and which peers were lost."""
+    """Record that the run exited on host loss. Advisory, like
+    ``preempted.json`` — resume works off the checkpoints alone — but
+    tells operators and restart tooling WHY the run ended and which
+    peers were lost. ``final_checkpoint`` False records that the
+    survivors' final save FAILED (the marker is still written — resume
+    then falls back to the newest complete quorum step)."""
     os.makedirs(checkpoint_dir, exist_ok=True)
     path = os.path.join(checkpoint_dir, HOST_LOSS_MARKER)
     with open(path, "w") as f:
@@ -89,6 +103,7 @@ def write_host_loss_marker(
                 "peers": sorted(int(p) for p in peers),
                 "reason": reason,
                 "exit_code": HOST_LOSS_EXIT_CODE,
+                "final_checkpoint": bool(final_checkpoint),
             },
             f,
         )

@@ -8,6 +8,7 @@ run real two-process collectives; see tests/test_parallel.py)."""
 
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -19,6 +20,7 @@ from photon_ml_tpu.io.checkpoint import (
     reindex_entity_params,
     save_checkpoint,
     save_checkpoint_sharded,
+    save_checkpoint_sharded_final,
     verify_checkpoint,
 )
 from photon_ml_tpu.parallel import multihost
@@ -218,6 +220,55 @@ class TestShardedCheckpointStore:
                 np.zeros(2, np.uint32),
             )
 
+    def test_pod_publish_drops_stale_staging_debris(
+        self, tmp_path, rng, monkeypatch
+    ):
+        """A crashed earlier attempt leaves shard files in the staging
+        dir (possibly at a different world size); the pod path's
+        exist_ok staging reuse must not swap that debris into the
+        published step."""
+        import jax
+
+        from photon_ml_tpu.io import checkpoint as ckpt_mod
+
+        params = _params(rng)
+        ekeys = {"per-user": _keys(7), "fact": _keys(7)}
+        staging = tmp_path / "step-1.shards"
+        staging.mkdir()
+        (staging / "shard-7-of-9.npz").write_bytes(b"stale debris")
+        (staging / "shard-7-of-9.json").write_text("{}")
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost, "allgather_host", lambda x: np.asarray(x)
+        )
+
+        def fake_allgather_strings(strs):
+            # play the peer: write shard 1 into the shared staging dir
+            # and return both digest entries in process order
+            digest1 = ckpt_mod._write_one_shard(
+                str(staging), 1, 2, 1, params, ekeys
+            )
+            return list(strs) + [
+                json.dumps({"shard": 1, "digest": digest1})
+            ]
+
+        monkeypatch.setattr(
+            multihost, "allgather_strings", fake_allgather_strings
+        )
+        path = save_checkpoint_sharded(
+            str(tmp_path), 1, params, np.zeros(2, np.uint32),
+            entity_keys=ekeys,
+        )
+        files = sorted(os.listdir(path))
+        assert "shard-7-of-9.npz" not in files
+        assert "shard-7-of-9.json" not in files
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck.step == 1 and ck.shards == 2
+        np.testing.assert_array_equal(
+            ck.params["per-user"], params["per-user"]
+        )
+
     def test_whole_model_writer_rejects_multiprocess(
         self, tmp_path, rng, monkeypatch
     ):
@@ -238,6 +289,89 @@ class TestShardedCheckpointStore:
                 str(tmp_path), 1, {"w": rng.normal(size=3)},
                 np.zeros(2, np.uint32), num_shards=2, process_index=0,
             )
+
+
+class TestHostLossFinalSave:
+    """The survivors' final save must be COLLECTIVE-FREE: the normal
+    pod writer's digest exchange + barrier include the dead peer, so it
+    would hang (no watchdog) or burn its retries (watchdog) exactly
+    when the final shard set is promised."""
+
+    def test_complete_quorum_step_without_collectives(
+        self, tmp_path, rng, monkeypatch
+    ):
+        def _no_collectives(*a, **k):
+            raise AssertionError(
+                "host-loss final save must not touch host collectives"
+            )
+
+        monkeypatch.setattr(
+            multihost, "allgather_strings", _no_collectives
+        )
+        monkeypatch.setattr(multihost, "allgather_host", _no_collectives)
+        params = _params(rng)
+        ekeys = {"per-user": _keys(7), "fact": _keys(7)}
+        path = save_checkpoint_sharded_final(
+            str(tmp_path), 4, params, np.zeros(2, np.uint32),
+            entity_keys=ekeys, num_shards=3, process_index=1,
+        )
+        assert path is not None
+        # election claim removed after publish
+        assert not (tmp_path / "step-4.publisher").exists()
+        ck = latest_checkpoint(str(tmp_path))
+        assert ck.step == 4 and ck.shards == 3
+        np.testing.assert_array_equal(
+            ck.params["per-user"], params["per-user"]
+        )
+        np.testing.assert_array_equal(
+            ck.params["fact"].gamma, params["fact"].gamma
+        )
+
+    def test_election_yields_to_active_publisher(self, tmp_path, rng):
+        params = _params(rng)
+        claim = tmp_path / "step-2.publisher"
+        claim.write_text("0")
+        out = save_checkpoint_sharded_final(
+            str(tmp_path), 2, params, np.zeros(2, np.uint32),
+            num_shards=2, process_index=1,
+        )
+        assert out is None
+        assert not (tmp_path / "step-2").exists()
+        # the claim holder's file is NOT touched by the loser
+        assert claim.read_text() == "0"
+        claim.unlink()
+        out = save_checkpoint_sharded_final(
+            str(tmp_path), 2, params, np.zeros(2, np.uint32),
+            num_shards=2, process_index=1,
+        )
+        assert out is not None
+        assert latest_checkpoint(str(tmp_path)).step == 2
+
+    def test_already_published_step_is_reused(self, tmp_path, rng):
+        params = _params(rng)
+        ekeys = {"per-user": _keys(7), "fact": _keys(7)}
+        save_checkpoint_sharded(
+            str(tmp_path), 3, params, np.zeros(2, np.uint32),
+            entity_keys=ekeys, num_shards=2,
+        )
+        # cadence save already landed this boundary: reuse, don't rewrite
+        out = save_checkpoint_sharded_final(
+            str(tmp_path), 3, params, np.zeros(2, np.uint32),
+            entity_keys=ekeys, num_shards=4, process_index=0,
+        )
+        assert out is not None
+        assert latest_checkpoint(str(tmp_path)).shards == 2
+
+    def test_stale_publisher_claim_pruned_by_next_save(
+        self, tmp_path, rng
+    ):
+        (tmp_path / "step-9.publisher").write_text("2")
+        save_checkpoint_sharded(
+            str(tmp_path), 1, _params(rng), np.zeros(2, np.uint32),
+            entity_keys={"per-user": _keys(7), "fact": _keys(7)},
+            num_shards=2,
+        )
+        assert not (tmp_path / "step-9.publisher").exists()
 
 
 class TestRestoreWithResharding:
@@ -357,6 +491,66 @@ class TestCollectiveWatchdog:
         with pytest.raises(ValueError):
             multihost.configure_collective_resilience(retries=-1)
 
+    def test_pod_live_orphan_escalates_instead_of_reissue(
+        self, monkeypatch
+    ):
+        """Multi-process, a retry must NOT reissue while the abandoned
+        attempt may still be in flight (peers could match the orphan
+        and every host's collective stream desyncs) — it escalates to
+        the host-loss contract instead."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        release = threading.Event()
+        calls = []
+
+        def wedged():
+            calls.append(1)
+            release.wait(30.0)
+
+        prev = multihost.configure_collective_resilience(
+            timeout_s=0.1, retries=2
+        )
+        try:
+            with pytest.raises(multihost.CollectiveAbandoned) as ei:
+                multihost._resilient_exchange("wedge_test", wedged)
+        finally:
+            release.set()
+            multihost.configure_collective_resilience(
+                prev.timeout_s, prev.retries
+            )
+        assert len(calls) == 1, "the wedged exchange was reissued"
+        assert is_host_loss(ei.value)
+
+    def test_pod_retry_consumes_late_orphan_result(self, monkeypatch):
+        """A straggler that arrives after the deadline COMPLETED the
+        exchange with this process's contribution — its result is
+        consumed instead of issuing a fresh (stream-desyncing)
+        exchange."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        calls = []
+
+        def straggler():
+            calls.append(1)
+            time.sleep(0.35)
+            return "late-but-aligned"
+
+        prev = multihost.configure_collective_resilience(
+            timeout_s=0.2, retries=2
+        )
+        try:
+            out = multihost._resilient_exchange(
+                "straggler_test", straggler
+            )
+        finally:
+            multihost.configure_collective_resilience(
+                prev.timeout_s, prev.retries
+            )
+        assert out == "late-but-aligned"
+        assert len(calls) == 1, "the completed exchange was reissued"
+
 
 class TestHeartbeatMonitor:
     def test_silent_peer_declared_lost_and_latched(self):
@@ -394,6 +588,31 @@ class TestHeartbeatMonitor:
                 deadline = time.time() + 5.0
                 while not mon.lost_peers() and time.time() < deadline:
                     time.sleep(5e-3)
+        assert mon.lost_peers() == [1]
+
+    def test_unpublished_peer_not_instantly_lost(self):
+        """Startup skew: a peer whose first KV beat has not landed yet
+        must age from the MONITOR'S START, not from -inf — otherwise the
+        first poll falsely declares it lost (permanently, since losses
+        latch) and aborts the whole run. A peer that never publishes
+        still goes lost once the threshold elapses from start."""
+
+        class _SilentKV:
+            def publish(self, pid, t):
+                pass
+
+            def read(self, self_pid):
+                return {}  # the peer's key is not in the store yet
+
+        mon = HeartbeatMonitor(
+            interval_s=0.05, miss_intervals=2.0,
+            transport=_SilentKV(), process_index=0, process_count=2,
+        )
+        ages = mon.poll_once()
+        assert np.isfinite(ages[1]) and ages[1] < 1.0
+        assert mon.lost_peers() == []
+        time.sleep(0.12)  # > miss_intervals * interval_s since start
+        mon.poll_once()
         assert mon.lost_peers() == [1]
 
     def test_gauges_and_slowest(self):
@@ -479,10 +698,55 @@ class TestHostLossRecoveryE2E:
                 rtol=0, atol=1e-10, err_msg=name,
             )
 
+    def test_marker_written_even_when_final_save_fails(self, tmp_path):
+        """A final save that exhausts its retries must still leave the
+        host-loss marker (flagged final_checkpoint=False) — the restart
+        then resumes from the newest complete quorum step."""
+        from photon_ml_tpu.resilience.drills import _tiny_game
+
+        ekeys = {"per-user": _keys(4, "user")}
+        mon = HeartbeatMonitor(
+            interval_s=1e-4, miss_intervals=1.0,
+            transport=InProcessHeartbeats(2),
+            process_index=0, process_count=2,
+        )
+        ckdir = str(tmp_path / "c")
+        with inject(
+            FaultSpec("heartbeat.miss", "raise", nth=1, count=-1, key="1"),
+            FaultSpec("checkpoint.shard_write", "raise", nth=1, count=-1),
+        ):
+            with pytest.raises(HostLossDetected):
+                _tiny_game(np.random.default_rng(7)).run(
+                    num_iterations=2, seed=1,
+                    checkpoint_dir=ckdir, checkpoint_every=10,
+                    sharded_checkpoints=2, entity_keys=ekeys,
+                    heartbeat=mon,
+                )
+        marker = read_host_loss_marker(ckdir)
+        assert marker is not None and marker["peers"] == [1]
+        assert marker["final_checkpoint"] is False
+        assert latest_checkpoint(ckdir) is None
+
     def test_exit_code_is_distinct(self):
         assert HOST_LOSS_EXIT_CODE not in (0, 1, 2, 3)
         assert is_host_loss(HostLossDetected([1]))
         assert not is_host_loss(ValueError("boom"))
+
+    def test_host_loss_matches_by_type_not_name(self):
+        """An unrelated library's exception merely NAMED CollectiveTimeout
+        must not trigger the restart-me exit code — classification is
+        isinstance against the real classes."""
+
+        class CollectiveTimeout(OSError):  # foreign same-name type
+            pass
+
+        assert not is_host_loss(CollectiveTimeout("impostor"))
+        assert is_host_loss(multihost.CollectiveTimeout("x", 1.0, 1))
+        assert is_host_loss(multihost.CollectiveAbandoned("x", 1.0))
+        # still recognized through a retry wrapper's cause chain
+        wrapped = RetryBudgetExceeded("x", 3, 1.0)
+        wrapped.__cause__ = multihost.CollectiveAbandoned("x", 2.0)
+        assert is_host_loss(wrapped)
 
 
 class TestFactoredShardedRoundTrip:
