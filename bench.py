@@ -1929,6 +1929,52 @@ def bench_multihost_resilience():
     }
 
 
+def bench_lint():
+    """photon-lint over the full package (docs/ANALYSIS.md). Sentinel-
+    tracked: ``lint_wall_s`` (lower — the gate must stay cheap enough
+    for tier-1 and pre-commit; the acceptance bound is <10s on this
+    box) and ``lint_findings_total`` (lower — finding creep means the
+    ratchet is loosening: new baselined debt or a noisy rule). The
+    zero-NEW-findings invariant itself is asserted here, not just
+    recorded — a bench round must not publish numbers for a tree that
+    fails its own gate."""
+    import os as _os
+
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.analysis import (
+        Analyzer,
+        Baseline,
+        default_baseline_path,
+    )
+
+    root = _os.path.dirname(_os.path.abspath(__file__))
+    package = _os.path.join(root, "photon_ml_tpu")
+    analyzer = Analyzer(base=root)
+    result = analyzer.run([package])
+    new, grandfathered, stale = Baseline.load(
+        default_baseline_path()
+    ).split(result.findings)
+    assert not new, (
+        f"photon-lint: {len(new)} non-baselined findings — fix them "
+        f"before benching: {[f.location() for f in new]}"
+    )
+    reg = obs.registry()
+    reg.set_gauge("lint.wall_s", result.wall_s)
+    reg.set_gauge("lint.findings_total", len(result.findings))
+    log(
+        f"lint: {result.files} files in {result.wall_s:.2f}s, "
+        f"{len(result.findings)} findings ({len(grandfathered)} "
+        f"baselined, {result.suppressed} suppressed, {len(stale)} stale)"
+    )
+    return {
+        "lint_wall_s": round(result.wall_s, 4),
+        "lint_findings_total": len(result.findings),
+        "lint_files": result.files,
+        "lint_suppressed": result.suppressed,
+        "lint_stale_baseline_entries": len(stale),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -2012,6 +2058,7 @@ def main():
     multihost_res = _phase(
         "multihost_resilience", bench_multihost_resilience
     )
+    lint = _phase("lint", bench_lint)
 
     extra = {
         **rtt,
@@ -2144,6 +2191,14 @@ def main():
         # checkpoint write bandwidth + watchdogged collective recovery
         # wall (sentinel: _gbps higher, recovery_s lower)
         extra["multihost_resilience"] = multihost_res
+    if lint:
+        # photon-lint self-hosting gate (docs/ANALYSIS.md): analyzer
+        # wall (sentinel: the generic _s lower-is-better rule) and
+        # total finding count (explicit lint_findings_total rule —
+        # finding creep is ratchet debt, tracked like any regression)
+        extra["lint_wall_s"] = lint["lint_wall_s"]
+        extra["lint_findings_total"] = lint["lint_findings_total"]
+        extra["lint"] = lint
     # where the bench run's own wall clock went + the final metrics
     # registry (solver iteration counters, ingest/checkpoint bytes,
     # recompiles when the compile listener was installed) + the XLA

@@ -1,0 +1,94 @@
+"""photon-lint: a JAX/SPMD-aware static analyzer for this tree.
+
+The reference leaned on scalac's type system to keep a 32k-LoC
+distributed trainer honest; the Python/JAX rebuild catches its worst
+bug classes at runtime (arm-time fault validation, the native-handle
+census, collective watchdogs) — or in review. This package turns each
+class the repo has ACTUALLY shipped into a build-time gate
+(docs/ANALYSIS.md has the full catalog with origin stories):
+
+====== ============================ =========================================
+rule   name                         the bug it generalizes
+====== ============================ =========================================
+PL001  spmd-collective-divergence   PR 11 review: host-loss save ran
+                                    full-world collectives from a handler
+PL002  exception-match-by-name      is_host_loss matched 'CollectiveTimeout'
+                                    by type NAME across libraries
+PL003  unknown-fault-site           pre-PR-10 typo'd drills that tested
+                                    nothing, moved from arm time to lint time
+PL004  trace-unsafe-host-op         host ops inside jit/shard_map/scan/
+                                    while_loop bodies (PR 8/9 lessons)
+PL005  unmanaged-native-handle      PR 9 handle census, static form
+PL006  obs-taxonomy                 dashboard-orphaning metric name typos
+PL007  swallowed-retryable          broad swallows hiding the retry seam
+====== ============================ =========================================
+
+``photon-lint check`` (cli/lint.py) runs the registry over a tree,
+subtracts the committed ratchet baseline
+(``photon_ml_tpu/analysis/baseline.json``), and exits 1 on anything
+new; ``tests/test_analysis.py::test_tree_is_clean`` runs it over
+``photon_ml_tpu/`` in tier-1.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from photon_ml_tpu.analysis.core import (
+    AnalysisResult,
+    Analyzer,
+    Finding,
+    ModuleContext,
+    Rule,
+    iter_py_files,
+)
+from photon_ml_tpu.analysis.baseline import (
+    EMPTY_BASELINE_RULES,
+    Baseline,
+    BaselineEntry,
+    default_baseline_path,
+)
+
+__all__ = [
+    "AnalysisResult",
+    "Analyzer",
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "iter_py_files",
+    "Baseline",
+    "BaselineEntry",
+    "EMPTY_BASELINE_RULES",
+    "default_baseline_path",
+    "default_rules",
+    "rule_catalog",
+]
+
+
+def default_rules() -> List[Rule]:
+    """Fresh instances of the full rule registry (rules carry per-run
+    scan state, so every Analyzer gets its own)."""
+    from photon_ml_tpu.analysis.rules_errors import (
+        ExceptionMatchByName,
+        SwallowedRetryable,
+    )
+    from photon_ml_tpu.analysis.rules_faults import UnknownFaultSiteRule
+    from photon_ml_tpu.analysis.rules_handles import UnmanagedNativeHandle
+    from photon_ml_tpu.analysis.rules_obs import ObsTaxonomyRule
+    from photon_ml_tpu.analysis.rules_spmd import SpmdCollectiveDivergence
+    from photon_ml_tpu.analysis.rules_trace import TraceUnsafeHostOp
+
+    return [
+        SpmdCollectiveDivergence(),
+        ExceptionMatchByName(),
+        UnknownFaultSiteRule(),
+        TraceUnsafeHostOp(),
+        UnmanagedNativeHandle(),
+        ObsTaxonomyRule(),
+        SwallowedRetryable(),
+    ]
+
+
+def rule_catalog() -> List[Rule]:
+    """The registry in id order (for ``photon-lint explain`` and docs)."""
+    return sorted(default_rules(), key=lambda r: r.id)

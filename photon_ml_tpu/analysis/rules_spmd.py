@@ -1,0 +1,169 @@
+"""PL001 spmd-collective-divergence: collectives under per-process
+control flow.
+
+Origin: the PR 11 review's HIGH finding. The host-loss FINAL checkpoint
+ran the normal pod writer — whose digest allgather and completion
+barrier include the peer just declared dead — from the recovery path,
+so the survivors' "final save" hung forever (or burned the whole retry
+budget) and the promised shard set never landed. The general shape: a
+collective is only safe when EVERY process reaches it in the same
+order. Two static contexts break that guarantee:
+
+- an ``except`` handler: only the processes that saw the exception
+  enter it, so a collective there desyncs the collective streams
+  (survivors wait on a peer that never calls);
+- a branch whose condition depends on ``process_index()`` /
+  ``process_id``: by construction different processes take different
+  arms (branching on ``process_count`` is uniform and fine).
+
+One level of the call graph is followed: a local function that
+(directly) calls a collective is itself treated as collective-calling,
+so hiding the allgather one ``def`` down doesn't evade the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    call_name,
+)
+
+__all__ = ["SpmdCollectiveDivergence", "COLLECTIVE_NAMES"]
+
+# the host/device collective seam (docs/MULTIHOST.md): host-blocking
+# exchanges, the pod barrier, traced reductions, and the sharded
+# checkpoint writer (whose digest exchange + completion barrier are
+# full-world collectives — the literal PR-11 bug)
+COLLECTIVE_NAMES = frozenset(
+    {
+        "allgather_host",
+        "allgather_strings",
+        "emit_pod_sync",
+        "psum",
+        "pmean",
+        "process_allgather",
+        "sync_global_devices",
+        "save_checkpoint_sharded",
+    }
+)
+
+_PER_PROCESS_NAMES = frozenset({"process_index", "process_id"})
+
+
+def _test_is_per_process(test: ast.AST) -> bool:
+    """True when a branch condition references process_index/process_id
+    (call, bare name, or attribute) — different processes evaluate it
+    differently."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in _PER_PROCESS_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _PER_PROCESS_NAMES:
+            return True
+    return False
+
+
+class SpmdCollectiveDivergence(Rule):
+    id = "PL001"
+    name = "spmd-collective-divergence"
+    severity = "error"
+    hint = (
+        "hoist the collective out of the divergent arm so every process "
+        "reaches it unconditionally; for recovery paths use a "
+        "collective-free protocol (the save_checkpoint_sharded_final "
+        "single-publisher pattern) or gate on uniform state "
+        "(process_count, a pre-exchanged flag) instead of process_index"
+    )
+    origin = (
+        "PR 11 review (HIGH): the host-loss final save ran the pod "
+        "checkpoint writer — digest allgather + completion barrier "
+        "including the dead peer — from the except-handler recovery "
+        "path; survivors hung forever waiting for a process that would "
+        "never join the exchange. Fixed by the collective-free "
+        "single-publisher final writer. This rule makes the whole class "
+        "(collectives reachable from per-process control flow) a "
+        "build-time error."
+    )
+
+    def __init__(self):
+        # module path -> local function names that call a collective
+        # directly (the one-level call graph)
+        self._collective_fns: Dict[str, Set[str]] = {}
+
+    # -- phase 1: collect local collective-calling functions ------------
+
+    def scan(self, ctx: ModuleContext) -> None:
+        local: Set[str] = set()
+        for call in ctx.walk_calls():
+            last, _ = call_name(call)
+            if last in COLLECTIVE_NAMES:
+                fn = ctx.enclosing_function(call)
+                if fn is not None:
+                    local.add(fn.name)
+        self._collective_fns[ctx.rel_path] = local
+
+    # -- phase 2: flag collectives in divergent contexts ----------------
+
+    def _divergent_context(
+        self, ctx: ModuleContext, node: ast.AST
+    ) -> Optional[Tuple[str, int]]:
+        """(description, line) of the innermost divergent construct the
+        node sits in, or None. Walking up stops at the enclosing
+        function boundary: a collective inside a nested ``def`` is
+        attributed when that function is CALLED, not where it's
+        defined."""
+        for anc, child in ctx.ancestry(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            if isinstance(anc, ast.ExceptHandler):
+                return ("an except handler", anc.lineno)
+            if isinstance(anc, (ast.If, ast.While)):
+                in_branch = child is not anc.test
+                if in_branch and _test_is_per_process(anc.test):
+                    return (
+                        "a process_index()-dependent branch",
+                        anc.lineno,
+                    )
+            if isinstance(anc, ast.IfExp):
+                in_branch = child is not anc.test
+                if in_branch and _test_is_per_process(anc.test):
+                    return (
+                        "a process_index()-dependent conditional "
+                        "expression",
+                        anc.lineno,
+                    )
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        local_collective_fns = self._collective_fns.get(ctx.rel_path, set())
+        for call in ctx.walk_calls():
+            last, full = call_name(call)
+            if last is None:
+                continue
+            via: Optional[str] = None
+            if last in COLLECTIVE_NAMES:
+                what = f"collective {last}()"
+            elif last in local_collective_fns:
+                via = last
+                what = (
+                    f"{last}(), which calls a collective (one call-graph "
+                    "level down)"
+                )
+            else:
+                continue
+            where = self._divergent_context(ctx, call)
+            if where is None:
+                continue
+            desc, ctx_line = where
+            yield self.finding(
+                ctx,
+                call,
+                f"{what} is reached inside {desc} (opened at line "
+                f"{ctx_line}): processes that don't take this arm never "
+                "join the exchange, so the pod's collective streams "
+                "desync or hang",
+            )

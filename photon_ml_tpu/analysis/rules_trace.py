@@ -1,0 +1,289 @@
+"""PL004 trace-unsafe-host-op: host operations inside traced code.
+
+JAX traces ``jit``/``shard_map``/``lax.scan``/``lax.while_loop`` bodies
+ONCE and replays the compiled program; host operations inside them
+either crash on a tracer (``.item()``, ``float()``), silently freeze a
+trace-time value into the compiled program (``time.time()``,
+``np.asarray`` on a constant), or fire once at trace time and never
+again (``print``). This repo has paid the tab repeatedly: the PR 9
+streamed solver had to drop to ``disable_jit`` because a host callback
+dispatched nested jit from the runtime thread; the PR 8 device-resident
+loops only work because every per-pass decision (divergence guard,
+tolerance check) was rebuilt as in-program lax ops rather than host
+reads.
+
+Detection is one level interprocedural: a function passed to a tracing
+transform is traced; local functions it calls (same module) are checked
+too. Functions handed to the SANCTIONED host escapes
+(``jax.pure_callback`` / ``io_callback`` / ``jax.debug.callback`` /
+``jax.debug.print``) are exempt — those run host-side by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from photon_ml_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    call_name,
+    dotted_name,
+)
+
+__all__ = ["TraceUnsafeHostOp"]
+
+# tracing transforms: (last callee name) -> indexes of the traced
+# function arguments
+_TRACING_ARG_INDEXES: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,),
+    "shard_map": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "vmap": (0,),
+    "pmap": (0,),
+}
+
+_TRACING_DECORATORS = frozenset({"jit", "shard_map", "vmap", "pmap"})
+
+# the sanctioned host escapes: functions passed here RUN on host
+_CALLBACK_SINKS = frozenset(
+    {"pure_callback", "io_callback", "callback", "host_callback"}
+)
+
+_TIME_FNS = frozenset(
+    {"time", "perf_counter", "monotonic", "sleep", "process_time"}
+)
+_NUMPY_BASES = frozenset({"np", "numpy", "onp"})
+_JNP_BASES = frozenset({"jnp", "jax.numpy"})
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    """@jit / @jax.jit / @partial(jax.jit, ...) and friends."""
+    if isinstance(dec, ast.Call):
+        last, _ = call_name(dec)
+        if last in _TRACING_DECORATORS:
+            return True
+        if last == "partial" and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner and inner.rsplit(".", 1)[-1] in _TRACING_DECORATORS:
+                return True
+        return False
+    name = dotted_name(dec)
+    return bool(name) and name.rsplit(".", 1)[-1] in _TRACING_DECORATORS
+
+
+class TraceUnsafeHostOp(Rule):
+    id = "PL004"
+    name = "trace-unsafe-host-op"
+    severity = "warning"
+    hint = (
+        "keep the body pure jax: jax.debug.print for prints, "
+        "jax.pure_callback/io_callback for genuine host work, carry "
+        "values in the loop state instead of .item()/float() reads, "
+        "and hoist time/np host ops outside the traced function"
+    )
+    origin = (
+        "The PR 9 streamed solver deadlocked dispatching nested jit "
+        "from a callback thread and had to fall back to disable_jit; "
+        "PR 8's device-resident loops exist because every host read "
+        "inside the solve path (objective checks, guards) forced a "
+        "dispatch round trip. Host ops inside traced bodies either "
+        "crash on tracers, freeze trace-time values into the program, "
+        "or fire once at trace time — none of which the author meant."
+    )
+
+    def _module_functions(
+        self, ctx: ModuleContext
+    ) -> Dict[str, ast.AST]:
+        fns: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, node)
+        return fns
+
+    def _traced_and_exempt(
+        self, ctx: ModuleContext, fns: Dict[str, ast.AST]
+    ) -> Tuple[List[Tuple[ast.AST, str]], Set[str]]:
+        """([(function node, why-traced)], exempt function names)."""
+        traced: List[Tuple[ast.AST, str]] = []
+        traced_ids: Set[int] = set()
+        exempt: Set[str] = set()
+
+        def add(fn_node: ast.AST, why: str) -> None:
+            if id(fn_node) not in traced_ids:
+                traced_ids.add(id(fn_node))
+                traced.append((fn_node, why))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _decorator_traces(dec):
+                        add(node, f"decorated with a tracing transform")
+            if not isinstance(node, ast.Call):
+                continue
+            last, _ = call_name(node)
+            if last in _CALLBACK_SINKS:
+                for arg in node.args:
+                    name = dotted_name(arg)
+                    if name:
+                        exempt.add(name.rsplit(".", 1)[-1])
+                continue
+            indexes = _TRACING_ARG_INDEXES.get(last or "")
+            if not indexes:
+                continue
+            for i in indexes:
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                if isinstance(arg, ast.Lambda):
+                    add(arg, f"passed to {last}()")
+                else:
+                    name = dotted_name(arg)
+                    fn_node = fns.get(name.rsplit(".", 1)[-1]) if name else None
+                    if fn_node is not None:
+                        add(fn_node, f"passed to {last}()")
+        return traced, exempt
+
+    def _params_of(self, fn: ast.AST) -> Set[str]:
+        if isinstance(fn, ast.Lambda):
+            a = fn.args
+        elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+        else:
+            return set()
+        names = set()
+        for group in (a.posonlyargs, a.args, a.kwonlyargs):
+            names.update(p.arg for p in group)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        return names
+
+    def _host_op(
+        self, call: ast.Call, params: Set[str]
+    ) -> Optional[str]:
+        """A description of the host op, or None."""
+        # method-shaped host ops fire on ANY receiver (state[0].item()
+        # has no resolvable dotted name but is exactly the bug)
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "item":
+                return (
+                    ".item() forces a device sync and crashes on tracers"
+                )
+            if call.func.attr == "block_until_ready":
+                return (
+                    ".block_until_ready() forces a host sync inside a "
+                    "trace"
+                )
+        last, full = call_name(call)
+        if last is None:
+            return None
+        base = full.rsplit(".", 1)[0] if full and "." in full else ""
+        if last == "print":
+            return "print() fires at trace time only (use jax.debug.print)"
+        if last == "device_get" or full == "jax.device_get":
+            return "jax.device_get() materializes on host mid-trace"
+        if last in _TIME_FNS and base.rsplit(".", 1)[-1] == "time":
+            return (
+                f"time.{last}() reads the HOST clock at trace time and "
+                "freezes it into the compiled program"
+            )
+        if last in ("asarray", "array") and base.rsplit(".", 1)[-1] in (
+            _NUMPY_BASES
+        ):
+            return (
+                f"{base}.{last}() materializes a host numpy array "
+                "(crashes on tracers; freezes constants otherwise)"
+            )
+        if last in ("float", "int") and len(call.args) == 1:
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and arg.id in params:
+                return (
+                    f"{last}() on a traced-function parameter "
+                    "concretizes a tracer (TracerConversionError at "
+                    "trace time)"
+                )
+            if isinstance(arg, ast.Call):
+                inner = dotted_name(arg.func)
+                if inner and inner.split(".", 1)[0] in ("jnp", "jax"):
+                    return (
+                        f"{last}() on a jax expression concretizes a "
+                        "tracer"
+                    )
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        fns = self._module_functions(ctx)
+        traced, exempt = self._traced_and_exempt(ctx, fns)
+        if not traced:
+            return
+        # one interprocedural level: local functions called from traced
+        # bodies, minus the sanctioned callback sinks' targets
+        seen_fn_ids: Set[int] = {id(fn) for fn, _ in traced}
+        second_level: List[Tuple[ast.AST, str]] = []
+        for fn_node, why in traced:
+            for node in ast.walk(fn_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # follow PLAIN-name calls only: resolving `obj.method()`
+                # by last name would bind to any same-named method of
+                # any class in the module (observed false positive:
+                # a jitted chunk pass's obj.hessian_diagonal() resolved
+                # to StreamingObjective's HOST-side method of the same
+                # name)
+                if not isinstance(node.func, ast.Name):
+                    continue
+                last, _ = call_name(node)
+                callee = fns.get(last or "")
+                if (
+                    callee is not None
+                    and last not in exempt
+                    and id(callee) not in seen_fn_ids
+                ):
+                    seen_fn_ids.add(id(callee))
+                    fn_name = getattr(fn_node, "name", "<lambda>")
+                    second_level.append(
+                        (callee, f"called from traced {fn_name}()")
+                    )
+        for fn_node, why in traced + second_level:
+            if getattr(fn_node, "name", None) in exempt:
+                continue
+            params = self._params_of(fn_node)
+            # nested defs inside the traced body that are THEMSELVES
+            # handed to callback sinks stay exempt; everything else in
+            # the body is trace-context
+            exempt_here: Set[int] = set()
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Call):
+                    last, _ = call_name(node)
+                    if last in _CALLBACK_SINKS:
+                        for arg in node.args:
+                            name = dotted_name(arg)
+                            if name and name.rsplit(".", 1)[-1] in fns:
+                                exempt_here.add(
+                                    id(fns[name.rsplit(".", 1)[-1]])
+                                )
+            skip_subtrees = exempt_here
+            stack = list(
+                ast.iter_child_nodes(fn_node)
+            )
+            while stack:
+                node = stack.pop()
+                if id(node) in skip_subtrees:
+                    continue
+                if isinstance(node, ast.Call):
+                    desc = self._host_op(node, params)
+                    if desc is not None:
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"host op inside traced code "
+                            f"({why}): {desc}",
+                        )
+                stack.extend(ast.iter_child_nodes(node))
+        return

@@ -976,73 +976,106 @@ class CoordinateDescent:
         # deterministic PRNG stream reproduces the run).
         ckpt_writer = _AsyncCheckpointWriter()
 
-        def _save_ckpt(step, wait: bool = False):
-            from photon_ml_tpu.io.checkpoint import (
-                save_checkpoint,
-                save_checkpoint_sharded,
-            )
-
-            materialize()
-            t0 = time.perf_counter()
-            # host snapshot: params / key / history copied now
+        def _ckpt_snapshot():
+            # host snapshot: params / key / history copied now (the
+            # write must capture THIS boundary, not whatever the next
+            # pass mutates)
             params_host = {
                 n: jax.tree_util.tree_map(
                     lambda a: np.asarray(a), model.params[n]
                 )
                 for n in names
             }
-            key_host = np.asarray(key)
-            hist_host = [dataclasses.asdict(h) for h in history]
-            frozen_host = sorted(frozen)
-            if sharded_checkpoints:
-                # per-process shard set + quorum manifest. On a pod the
-                # digest exchange + swap barrier are collective, so the
-                # write runs SYNCHRONOUSLY on the training thread (every
-                # process must reach the exchange together; a background
-                # thread would race the next pass's collectives).
-                num_shards = (
-                    None
-                    if sharded_checkpoints is True
-                    else int(sharded_checkpoints)
-                )
-                ekeys_host = (
-                    {
-                        n: [str(k) for k in v]
-                        for n, v in entity_keys.items()
-                    }
-                    if entity_keys
-                    else None
-                )
-                ckpt_writer.join()  # any legacy overlapped write first
-                save_checkpoint_sharded(
+            return (
+                params_host,
+                np.asarray(key),
+                [dataclasses.asdict(h) for h in history],
+                sorted(frozen),
+            )
+
+        def _sharded_num_shards():
+            return (
+                None
+                if sharded_checkpoints is True
+                else int(sharded_checkpoints)
+            )
+
+        def _save_ckpt_local(step, wait: bool = False):
+            """The legacy single-file writer on the overlapped
+            background thread. NO collectives — the only cadence writer
+            the host-loss handler may reach (photon-lint PL001: the
+            sharded writer's digest exchange and swap barrier are
+            full-world collectives)."""
+            from photon_ml_tpu.io.checkpoint import save_checkpoint
+
+            materialize()
+            t0 = time.perf_counter()
+            params_host, key_host, hist_host, frozen_host = (
+                _ckpt_snapshot()
+            )
+            ckpt_writer.submit(
+                lambda: save_checkpoint(
                     checkpoint_dir,
                     step,
+                    # save_checkpoint handles plain tables AND
+                    # FactoredParams
                     params_host,
                     key_host,
-                    history=hist_host,
+                    hist_host,
                     frozen=frozen_host,
-                    entity_keys=ekeys_host,
-                    num_shards=num_shards,
                 )
-            else:
-                ckpt_writer.submit(
-                    lambda: save_checkpoint(
-                        checkpoint_dir,
-                        step,
-                        # save_checkpoint handles plain tables AND
-                        # FactoredParams
-                        params_host,
-                        key_host,
-                        hist_host,
-                        frozen=frozen_host,
-                    )
-                )
-                if wait:
-                    ckpt_writer.join()
+            )
+            if wait:
+                ckpt_writer.join()
             obs.registry().observe(
                 "game.checkpoint.submit_ms",
                 (time.perf_counter() - t0) * 1e3,
             )
+
+        def _save_ckpt_sharded(step):
+            """Per-process shard set + quorum manifest. On a pod the
+            digest exchange + swap barrier are collective, so the
+            write runs SYNCHRONOUSLY on the training thread (every
+            process must reach the exchange together; a background
+            thread would race the next pass's collectives)."""
+            from photon_ml_tpu.io.checkpoint import (
+                save_checkpoint_sharded,
+            )
+
+            materialize()
+            t0 = time.perf_counter()
+            params_host, key_host, hist_host, frozen_host = (
+                _ckpt_snapshot()
+            )
+            ekeys_host = (
+                {
+                    n: [str(k) for k in v]
+                    for n, v in entity_keys.items()
+                }
+                if entity_keys
+                else None
+            )
+            ckpt_writer.join()  # any legacy overlapped write first
+            save_checkpoint_sharded(
+                checkpoint_dir,
+                step,
+                params_host,
+                key_host,
+                history=hist_host,
+                frozen=frozen_host,
+                entity_keys=ekeys_host,
+                num_shards=_sharded_num_shards(),
+            )
+            obs.registry().observe(
+                "game.checkpoint.submit_ms",
+                (time.perf_counter() - t0) * 1e3,
+            )
+
+        def _save_ckpt(step, wait: bool = False):
+            if sharded_checkpoints:
+                _save_ckpt_sharded(step)
+            else:
+                _save_ckpt_local(step, wait)
 
         def _save_final_shards(step: int) -> None:
             """The pod survivors' final save — collective-free by
@@ -1082,7 +1115,7 @@ class CoordinateDescent:
                     if entity_keys
                     else None
                 ),
-                num_shards=jax.process_count(),
+                num_shards=_sharded_num_shards(),
             )
 
         def _host_loss_boundary(step: int, saved: bool) -> None:
@@ -1113,12 +1146,20 @@ class CoordinateDescent:
                             # this boundary's cadence checkpoint already
                             # landed (all peers alive at that point)
                             ckpt_writer.join()
-                        elif (
-                            jax.process_count() > 1 and sharded_checkpoints
-                        ):
+                        elif sharded_checkpoints:
+                            # ANY world size: the single-publisher
+                            # final writer — collective-free by
+                            # construction. The normal sharded writer's
+                            # digest exchange + completion barrier
+                            # include the peer just declared dead (the
+                            # PR-11 hang, photon-lint PL001); routing
+                            # single-process emulation through the same
+                            # path keeps the recovery writer in tier-1.
                             _save_final_shards(step)
                         else:
-                            _save_ckpt(step, wait=True)
+                            # legacy format: the overlapped local
+                            # writer, no collectives
+                            _save_ckpt_local(step, wait=True)
                     except Exception as save_err:  # noqa: BLE001
                         final_ok = False
                         obs.emit_event(

@@ -33,6 +33,7 @@ from typing import Optional
 from photon_ml_tpu.obs import collectives
 from photon_ml_tpu.obs import convergence
 from photon_ml_tpu.obs import dist
+from photon_ml_tpu.obs import taxonomy
 from photon_ml_tpu.obs.convergence import (
     ConvergenceReport,
     ConvergenceTracker,
@@ -136,6 +137,8 @@ __all__ = [
     "sample_hbm",
     "MetricsDumper",
     "observe",
+    # name taxonomy registry (obs.taxonomy; photon-lint PL006)
+    "taxonomy",
     # distributed observability (obs.dist)
     "dist",
     "emit_clock_sync",

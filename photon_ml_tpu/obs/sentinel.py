@@ -102,6 +102,11 @@ _DIRECTION_RULES = (
     # companion p99_under_overload_ms / breaker_recovery_s gate through
     # the generic _ms/_s lower-is-better rules below
     (re.compile(r"shed_frac$"), LOWER_IS_BETTER),
+    # photon-lint self-hosting gate (docs/ANALYSIS.md): total findings
+    # over the tree — NEW findings already fail the lint itself, so
+    # what this tracks is ratchet debt (baselined + suppressed) creep;
+    # the companion lint_wall_s gates through the generic _s rule
+    (re.compile(r"lint_findings_total$"), LOWER_IS_BETTER),
     (re.compile(r"(^|\.)mfu$"), HIGHER_IS_BETTER),
     (re.compile(r"hbm_util$"), HIGHER_IS_BETTER),
     (re.compile(r"achieved_tflops$"), HIGHER_IS_BETTER),

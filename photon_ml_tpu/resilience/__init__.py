@@ -30,6 +30,7 @@ from photon_ml_tpu.resilience.faults import (
     known_sites,
     parse_spec,
     register_site,
+    registered_sites,
     registry,
 )
 from photon_ml_tpu.resilience.hostloss import (
@@ -61,6 +62,7 @@ __all__ = [
     "InjectedFault",
     "UnknownFaultSite",
     "known_sites",
+    "registered_sites",
     "register_site",
     "arm_from_env",
     "corrupt_file",

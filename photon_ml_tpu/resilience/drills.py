@@ -159,10 +159,17 @@ def drill_site_registry(smoke: bool = True) -> dict:
     """Arm-time validation rejects typo'd sites; unarmed probes stay a
     dict lookup (the obs_overhead gate's chaos-layer share)."""
     try:
-        with inject(FaultSpec("serving.scoer", "raise", nth=1)):
+        with inject(FaultSpec("serving.scoer", "raise", nth=1)):  # photon-lint: disable=PL003 deliberately typo'd site — this drill asserts arm-time validation rejects it
             raise AssertionError("typo'd site armed without error")
     except UnknownFaultSite as e:
-        assert "serving.score" in str(e), "error must list known sites"
+        # classify by the structured field, not message text (PL002);
+        # the near-miss must be carried verbatim and the real site must
+        # be among the suggestions the error advertises
+        assert e.site == "serving.scoer", "error must carry the typo"
+        assert "serving.score" in known_sites(), (
+            "the suggestion list the error prints must contain the "
+            "real site"
+        )
     # every site in the table is armable
     for site in known_sites():
         with inject(FaultSpec(site, "delay", nth=10**9, delay=0.0)):

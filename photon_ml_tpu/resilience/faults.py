@@ -104,6 +104,16 @@ def known_sites() -> tuple:
     return tuple(sorted(set(KNOWN_SITES) | _EXTRA_SITES))
 
 
+def registered_sites() -> tuple:
+    """The machine-readable site registry: the single source of truth
+    shared by arm-time validation, the ``photon-chaos sites`` listing,
+    the docs/ROBUSTNESS.md site table, and the ``photon-lint`` PL003
+    rule (a ``fire("...")``/``FaultSpec("...")`` literal outside this
+    set is a build-time error, not an arm-time one). Alias of
+    :func:`known_sites`, exported under the name the consumers bind."""
+    return known_sites()
+
+
 class UnknownFaultSite(ValueError):
     """Armed a site no production code probes — the drill would test
     nothing. Carries the valid-site list so the typo is obvious."""

@@ -1,0 +1,871 @@
+"""photon-lint drills: the static analyzer that gates this repo's own
+historical runtime bug classes (docs/ANALYSIS.md).
+
+The contract under test: each rule fires on an adversarial snippet
+reproducing its originating bug shape and stays silent on the
+near-miss; the ratchet baseline grandfathers by (rule, path, line text)
+with multiset semantics and prunes stale entries without grandfathering
+new ones; suppressions require a reason; the CLI's exit codes gate CI
+(0 clean, 1 new findings, 2 usage errors); and — the self-hosting gate —
+``photon-lint check photon_ml_tpu/`` over THIS tree exits 0, with ZERO
+baseline entries for the empty-by-policy rules PL001/PL002/PL003.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from photon_ml_tpu.analysis import (
+    EMPTY_BASELINE_RULES,
+    Analyzer,
+    Baseline,
+    BaselineEntry,
+    default_baseline_path,
+    default_rules,
+    rule_catalog,
+)
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "photon_ml_tpu")
+
+ALL_RULES = ("PL001", "PL002", "PL003", "PL004", "PL005", "PL006", "PL007")
+
+
+def lint_source(tmp_path, code, name="snippet.py"):
+    """Analyze one snippet; returns the findings list."""
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(code))
+    analyzer = Analyzer(base=str(tmp_path))
+    return analyzer.run([str(path)])
+
+
+def finding_rules(result):
+    return sorted({f.rule for f in result.findings})
+
+
+def run_cli(args, cwd):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.lint", *args],
+        cwd=cwd,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+# ---------------------------------------------------------------------------
+# PL001 spmd-collective-divergence
+# ---------------------------------------------------------------------------
+
+
+class TestPL001:
+    def test_collective_in_except_handler(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.parallel.multihost import allgather_host
+
+            def boundary(x):
+                try:
+                    x = x + 1
+                except Exception:
+                    allgather_host(x)
+                return x
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL001"]
+        assert "except handler" in res.findings[0].message
+
+    def test_collective_under_process_index_branch(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import jax
+            from photon_ml_tpu.parallel import allgather_strings
+
+            def publish(entries):
+                if jax.process_index() == 0:
+                    return allgather_strings(entries)
+                return []
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL001"]
+        assert "process_index" in res.findings[0].message
+
+    def test_one_level_call_graph(self, tmp_path):
+        # hiding the collective one def down does not evade the rule
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.parallel.multihost import emit_pod_sync
+
+            def sync_obs():
+                emit_pod_sync()
+
+            def recover():
+                try:
+                    pass
+                except OSError:
+                    sync_obs()
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL001"]
+        assert "sync_obs" in res.findings[0].message
+
+    def test_near_misses_stay_silent(self, tmp_path):
+        # uniform branches (process_count), try BODIES, and finally
+        # blocks are reached by every process — not divergence
+        res = lint_source(
+            tmp_path,
+            """
+            import jax
+            from photon_ml_tpu.parallel.multihost import allgather_host
+
+            def exchange(x):
+                if jax.process_count() == 1:
+                    return x
+                try:
+                    out = allgather_host(x)
+                finally:
+                    x = None
+                return out
+            """,
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PL002 exception-match-by-name
+# ---------------------------------------------------------------------------
+
+
+class TestPL002:
+    def test_type_name_comparison(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            def is_timeout(exc):
+                return type(exc).__name__ == "CollectiveTimeout"
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL002"]
+
+    def test_dunder_class_name_in_tuple(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            def classify(exc):
+                return exc.__class__.__name__ in ("Timeout", "Stall")
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL002"]
+
+    def test_message_containment_on_except_binding(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            def run(fn):
+                try:
+                    fn()
+                except Exception as e:
+                    if "deadline" in str(e):
+                        return True
+                    raise
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL002"]
+
+    def test_formatting_and_isinstance_stay_silent(self, tmp_path):
+        # NAMING the type for a log line is fine; isinstance is the fix
+        res = lint_source(
+            tmp_path,
+            """
+            def describe(fn):
+                try:
+                    fn()
+                except ValueError as e:
+                    msg = f"{type(e).__name__}: {e}"
+                    if isinstance(e, ValueError):
+                        return msg
+                    raise
+            """,
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PL003 unknown-fault-site
+# ---------------------------------------------------------------------------
+
+
+class TestPL003:
+    def test_fire_with_typo(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.resilience.faults import fire
+
+            def probe():
+                fire("serving.scoer")
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL003"]
+        assert "serving.scoer" in res.findings[0].message
+
+    def test_faultspec_and_schedule_literals(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.resilience.faults import FaultSpec
+
+            SPEC = FaultSpec(site="bogus.site", mode="raise", nth=1)
+            SCHEDULE = "nosuch.seam:raise@n=2"
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL003", "PL003"]
+
+    def test_registered_sites_and_inline_register(self, tmp_path):
+        # registry sites are clean; register_site() literals extend the
+        # valid set ACROSS files (scan phase)
+        a = tmp_path / "a.py"
+        a.write_text(
+            "from photon_ml_tpu.resilience.faults import register_site\n"
+            'register_site("custom.seam")\n'
+        )
+        b = tmp_path / "b.py"
+        b.write_text(
+            "from photon_ml_tpu.resilience.faults import fire\n"
+            "def f():\n"
+            '    fire("custom.seam")\n'
+            '    fire("checkpoint.save")\n'
+        )
+        res = Analyzer(base=str(tmp_path)).run([str(a), str(b)])
+        assert res.findings == []
+
+    def test_docstring_examples_are_skipped(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            '''
+            def doc():
+                """Example: PHOTON_FAULTS="made.up:raise@n=1"."""
+                return None
+            ''',
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PL004 trace-unsafe-host-op
+# ---------------------------------------------------------------------------
+
+
+class TestPL004:
+    def test_print_in_jitted_fn(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                print(x)
+                return x + 1
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL004"]
+
+    def test_host_clock_in_scan_body(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import time
+            import jax
+
+            def body(carry, x):
+                return carry + x, time.time()
+
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL004"]
+
+    def test_item_and_float_on_param_in_while_loop(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from jax import lax
+
+            def cond(state):
+                return state[0].item() > 0
+
+            def body(state):
+                return (state[0] - float(state), state[1])
+
+            def solve(state):
+                return lax.while_loop(cond, body, state)
+            """,
+        )
+        rules = [f.rule for f in res.findings]
+        assert rules == ["PL004", "PL004"]
+
+    def test_pure_callback_target_is_exempt(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import numpy as np
+            import jax
+
+            def host_sweep(w):
+                return np.asarray(w).sum()
+
+            @jax.jit
+            def value(w):
+                return jax.pure_callback(host_sweep, w.dtype, w)
+            """,
+        )
+        assert res.findings == []
+
+    def test_untraced_host_ops_stay_silent(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import time
+            import numpy as np
+
+            def bench(fn, x):
+                t0 = time.perf_counter()
+                out = np.asarray(fn(x))
+                print(out)
+                return time.perf_counter() - t0
+            """,
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PL005 unmanaged-native-handle
+# ---------------------------------------------------------------------------
+
+
+class TestPL005:
+    def test_unowned_construction(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.io.native import NativeAvroReader
+
+            def leak(prog, desc, vocab):
+                reader = NativeAvroReader(prog, desc, vocab, ())
+                return reader.num_records
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL005"]
+
+    def test_with_and_deferred_with_are_owned(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.io.native import (
+                NativeAvroReader,
+                NativeVocabSet,
+            )
+
+            def scan(prog, desc, paths):
+                vocabset = NativeVocabSet([], [])
+                with vocabset:
+                    with NativeAvroReader(prog, desc, vocabset, ()) as r:
+                        return r.num_records
+            """,
+        )
+        assert res.findings == []
+
+    def test_managed_container_attribute_is_owned(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.io.native import NativeVocabSet
+
+            class Pipeline:
+                def __init__(self):
+                    self._vocabset = NativeVocabSet([], [])
+
+                def close(self):
+                    self._vocabset.close()
+            """,
+        )
+        assert res.findings == []
+
+    def test_unmanaged_container_attribute_flags(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu.io.native import NativeVocabSet
+
+            class Holder:
+                def __init__(self):
+                    self.vocab = NativeVocabSet([], [])
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL005"]
+
+
+# ---------------------------------------------------------------------------
+# PL006 obs-taxonomy
+# ---------------------------------------------------------------------------
+
+
+class TestPL006:
+    def test_typod_metric_name(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu import obs
+
+            def record():
+                obs.registry().inc("sevring.requests")
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL006"]
+
+    def test_unknown_span_name(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu import obs
+
+            def work():
+                with obs.span("bogus.phase"):
+                    return 1
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL006"]
+
+    def test_documented_names_and_fstring_prefixes(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            from photon_ml_tpu import obs
+
+            def record(site, reg):
+                obs.emit_event("resilience.fault_injected", site=site)
+                reg.inc(f"resilience.faults_injected.{site}")
+                with obs.span("game.pass", cat="game"):
+                    reg.observe("serving.request_ms", 1.0)
+            """,
+        )
+        assert res.findings == []
+
+    def test_fully_dynamic_names_are_skipped(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            def record(reg, name):
+                reg.inc(name)
+                reg.inc(f"{name}.count")
+            """,
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PL007 swallowed-retryable
+# ---------------------------------------------------------------------------
+
+
+class TestPL007:
+    def test_swallowed_open(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            def read(path):
+                try:
+                    with open(path) as f:
+                        return f.read()
+                except Exception:
+                    pass
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL007"]
+
+    def test_log_only_handler_flags(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import os
+
+            def cleanup(path, logger):
+                try:
+                    os.remove(path)
+                except OSError:
+                    logger.warning("cleanup failed")
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL007"]
+
+    def test_specific_or_handled_exceptions_stay_silent(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import os
+
+            def cleanup(path, seen):
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+                try:
+                    os.rmdir(path)
+                except OSError as e:
+                    seen.append(e)
+                    raise
+            """,
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    CODE = """
+    from photon_ml_tpu.resilience.faults import fire
+
+    def probe():
+        fire("made.up.site")  {comment}
+    """
+
+    def test_with_reason_suppresses(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            self.CODE.format(
+                comment="# photon-lint: disable=PL003 drill arms a typo "
+                "on purpose"
+            ),
+        )
+        assert res.findings == []
+        assert res.suppressed == 1
+
+    def test_without_reason_is_inert_and_reported(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            self.CODE.format(comment="# photon-lint: disable=PL003"),
+        )
+        assert [f.rule for f in res.findings] == ["PL003"]
+        assert len(res.bare_suppressions) == 1
+
+    def test_wrong_rule_id_does_not_suppress(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            self.CODE.format(
+                comment="# photon-lint: disable=PL001 wrong rule"
+            ),
+        )
+        assert [f.rule for f in res.findings] == ["PL003"]
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+
+def _finding(rule="PL007", path="pkg/a.py", line=3, text="except Exception:"):
+    from photon_ml_tpu.analysis.core import Finding
+
+    return Finding(
+        rule=rule,
+        path=path,
+        line=line,
+        col=0,
+        severity="warning",
+        message="m",
+        hint="h",
+        text=text,
+    )
+
+
+class TestBaseline:
+    def test_split_new_vs_grandfathered_vs_stale(self):
+        base = Baseline(
+            [
+                BaselineEntry("PL007", "pkg/a.py", 3, "except Exception:"),
+                BaselineEntry("PL007", "pkg/gone.py", 9, "except OSError:"),
+            ]
+        )
+        findings = [
+            _finding(),  # matches entry 1
+            _finding(path="pkg/b.py"),  # new
+        ]
+        new, old, stale = base.split(findings)
+        assert [f.path for f in new] == ["pkg/b.py"]
+        assert [f.path for f in old] == ["pkg/a.py"]
+        assert [e.path for e in stale] == ["pkg/gone.py"]
+
+    def test_line_drift_does_not_resurrect(self):
+        base = Baseline(
+            [BaselineEntry("PL007", "pkg/a.py", 3, "except Exception:")]
+        )
+        new, old, _ = base.split([_finding(line=40)])
+        assert new == [] and len(old) == 1
+
+    def test_multiset_semantics(self):
+        # ONE baselined occurrence does not absorb a second identical one
+        base = Baseline(
+            [BaselineEntry("PL007", "pkg/a.py", 3, "except Exception:")]
+        )
+        new, old, _ = base.split([_finding(line=3), _finding(line=30)])
+        assert len(old) == 1 and len(new) == 1
+
+    def test_prune_drops_stale_keeps_matched(self):
+        base = Baseline(
+            [
+                BaselineEntry("PL007", "pkg/a.py", 3, "except Exception:"),
+                BaselineEntry("PL007", "pkg/gone.py", 9, "except OSError:"),
+            ]
+        )
+        pruned = base.pruned([_finding(line=17)])
+        assert len(pruned.entries) == 1
+        assert pruned.entries[0].path == "pkg/a.py"
+        assert pruned.entries[0].line == 17  # advisory line refreshed
+
+    def test_from_findings_refuses_empty_policy_rules(self):
+        base = Baseline.from_findings(
+            [_finding(rule="PL001"), _finding(rule="PL007")]
+        )
+        assert [e.rule for e in base.entries] == ["PL007"]
+
+    def test_save_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        base = Baseline(
+            [BaselineEntry("PL007", "pkg/a.py", 3, "except Exception:")]
+        )
+        base.save(path)
+        loaded = Baseline.load(path)
+        assert loaded.entries == base.entries
+        assert Baseline.load(str(tmp_path / "missing.json")).entries == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, JSON output, explain, baseline workflow
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _violation_tree(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            "from photon_ml_tpu.resilience.faults import fire\n"
+            "def probe():\n"
+            '    fire("made.up.site")\n'
+        )
+        return pkg
+
+    def test_check_exit_codes_and_json(self, tmp_path):
+        pkg = self._violation_tree(tmp_path)
+        empty = tmp_path / "empty.json"
+        proc = run_cli(
+            ["check", "pkg", "--json", "--baseline", str(empty)],
+            cwd=str(tmp_path),
+        )
+        assert proc.returncode == 1, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["new"][0]["rule"] == "PL003"
+        assert doc["new"][0]["path"] == "pkg/bad.py"
+        assert doc["new"][0]["line"] == 3
+
+        # grandfather it, then check is clean (exit 0)
+        proc = run_cli(
+            ["baseline", "pkg", "--baseline", str(empty)], cwd=str(tmp_path)
+        )
+        # PL003 is empty-by-policy: baseline REFUSES to grandfather it
+        assert proc.returncode == 1
+        assert "REFUSING" in proc.stderr
+
+        # a PL007 finding CAN be grandfathered
+        (pkg / "swallow.py").write_text(
+            "def read(path):\n"
+            "    try:\n"
+            "        return open(path).read()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        (pkg / "bad.py").unlink()
+        proc = run_cli(
+            ["baseline", "pkg", "--baseline", str(empty)], cwd=str(tmp_path)
+        )
+        assert proc.returncode == 0, proc.stderr
+        proc = run_cli(
+            ["check", "pkg", "--baseline", str(empty)], cwd=str(tmp_path)
+        )
+        assert proc.returncode == 0, proc.stdout
+
+        # fixing the finding leaves a stale entry; --prune drops it
+        (pkg / "swallow.py").write_text("def read(path):\n    return 1\n")
+        proc = run_cli(
+            ["baseline", "pkg", "--prune", "--baseline", str(empty)],
+            cwd=str(tmp_path),
+        )
+        assert proc.returncode == 0
+        doc = json.loads((tmp_path / "empty.json").read_text())
+        assert doc["entries"] == []
+
+    def test_missing_path_exits_2(self, tmp_path):
+        proc = run_cli(["check", "nosuch_dir"], cwd=str(tmp_path))
+        assert proc.returncode == 2
+
+    def test_explain(self, tmp_path):
+        proc = run_cli(["explain", "PL001"], cwd=str(tmp_path))
+        assert proc.returncode == 0
+        assert "spmd-collective-divergence" in proc.stdout
+        assert "PR 11" in proc.stdout  # the origin story
+        proc = run_cli(["explain", "PL999"], cwd=str(tmp_path))
+        assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# the self-hosting gate + seeded-violation sweep
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    """THE gate: photon-lint over this very tree exits 0 (everything
+    either fixed, suppressed-with-reason, or ratcheted in the committed
+    baseline)."""
+    proc = run_cli(["check", "photon_ml_tpu", "--json"], cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["new"] == []
+    assert doc["stale_baseline_entries"] == []
+    assert doc["bare_suppressions"] == []
+    assert doc["files"] > 50  # the walker actually covered the tree
+
+
+def test_empty_baseline_policy_rules():
+    """PL001/PL002/PL003 ship with ZERO grandfathered findings: their
+    bug classes (collective divergence, by-name exception matching,
+    unknown fault sites) were all fixed in-tree, not ratcheted."""
+    base = Baseline.load(default_baseline_path())
+    assert base.entries, "committed baseline should exist and be non-empty"
+    offenders = [
+        e for e in base.entries if e.rule in EMPTY_BASELINE_RULES
+    ]
+    assert offenders == []
+
+
+def test_rule_catalog_is_complete():
+    catalog = rule_catalog()
+    assert tuple(r.id for r in catalog) == ALL_RULES
+    for r in catalog:
+        assert r.origin, f"{r.id} must tell its origin story"
+        assert r.hint, f"{r.id} must say how to fix"
+        assert r.severity in ("error", "warning")
+
+
+SEEDS = {
+    "PL001": (
+        "from photon_ml_tpu.parallel.multihost import allgather_host\n"
+        "def boundary(x):\n"
+        "    try:\n"
+        "        x = x + 1\n"
+        "    except Exception:\n"
+        "        allgather_host(x)\n",
+        6,
+    ),
+    "PL002": (
+        "def classify(exc):\n"
+        '    return type(exc).__name__ == "CollectiveTimeout"\n',
+        2,
+    ),
+    "PL003": (
+        "from photon_ml_tpu.resilience.faults import fire\n"
+        "def probe():\n"
+        '    fire("serving.scoer")\n',
+        3,
+    ),
+    "PL004": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    print(x)\n"
+        "    return x + 1\n",
+        4,
+    ),
+    "PL005": (
+        "from photon_ml_tpu.io.native import NativeAvroReader\n"
+        "def leak(prog, desc, vocab):\n"
+        "    reader = NativeAvroReader(prog, desc, vocab, ())\n"
+        "    return reader.num_records\n",
+        3,
+    ),
+    "PL006": (
+        "from photon_ml_tpu import obs\n"
+        "def record():\n"
+        '    obs.registry().inc("sevring.requests")\n',
+        3,
+    ),
+    "PL007": (
+        "def read(path):\n"
+        "    try:\n"
+        "        return open(path).read()\n"
+        "    except Exception:\n"
+        "        pass\n",
+        4,
+    ),
+}
+
+
+def test_seeded_violations_fail_scratch_copy(tmp_path):
+    """Acceptance drill: copy the real tree, seed one synthetic
+    violation of EACH rule, and photon-lint must exit 1 naming every
+    rule id at the exact file:line."""
+    scratch = tmp_path / "photon_ml_tpu"
+    shutil.copytree(
+        PACKAGE,
+        scratch,
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"),
+    )
+    for rule, (code, line) in SEEDS.items():
+        (scratch / f"seed_{rule.lower()}.py").write_text(code)
+    proc = run_cli(["check", "photon_ml_tpu", "--json"], cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    located = {
+        (f["rule"], f["path"], f["line"]) for f in doc["new"]
+    }
+    for rule, (code, line) in SEEDS.items():
+        expected = (
+            rule,
+            f"photon_ml_tpu/seed_{rule.lower()}.py",
+            line,
+        )
+        assert expected in located, (
+            f"{rule} not found at {expected}; got {sorted(located)}"
+        )
+    # nothing BUT the seeds is new: the copied tree itself stays clean
+    # under the committed baseline
+    assert len(located) == len(SEEDS)
+
+
+def test_full_tree_lint_is_fast():
+    """The gate must stay cheap enough for tier-1 and pre-commit: the
+    committed acceptance bound is <10s on the bench box; this asserts a
+    looser bound (timeshared CI hosts) while bench.py records the real
+    wall as sentinel-tracked lint_wall_s."""
+    analyzer = Analyzer(base=REPO_ROOT)
+    result = analyzer.run([PACKAGE])
+    assert result.wall_s < 30.0
+    assert result.files > 50
