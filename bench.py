@@ -1929,6 +1929,128 @@ def bench_multihost_resilience():
     }
 
 
+def bench_quality():
+    """Model-quality observability (docs/OBSERVABILITY.md "Quality &
+    drift"). Sentinel-tracked: ``sketch_rows_per_s`` (higher — the
+    per-chunk fingerprint accumulation rate the ingest paths pay),
+    ``quality_overhead_ratio`` (lower — the serving path with the
+    DriftMonitor sampling vs without, same batches), and
+    ``drift_alarm_latency_requests`` / ``drift_alarm_latency_ms``
+    (lower — offered requests / wall from the first shifted batch to
+    ``drift.alarm``). The hard invariants (quiet unshifted replay,
+    flight-recorded alarm, fault-degraded baseline) are asserted by the
+    ``drift_alarm`` chaos drill, not just recorded."""
+    import numpy as _np
+
+    from photon_ml_tpu.obs.quality import BaselineFingerprint, DriftMonitor
+    from photon_ml_tpu.resilience.drills import build_drill_engine
+
+    rng = _np.random.default_rng(20260805)
+
+    # 1) sketch throughput: the fingerprint-collector hot path over
+    # pipeline-shaped staged chunks
+    d = 32
+    rows = 200_000
+    X = rng.standard_normal((rows, d), dtype=_np.float32)
+    y = (rng.uniform(size=rows) < 0.3).astype(_np.float32)
+    fp = BaselineFingerprint(max_features=d)
+    t0 = time.perf_counter()
+    for lo in range(0, rows, 8192):
+        fp.observe_batch(
+            X[lo : lo + 8192], y[lo : lo + 8192], shard="features"
+        )
+    sketch_s = time.perf_counter() - t0
+    sketch_rows_per_s = rows / sketch_s
+
+    # 2) serving overhead: the same END-TO-END request batches
+    # (featurize + padded device score — the real serving path) with
+    # and without a DriftMonitor at default sampling on the engine
+    from photon_ml_tpu.resilience.drills import make_drill_request
+
+    d_fixed, d_user, n_users = 16, 6, 64
+    engine = build_drill_engine(rng, d_fixed, d_user, n_users)
+    req_batches = [
+        [
+            make_drill_request(rng, d_fixed, d_user, n_users)
+            for _ in range(64)
+        ]
+        for _ in range(48)
+    ]
+    arr_batches = [
+        {
+            "g": rng.standard_normal((256, d_fixed)),
+            "u": rng.standard_normal((256, d_user)),
+        }
+        for _ in range(16)
+    ]
+    baseline = BaselineFingerprint(max_features=24)
+    for b in arr_batches:
+        baseline.observe_batch(b["g"], _np.zeros(256), shard="g")
+        baseline.observe_rows("u", b["u"])
+    # request featurization is sparse (most columns 0), so the live
+    # window must compare against a baseline of the SAME featurized
+    # traffic — sketch what the engine actually sees
+    for reqs in req_batches[:8]:
+        feats, _, _ = engine.featurize(reqs)
+        baseline.observe_batch(feats["g"], _np.zeros(64), shard="g")
+        baseline.observe_rows("u", feats["u"])
+    baseline.observe_margins(engine.score(req_batches[0]))
+
+    def score_all():
+        t0 = time.perf_counter()
+        for reqs in req_batches:
+            engine.score(reqs)
+        return time.perf_counter() - t0
+
+    engine.drift = None
+    score_all()  # warm every bucket outside the timers
+    base_wall = min(score_all() for _ in range(3))
+    engine.drift = DriftMonitor(
+        baseline, registry=engine.stats.registry, check_every_rows=512
+    )
+    quality_wall = min(score_all() for _ in range(3))
+    overhead_ratio = quality_wall / base_wall
+
+    # 3) alarm latency: offered requests + wall from the first shifted
+    # batch until drift.alarm fires (sample_every=1: the tightest the
+    # monitor can answer; production sampling multiplies it by N)
+    engine.drift = DriftMonitor(
+        baseline,
+        registry=engine.stats.registry,
+        check_every_rows=512,
+        min_rows=256,
+        sample_every=1,
+    )
+    offered = 0
+    t0 = time.perf_counter()
+    while engine.drift.alarms == 0:
+        assert offered < 65536, "drift alarm never fired under shift"
+        engine.score_arrays(
+            {
+                "g": rng.standard_normal((256, d_fixed)) + 3.0,
+                "u": rng.standard_normal((256, d_user)) + 3.0,
+            }
+        )
+        offered += 256
+    alarm_wall_ms = (time.perf_counter() - t0) * 1e3
+
+    log(
+        f"quality: sketch {sketch_rows_per_s / 1e6:.2f}M rows/s "
+        f"({d} cols), drift-monitor overhead {overhead_ratio:.3f}x, "
+        f"alarm after {offered} shifted requests "
+        f"({alarm_wall_ms:.1f}ms, psi_max "
+        f"{engine.drift.last_report['psi_max']:.2f})"
+    )
+    return {
+        "sketch_rows_per_s": round(sketch_rows_per_s),
+        "sketch_cols": d,
+        "quality_overhead_ratio": round(overhead_ratio, 4),
+        "drift_alarm_latency_requests": offered,
+        "drift_alarm_latency_ms": round(alarm_wall_ms, 2),
+        "psi_max_at_alarm": engine.drift.last_report["psi_max"],
+    }
+
+
 def bench_lint():
     """photon-lint over the full package (docs/ANALYSIS.md). Sentinel-
     tracked: ``lint_wall_s`` (lower — the gate must stay cheap enough
@@ -2058,6 +2180,7 @@ def main():
     multihost_res = _phase(
         "multihost_resilience", bench_multihost_resilience
     )
+    quality = _phase("quality", bench_quality)
     lint = _phase("lint", bench_lint)
 
     extra = {
@@ -2191,6 +2314,12 @@ def main():
         # checkpoint write bandwidth + watchdogged collective recovery
         # wall (sentinel: _gbps higher, recovery_s lower)
         extra["multihost_resilience"] = multihost_res
+    if quality:
+        # model-quality observability (docs/OBSERVABILITY.md "Quality &
+        # drift"): sketch throughput, DriftMonitor serving overhead, and
+        # covariate-shift alarm latency (sentinel: per_s higher,
+        # overhead_ratio + drift_alarm_latency_* lower)
+        extra["quality"] = quality
     if lint:
         # photon-lint self-hosting gate (docs/ANALYSIS.md): analyzer
         # wall (sentinel: the generic _s lower-is-better rule) and

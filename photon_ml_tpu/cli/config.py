@@ -161,6 +161,11 @@ class GLMDriverParams:
     heartbeat_s: float = 0.0
     collective_timeout_s: Optional[float] = None
     sharded_ckpt: bool = False
+    # model-quality observability (docs/OBSERVABILITY.md "Quality &
+    # drift"): accumulate per-feature/label/margin sketches over ingest
+    # and export <output_dir>/quality-fingerprint.json — the baseline
+    # `photon-obs drift` and the serving DriftMonitor compare against
+    quality_fingerprint: bool = True
 
     def validate(self) -> None:
         if not self.train_input:
@@ -442,6 +447,12 @@ class GameDriverParams:
     heartbeat_s: float = 0.0
     collective_timeout_s: Optional[float] = None
     sharded_ckpt: bool = False
+    # model-quality observability: sketch the GAME ingest (per-shard
+    # features, labels, entity top-k) plus the best model's training
+    # margins, and export quality-fingerprint.json into every model
+    # export subdir (next to model-manifest.json, manifest-covered) —
+    # the baseline the serving DriftMonitor hot-loads with the model
+    quality_fingerprint: bool = True
 
     def validate(self) -> None:
         if not self.train_input:

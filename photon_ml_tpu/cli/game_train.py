@@ -507,6 +507,10 @@ def run_game_training(params) -> GameTrainingRun:
         ):
             return _run_game_training(params, logger, shutdown)
     finally:
+        if params.quality_fingerprint:
+            # idempotent: normally uninstalled right after train ingest;
+            # covers the ingest-raised path so no collector leaks
+            obs.quality.uninstall_fingerprint_collector()
         configure_collective_resilience(
             prev_resilience.timeout_s, prev_resilience.retries
         )
@@ -571,6 +575,15 @@ def _run_game_training(
         emit_pod_sync()
 
     # ---- prepare feature maps + dataset ---------------------------------
+    # quality fingerprint (docs/OBSERVABILITY.md "Quality & drift"): the
+    # io paths feed the installed collector per-shard per ingest chunk;
+    # installed for the TRAIN ingest only (validation rows are a
+    # different distribution and must not blur the baseline)
+    from photon_ml_tpu.obs import quality as quality_mod
+
+    fingerprint = None
+    if params.quality_fingerprint:
+        fingerprint = quality_mod.install_fingerprint_collector()
     with timed(logger, "prepare data"):
         from photon_ml_tpu.io.ingest import IngestSource
 
@@ -642,6 +655,13 @@ def _run_game_training(
                 sparse_shards=set(params.sparse_shards),
             )
         logger.info(f"read {len(data.labels)} training records")
+        if fingerprint is not None:
+            # train ingest done — stop collecting before validation io
+            quality_mod.uninstall_fingerprint_collector()
+            logger.info(
+                f"quality fingerprint: {fingerprint.rows} rows sketched "
+                f"over shards {sorted(fingerprint.shards)}"
+            )
         entity_counts = {k: len(v) for k, v in entity_vocabs.items()}
         logger.info(
             f"shards: { {s: len(v) for s, v in shard_vocabs.items()} } "
@@ -1051,6 +1071,25 @@ def _run_game_training(
     ) and not shutdown.requested
     output_dirs: List[str] = []
     with timed(logger, "save models"):
+        if (
+            fingerprint is not None
+            and fingerprint.rows > 0
+            and save_process
+        ):
+            # margin sketch: the best model's score distribution over
+            # its own training rows (offsets included — the space
+            # serving scores live in); one scoring pass, the baseline
+            # the serving DriftMonitor compares live scores against
+            margins = score_game_data(
+                sweep[best_index]["model"].params,
+                shards_by_coord,
+                res_by_coord,
+                data,
+                dtype=dtype,
+            ) + jnp.asarray(data.offsets, dtype)
+            fingerprint.observe_margins(
+                np.asarray(margins), np.asarray(data.weights)
+            )
         to_save: List[int] = []
         if not save_process:
             pass  # non-zero process: model already fetched, writes skipped
@@ -1110,6 +1149,11 @@ def _run_game_training(
                     f,
                     indent=2,
                 )
+            if fingerprint is not None and fingerprint.rows > 0:
+                # written BEFORE write_model_manifest below, so the
+                # baseline is covered by the export's integrity digest
+                # and hot-reloads atomically with the model
+                fingerprint.save(subdir)
             output_dirs.append(subdir)
         if save_process:
             for shard, vocab in shard_vocabs.items():
@@ -1235,6 +1279,13 @@ def main(argv=None) -> None:
         "entity-keyed shards restore onto a different world size "
         "(required for checkpointing on a pod — docs/MULTIHOST.md)",
     )
+    p.add_argument(
+        "--no-quality-fingerprint", dest="quality_fingerprint",
+        action="store_false", default=None,
+        help="skip the train-data quality fingerprint "
+        "(quality-fingerprint.json in every export subdir — the "
+        "serving drift-detection baseline; docs/OBSERVABILITY.md)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1284,6 +1335,8 @@ def main(argv=None) -> None:
         base["collective_timeout_s"] = args.collective_timeout_s
     if args.sharded_ckpt is not None:
         base["sharded_ckpt"] = args.sharded_ckpt
+    if args.quality_fingerprint is not None:
+        base["quality_fingerprint"] = args.quality_fingerprint
     try:
         run_game_training(base)
     except BaseException as e:

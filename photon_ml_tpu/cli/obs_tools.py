@@ -11,6 +11,9 @@ own monotonic clock. This CLI folds them into pod-level artifacts:
     # render a run's convergence health from its events.jsonl
     python -m photon_ml_tpu.cli.obs_tools convergence out/trace
 
+    # compare two quality fingerprints; exit 1 on drift alarm (cron)
+    python -m photon_ml_tpu.cli.obs_tools drift out/run1 out/run2
+
 ``convergence`` reads the ``convergence.solve`` / ``convergence.fleet``
 events the obs.convergence layer emits (train CLIs under ``--trace-dir``
 and/or ``--convergence-report``) and renders per-solve value/grad-norm
@@ -29,7 +32,10 @@ writes:
 - ``<out>/events.jsonl`` — every shard's structured events, host-tagged
   and time-ordered (when shards carry event logs),
 - ``<out>/metrics.json`` — per-host instruments under ``host.<i>.``
-  prefixes plus ``pod.*`` counter sums (when shards carry snapshots).
+  prefixes plus ``pod.*`` counter sums (when shards carry snapshots),
+- ``<out>/quality-fingerprint.json`` — per-host quality fingerprints
+  folded EXACTLY (sketch merge; pod-merged == single-pass) when shard
+  dirs carry them (docs/OBSERVABILITY.md "Quality & drift").
 
 Missing / truncated / torn shards are skipped with a warning — merges
 run during post-mortems and must work with whatever survived. Exit 0 on
@@ -103,6 +109,31 @@ def merge_command(args) -> int:
                 f.write(json.dumps(rec, sort_keys=True) + "\n")
         events_written = len(records)
 
+    # quality-fingerprint.json: exact sketch folding — the pod-merged
+    # fingerprint equals one single-pass fingerprint over all hosts'
+    # rows (obs.sketches merge contract)
+    merged_fp = None
+    fp_shards = 0
+    for _, label in docs:
+        shard_dir = os.path.dirname(os.path.abspath(label))
+        fp_path = os.path.join(shard_dir, "quality-fingerprint.json")
+        if not os.path.exists(fp_path):
+            continue
+        from photon_ml_tpu.obs.quality import BaselineFingerprint
+
+        try:
+            fp = BaselineFingerprint.load(fp_path)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            warnings.append(f"{fp_path}: skipped ({e})")
+            continue
+        if merged_fp is None:
+            merged_fp = fp
+        else:
+            merged_fp.merge(fp)
+        fp_shards += 1
+    if merged_fp is not None:
+        merged_fp.save(os.path.join(args.out, "quality-fingerprint.json"))
+
     # metrics.json: host.<i>.-prefixed union + pod.* counter sums
     metric_snaps = []
     for pos, (doc, label) in enumerate(docs):
@@ -138,6 +169,7 @@ def merge_command(args) -> int:
                     "events": info["events"],
                     "events_jsonl": events_written,
                     "metrics_shards": len(metric_snaps),
+                    "fingerprint_shards": fp_shards,
                     "duplicates_dropped": info["duplicates_dropped"],
                     "aligned_by": info["aligned_by"],
                     "skipped": len(paths) - info["shards"],
@@ -327,6 +359,89 @@ def convergence_command(args) -> int:
     return 0
 
 
+# -- photon-obs drift --------------------------------------------------------
+
+
+def drift_command(args) -> int:
+    """Compare two quality fingerprints (train-time baseline vs a newer
+    fingerprint — a later train run, a pod-merged serving sample, or a
+    suspect export). Prints a per-feature PSI/JS table to stderr, one
+    BENCH-style JSON line to stdout, and exits NONZERO when any feature
+    (or the margin distribution) crosses the alarm threshold — the cron
+    contract: `photon-obs drift base/ current/ || trigger-retrain`."""
+    from photon_ml_tpu.obs.quality import (
+        BaselineFingerprint,
+        compare_fingerprints,
+    )
+
+    sides = {}
+    for role, path in (("baseline", args.baseline), ("current", args.current)):
+        try:
+            sides[role] = BaselineFingerprint.load(path)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(
+                f"photon-obs: {role} fingerprint {path!r} unreadable "
+                f"({e})",
+                file=sys.stderr,
+            )
+            return 2
+    report = compare_fingerprints(
+        sides["baseline"], sides["current"], psi_alarm=args.threshold
+    )
+
+    out = sys.stderr  # human rendering; the JSON summary owns stdout
+    ranked = sorted(
+        report["features"].items(),
+        key=lambda kv: -kv[1]["psi"],
+    )
+    print(
+        f"— drift report: {report['baseline_rows']} baseline rows vs "
+        f"{report['current_rows']} current rows "
+        f"(alarm threshold PSI >= {args.threshold:g}) —",
+        file=out,
+    )
+    for key, f in ranked[: args.top]:
+        flag = " ALARM" if f["psi"] >= args.threshold else ""
+        label = f" ({f['name']})" if f.get("name") else ""
+        print(
+            f"{key}{label}: psi={f['psi']:.4f} js={f['js']:.4f} "
+            f"mean {f['baseline_mean']:g} -> {f['current_mean']:g}"
+            f"{flag}",
+            file=out,
+        )
+    if report["margin_psi"] is not None:
+        print(f"margin/score psi={report['margin_psi']:.4f}", file=out)
+    if report["label_psi"] is not None:
+        print(f"label psi={report['label_psi']:.4f}", file=out)
+    if report["alarm"]:
+        print(
+            f"DRIFT ALARM: {len(report['flagged'])} feature(s) over "
+            f"threshold: {report['flagged']}",
+            file=out,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "drift_psi_max",
+                "value": report["psi_max"],
+                "unit": "psi",
+                "extra": {
+                    "alarm": report["alarm"],
+                    "flagged": report["flagged"],
+                    "js_max": report["js_max"],
+                    "margin_psi": report["margin_psi"],
+                    "label_psi": report["label_psi"],
+                    "threshold": args.threshold,
+                    "features_compared": len(report["features"]),
+                    "baseline_rows": report["baseline_rows"],
+                    "current_rows": report["current_rows"],
+                },
+            }
+        )
+    )
+    return 1 if report["alarm"] else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="photon-obs",
@@ -364,6 +479,33 @@ def main(argv=None) -> int:
         help="how many of the most recent solves to render (default 8)",
     )
     cp.set_defaults(func=convergence_command)
+    dp = sub.add_parser(
+        "drift",
+        help="compare two quality fingerprints; exit 1 on drift alarm "
+        "(cron contract)",
+    )
+    dp.add_argument(
+        "baseline",
+        help="train-time quality-fingerprint.json (or its export dir)",
+    )
+    dp.add_argument(
+        "current",
+        help="newer fingerprint to compare (file or directory)",
+    )
+    dp.add_argument(
+        "--threshold",
+        type=float,
+        default=0.25,
+        help="PSI alarm threshold (default 0.25 — the conventional "
+        "'action-worthy shift' reading)",
+    )
+    dp.add_argument(
+        "--top",
+        type=int,
+        default=10,
+        help="how many worst features to render (default 10)",
+    )
+    dp.set_defaults(func=drift_command)
     args = p.parse_args(argv)
     return args.func(args)
 

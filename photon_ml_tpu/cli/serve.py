@@ -27,6 +27,18 @@ Protocol (one JSON object per line):
     {"cmd": "reload", "path": "<export dir>"} -> {"reloaded": "<version>"}
                            (an explicit reload bypasses the breaker's
                            quarantine — the operator asked)
+    {"cmd": "feedback", "label": 1, "score": 1.234, "weight": 1.0}
+                        -> {"ok": true, "window_n": N} — the delayed-
+                           label protocol: the client echoes the score
+                           it was served once the true label arrives,
+                           feeding rolling-window online AUC/calibration
+                           gauges (quality.*; obs.quality.OnlineQuality)
+    {"cmd": "quality"}  -> online-quality snapshot (window AUC — exact,
+                           equal to the ops.metrics replay — plus
+                           calibration error and window counts)
+    {"cmd": "drift"}    -> current model's DriftMonitor snapshot (live
+                           PSI vs the export's train-time baseline
+                           fingerprint; docs/OBSERVABILITY.md)
 
 ``deadline_ms`` (per request, or ``--default-deadline-ms``) drops a
 request that can't start scoring in time — the Future answers
@@ -82,6 +94,7 @@ def serve_lines(
     shutdown=None,
     window: int = 128,
     default_deadline_ms: Optional[float] = None,
+    quality=None,
 ) -> int:
     """Pump a JSON-lines stream through the batcher, writing one response
     line per request IN ORDER. A dedicated writer thread emits each
@@ -169,6 +182,53 @@ def serve_lines(
                         if registry is not None:
                             health.update(registry.health())
                         reply_now(health)
+                    elif cmd == "feedback":
+                        # delayed-label loop (docs/OBSERVABILITY.md
+                        # "Quality & drift"): the client echoes the
+                        # served score once the true label arrives
+                        if quality is None:
+                            reply_now(
+                                {"error": "no online-quality tracker"}
+                            )
+                        else:
+                            quality.record(
+                                float(obj["label"]),
+                                float(obj["score"]),
+                                float(obj.get("weight", 1.0)),
+                            )
+                            reply_now(
+                                {
+                                    "ok": True,
+                                    "window_n": quality.window_n,
+                                }
+                            )
+                    elif cmd == "quality":
+                        if quality is None:
+                            reply_now(
+                                {"error": "no online-quality tracker"}
+                            )
+                        else:
+                            reply_now(quality.snapshot())
+                    elif cmd == "drift":
+                        v = (
+                            registry.current
+                            if registry is not None
+                            else None
+                        )
+                        monitor = (
+                            getattr(v.engine, "drift", None)
+                            if v is not None and v.engine is not None
+                            else None
+                        )
+                        if monitor is None:
+                            reply_now(
+                                {
+                                    "error": "no drift monitor (export "
+                                    "has no quality fingerprint)"
+                                }
+                            )
+                        else:
+                            reply_now(monitor.snapshot())
                     elif cmd == "version":
                         reply_now({"version": registry.version()})
                     elif cmd == "reload":
@@ -218,7 +278,7 @@ def _watch_loop(registry, watch_root, poll_s, shutdown, logger):
 
 def _serve_socket(
     port, batcher, registry, stats, shutdown, logger,
-    default_deadline_ms=None,
+    default_deadline_ms=None, quality=None,
 ):
     import socketserver
 
@@ -236,6 +296,7 @@ def _serve_socket(
             serve_lines(
                 lines, _W(), batcher, registry, stats, shutdown=shutdown,
                 default_deadline_ms=default_deadline_ms,
+                quality=quality,
             )
 
     class Server(socketserver.ThreadingTCPServer):
@@ -333,6 +394,11 @@ def main(argv=None) -> None:
         window_s=args.slo_window_s,
         registry=stats.registry,
     )
+    # online quality: delayed-label feedback -> rolling exact AUC /
+    # calibration gauges (quality.*; the {"cmd": "feedback"} surface)
+    from photon_ml_tpu.obs.quality import OnlineQuality
+
+    quality = OnlineQuality(registry=stats.registry)
     batcher = MicroBatcher(
         registry.score,
         max_batch=args.max_batch,
@@ -357,6 +423,7 @@ def main(argv=None) -> None:
             _serve_socket(
                 args.socket, batcher, registry, stats, shutdown, logger,
                 default_deadline_ms=args.default_deadline_ms,
+                quality=quality,
             )
         else:
             serve_lines(
@@ -368,6 +435,7 @@ def main(argv=None) -> None:
                 shutdown=shutdown,
                 window=args.max_batch * 2,
                 default_deadline_ms=args.default_deadline_ms,
+                quality=quality,
             )
     finally:
         drained = batcher.drain()

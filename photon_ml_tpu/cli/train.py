@@ -211,6 +211,11 @@ def run_glm_training(params) -> GLMTrainingRun:
         ):
             return _run_glm_training(params)
     finally:
+        if params.quality_fingerprint:
+            # idempotent: normally uninstalled right after train ingest;
+            # this covers the ingest-raised path so no collector leaks
+            # into the next in-process run
+            obs.quality.uninstall_fingerprint_collector()
         configure_collective_resilience(
             prev_resilience.timeout_s, prev_resilience.retries
         )
@@ -255,6 +260,16 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
             vocab = source.build_vocab(add_intercept=params.add_intercept)
         logger.info(f"feature space: {len(vocab)} columns "
                     f"(intercept={vocab.intercept_index})")
+
+        # quality fingerprint: the io paths feed the installed collector
+        # per ingest chunk (docs/OBSERVABILITY.md "Quality & drift");
+        # installed for the TRAIN ingest only — validation rows are a
+        # different distribution and must not blur the baseline
+        from photon_ml_tpu.obs import quality as quality_mod
+
+        fingerprint = None
+        if params.quality_fingerprint:
+            fingerprint = quality_mod.install_fingerprint_collector()
 
         task = TaskType[params.task]
         batch = None
@@ -325,6 +340,13 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
                 os.path.join(params.output_dir, "feature-summary.tsv"),
                 summary,
                 vocab,
+            )
+        if fingerprint is not None:
+            # train ingest is done — stop collecting (validation ingest
+            # below must not enter the baseline)
+            quality_mod.uninstall_fingerprint_collector()
+            logger.info(
+                f"quality fingerprint: {fingerprint.rows} rows sketched"
             )
     tracker.advance(DriverStage.PREPROCESSED)
 
@@ -539,6 +561,22 @@ def _run_glm_training(params: GLMDriverParams) -> GLMTrainingRun:
 
     # ---- OUTPUT ----------------------------------------------------------
     with timed(logger, "write models"):
+        if fingerprint is not None and fingerprint.rows > 0:
+            # margin sketch: the shipped model's score distribution on
+            # its own training data — what the serving DriftMonitor
+            # compares live score distributions against. In-core only
+            # (the out-of-core design holds no host batch to score).
+            if batch is not None and models:
+                chosen = best if best is not None else models[0]
+                margins = chosen.model.compute_margin(
+                    batch.features, batch.offsets
+                )
+                fingerprint.observe_margins(
+                    np.asarray(margins),
+                    np.asarray(batch.effective_weights()),
+                )
+            fp_path = fingerprint.save(params.output_dir)
+            logger.info(f"wrote quality fingerprint to {fp_path}")
         vocab.save(os.path.join(params.output_dir, "feature-index.txt"))
         if params.model_output_mode != "NONE":
             to_write = (
@@ -712,6 +750,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="watchdog deadline on host-side collectives: a stalled "
         "exchange times out, retries with backoff, and emits straggler "
         "attribution instead of wedging the pod (default: no watchdog)",
+    )
+    p.add_argument(
+        "--no-quality-fingerprint", dest="quality_fingerprint",
+        action="store_false", default=None,
+        help="skip the train-data quality fingerprint (per-feature/"
+        "label/margin sketches written to quality-fingerprint.json; "
+        "the drift-detection baseline — docs/OBSERVABILITY.md)",
     )
     p.add_argument(
         "--sharded-ckpt", action="store_true", default=None,

@@ -22,8 +22,53 @@ import numpy as np
 from photon_ml_tpu import obs
 from photon_ml_tpu.core.types import LabeledBatch
 from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+from photon_ml_tpu.obs import quality as _quality
 from photon_ml_tpu.resilience import faults as _faults
 from photon_ml_tpu.resilience import retry as _retry
+
+
+def _vocab_names(vocab, limit: int) -> List[str]:
+    """Human names for a vocabulary's leading ``limit`` columns (the
+    fingerprint cap) — ``name`` or ``name\\x01term`` rendered readable."""
+    names = []
+    for j in range(min(len(vocab), limit)):
+        name, term = vocab.name_term(j)
+        names.append(f"{name}{term}" if term else str(name))
+    return names
+
+
+def _feed_fingerprint(features_by_shard, labels, weights, vocabs=None):
+    """Feed the installed quality fingerprint collector (no-op when
+    none is installed — the common case costs one global read). Dense
+    (n, d) shards contribute per-column sketches; sparse/structured
+    containers contribute labels/weights only."""
+    coll = _quality.fingerprint_collector()
+    if coll is None:
+        return
+    for shard, m in (features_by_shard or {}).items():
+        if getattr(m, "ndim", 0) != 2:
+            continue
+        vocab = (vocabs or {}).get(shard)
+        coll.observe_rows(
+            shard,
+            np.asarray(m),
+            weights,
+            names=(
+                _vocab_names(vocab, coll.max_features)
+                if vocab is not None
+                else None
+            ),
+        )
+    if labels is not None:
+        coll.observe_labels(np.asarray(labels), weights)
+
+
+def _feed_fingerprint_entities(entities, weights=None):
+    coll = _quality.fingerprint_collector()
+    if coll is None:
+        return
+    for kind, keys in (entities or {}).items():
+        coll.observe_categorical(kind, keys, weights)
 
 
 def _resilient_read(fn, *args, label: str, logger=None, paths=None, **kwargs):
@@ -559,6 +604,12 @@ class IngestSource:
             present = np.asarray(
                 [r.get("label") is not None for r in recs], bool
             )
+            _feed_fingerprint(
+                {"features": batch.features},
+                batch.labels,
+                np.asarray(batch.effective_weights()),
+                vocabs={"features": vocab},
+            )
             return batch, uids, present
         n = out["n"]
         rows, cols, vals = out["coo"][0]
@@ -585,6 +636,12 @@ class IngestSource:
             offsets=out["offsets"],
             weights=out["weights"],
             dtype=dtype or jnp.float32,
+        )
+        _feed_fingerprint(
+            {"features": features},
+            out["labels"],
+            out["weights"],
+            vocabs={"features": vocab},
         )
         return batch, out["uids"], out["label_present"]
 
@@ -730,6 +787,12 @@ class IngestSource:
             weights=out["weights"],
             entity_ids=entity_ids,
         )
+        _feed_fingerprint(
+            features, out["labels"], out["weights"], vocabs=shard_vocabs
+        )
+        _feed_fingerprint_entities(
+            {k: out["entities"][k] for k in entity_keys}, out["weights"]
+        )
         return data, out_vocabs, out["uids"], out["label_present"]
 
     def game_data(
@@ -762,6 +825,12 @@ class IngestSource:
             present = np.asarray(
                 [r.get("label") is not None for r in recs], bool
             )
+            _feed_fingerprint(
+                dict(data.features),
+                data.labels,
+                np.asarray(data.weights),
+                vocabs=shard_vocabs,
+            )
             return data, vocabs, uids, present
         from photon_ml_tpu.game.data import GameData
 
@@ -784,6 +853,12 @@ class IngestSource:
             offsets=out["offsets"],
             weights=out["weights"],
             entity_ids=entity_ids,
+        )
+        _feed_fingerprint(
+            features, out["labels"], out["weights"], vocabs=shard_vocabs
+        )
+        _feed_fingerprint_entities(
+            {k: out["entities"][k] for k in entity_keys}, out["weights"]
         )
         return data, out_vocabs, out["uids"], out["label_present"]
 

@@ -55,6 +55,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu import obs
+from photon_ml_tpu.obs import quality as _quality
 from photon_ml_tpu.resilience import faults as _faults
 
 DEFAULT_CHUNK_MB = 64.0
@@ -746,8 +747,34 @@ class IngestPipeline:
             slot, buf = ring.acquire(rpc, d, dtype)
             fill = 0
 
+        names_cache: Dict[int, List[str]] = {}
+
+        def chunk_names(coll) -> List[str]:
+            limit = min(d, coll.max_features)
+            if limit not in names_cache:
+                names = []
+                for j in range(limit):
+                    name, term = vocab.name_term(j)
+                    names.append(f"{name}{term}" if term else str(name))
+                names_cache[limit] = names
+            return names_cache[limit]
+
         def emit(rows: int) -> StagedChunk:
             nonlocal index, start_row
+            # quality fingerprint: sketch the staged rows HERE, while
+            # they are host-resident numpy (the streamed/out-of-core
+            # paths never hold an in-core batch to sketch later); the
+            # sketch aggregates copy immediately, so ring-slot reuse
+            # after transfer cannot corrupt them
+            coll = _quality.fingerprint_collector()
+            if coll is not None:
+                coll.observe_batch(
+                    buf["features"][:rows],
+                    buf["labels"][:rows],
+                    buf["weights"][:rows],
+                    shard="features",
+                    names=chunk_names(coll),
+                )
             if pad_tail and rows < rpc:
                 buf["features"][rows:] = 0.0
                 for k in ("labels", "offsets", "weights"):

@@ -33,6 +33,8 @@ from typing import Optional
 from photon_ml_tpu.obs import collectives
 from photon_ml_tpu.obs import convergence
 from photon_ml_tpu.obs import dist
+from photon_ml_tpu.obs import quality
+from photon_ml_tpu.obs import sketches
 from photon_ml_tpu.obs import taxonomy
 from photon_ml_tpu.obs.convergence import (
     ConvergenceReport,
@@ -86,6 +88,20 @@ from photon_ml_tpu.obs.flight import (
     flight_recorder,
     install_flight_recorder,
     uninstall_flight_recorder,
+)
+from photon_ml_tpu.obs.quality import (
+    BaselineFingerprint,
+    DriftMonitor,
+    OnlineQuality,
+    fingerprint_collector,
+    install_fingerprint_collector,
+    try_load_fingerprint,
+    uninstall_fingerprint_collector,
+)
+from photon_ml_tpu.obs.sketches import (
+    HistogramSketch,
+    MomentSketch,
+    TopKSketch,
 )
 from photon_ml_tpu.obs.trace import (
     Span,
@@ -173,6 +189,19 @@ __all__ = [
     # executable-dispatch counting (obs.dispatch_count)
     "DispatchCounts",
     "count_dispatches",
+    # model/data-quality layer (obs.sketches, obs.quality)
+    "sketches",
+    "quality",
+    "MomentSketch",
+    "HistogramSketch",
+    "TopKSketch",
+    "BaselineFingerprint",
+    "DriftMonitor",
+    "OnlineQuality",
+    "fingerprint_collector",
+    "install_fingerprint_collector",
+    "uninstall_fingerprint_collector",
+    "try_load_fingerprint",
 ]
 
 

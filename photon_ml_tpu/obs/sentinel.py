@@ -102,6 +102,15 @@ _DIRECTION_RULES = (
     # companion p99_under_overload_ms / breaker_recovery_s gate through
     # the generic _ms/_s lower-is-better rules below
     (re.compile(r"shed_frac$"), LOWER_IS_BETTER),
+    # model-quality observability (docs/OBSERVABILITY.md "Quality &
+    # drift", bench_quality): the serving path's wall with the
+    # DriftMonitor sampling vs without (creep here is the quality
+    # layer's tax growing), and how many offered requests / how much
+    # wall a real covariate shift needs before drift.alarm fires — the
+    # retrain-loop trigger must not get slower to notice. The
+    # companion sketch_rows_per_s gates through the generic per_s rule.
+    (re.compile(r"overhead_ratio$"), LOWER_IS_BETTER),
+    (re.compile(r"drift_alarm_latency"), LOWER_IS_BETTER),
     # photon-lint self-hosting gate (docs/ANALYSIS.md): total findings
     # over the tree — NEW findings already fail the lint itself, so
     # what this tracks is ratchet debt (baselined + suppressed) creep;

@@ -127,6 +127,18 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         r"lint\.[a-z_]+(\..+)?",
         "photon-lint analyzer metrics (docs/ANALYSIS.md)",
     ),
+    (
+        "drift",
+        r"drift\.[a-z_]+(\..+)?",
+        "serving-vs-baseline drift detection: per-feature PSI/JS "
+        "gauges, drift.alarm events (obs.quality.DriftMonitor)",
+    ),
+    (
+        "quality",
+        r"quality\.[a-z_]+(\..+)?",
+        "model-quality layer: online AUC/calibration gauges from the "
+        "feedback loop, baseline-fingerprint health counters",
+    ),
 )
 
 _COMPILED = tuple(

@@ -1020,6 +1020,123 @@ def drill_checkpoint_integrity(smoke: bool = True) -> dict:
 # the schedule
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# drill: covariate shift raises drift.alarm; unshifted replay stays quiet
+# ---------------------------------------------------------------------------
+
+
+def drill_drift_alarm(smoke: bool = True) -> dict:
+    """Model-quality drill (docs/OBSERVABILITY.md "Quality & drift"):
+    a train-time baseline fingerprint + a DriftMonitor on the scoring
+    engine must (1) stay QUIET on unshifted replay traffic, (2) raise
+    ``drift.alarm`` within a bounded request count once the feature
+    distribution shifts, (3) land the alarm in the flight-recorder
+    dump, and (4) degrade to serve-without-monitoring — not crash —
+    when the ``quality.baseline`` fault site makes the fingerprint
+    unreadable or corrupt."""
+    import json as _json
+
+    from photon_ml_tpu.obs.metrics import MetricsRegistry
+    from photon_ml_tpu.obs.quality import (
+        BaselineFingerprint,
+        DriftMonitor,
+        try_load_fingerprint,
+    )
+
+    rng = np.random.default_rng(7)
+    d_fixed, d_user, n_users = 8, 4, 32
+    engine = build_drill_engine(rng, d_fixed, d_user, n_users)
+
+    def traffic(n, shift=0.0):
+        return {
+            "g": rng.normal(size=(n, d_fixed)) + shift,
+            "u": rng.normal(size=(n, d_user)) + shift,
+        }
+
+    # train-time baseline: sketches of the unshifted distribution plus
+    # the model's own score distribution over it
+    baseline = BaselineFingerprint(max_features=16)
+    base_rows = 2048 if smoke else 16384
+    feats = traffic(base_rows)
+    baseline.observe_batch(feats["g"], np.zeros(base_rows), shard="g")
+    baseline.observe_rows("u", feats["u"])
+    baseline.observe_margins(
+        engine.score_arrays({k: v[:512] for k, v in feats.items()})
+    )
+    engine.drift = DriftMonitor(
+        baseline,
+        registry=engine.stats.registry,
+        check_every_rows=256,
+        min_rows=128,
+        psi_alarm=0.25,
+        sample_every=1,  # the drill asserts a TIGHT alarm-latency bound
+    )
+
+    batch_rows = 64
+    max_shifted_rows = 1024  # the bounded-request-count contract
+    with tempfile.TemporaryDirectory() as tmp:
+        with obs.observe(flight_dir=tmp, flight_records=512):
+            # phase 1: unshifted replay — NO false alarm across checks
+            for _ in range(8):
+                engine.score_arrays(traffic(batch_rows))
+            quiet_checks = engine.drift.checks
+            assert quiet_checks >= 1, "quiet phase never reached a check"
+            assert engine.drift.alarms == 0, (
+                f"false drift alarm on unshifted replay "
+                f"(psi_max={engine.drift.last_report['psi_max']})"
+            )
+            # phase 2: shifted traffic — alarm within the bound
+            shifted_rows = 0
+            while engine.drift.alarms == 0:
+                assert shifted_rows < max_shifted_rows, (
+                    f"no drift.alarm within {max_shifted_rows} shifted "
+                    "requests"
+                )
+                engine.score_arrays(traffic(batch_rows, shift=3.0))
+                shifted_rows += batch_rows
+            alarm_report = engine.drift.last_report
+            assert alarm_report["alarm"] and alarm_report["flagged"], (
+                f"alarm fired without flagged features: {alarm_report}"
+            )
+            dump_path = obs.flight_dump("drift-drill", flight_dir=tmp)
+            assert dump_path is not None, "flight dump failed"
+            with open(dump_path, encoding="utf-8") as f:
+                dump = _json.load(f)
+            alarm_records = [
+                r
+                for r in dump["records"]
+                if r.get("name") == "drift.alarm"
+            ]
+            assert alarm_records, (
+                "flight-recorder dump holds no drift.alarm snapshot"
+            )
+            assert alarm_records[-1].get("worst"), (
+                "drift.alarm flight record carries no offender snapshot"
+            )
+
+        # phase 3: the quality.baseline fault site — a broken
+        # fingerprint degrades to no-monitoring, never an exception
+        export = os.path.join(tmp, "export")
+        os.makedirs(export)
+        baseline.save(export)
+        reg = MetricsRegistry()
+        assert try_load_fingerprint(export, registry=reg) is not None
+        with inject(FaultSpec("quality.baseline", "raise", nth=1)):
+            assert try_load_fingerprint(export, registry=reg) is None
+        assert reg.counter("quality.baseline_missing").value == 1
+        with inject(FaultSpec("quality.baseline", "corrupt", nth=1)):
+            assert try_load_fingerprint(export, registry=reg) is None
+        assert reg.counter("quality.baseline_errors").value == 1
+
+    return {
+        "quiet_checks": quiet_checks,
+        "alarm_latency_rows": shifted_rows,
+        "psi_max_at_alarm": alarm_report["psi_max"],
+        "flagged_features": len(alarm_report["flagged"]),
+        "flight_alarm_records": len(alarm_records),
+    }
+
+
 DRILLS: Dict[str, Callable[[bool], dict]] = {
     "site_registry": drill_site_registry,
     "serving_score": drill_serving_score,
@@ -1036,6 +1153,10 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     "heartbeat_loss": drill_heartbeat_loss,
     "host_loss_recovery": drill_host_loss_recovery,
     "torn_shard": drill_torn_shard,
+    # model-quality observability (docs/OBSERVABILITY.md): covariate
+    # shift alarms, quiet unshifted replay, flight-recorded snapshot,
+    # quality.baseline fault degradation
+    "drift_alarm": drill_drift_alarm,
 }
 
 # the subset `photon-chaos drill --multihost-smoke` runs: every drill of

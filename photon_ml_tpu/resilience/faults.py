@@ -29,6 +29,10 @@ Sites in-tree today::
                             raise = peer went silent, delay = straggler)
     checkpoint.shard_write  per per-process checkpoint shard write
                             (key = shard index; corrupt = torn shard)
+    quality.baseline        per quality-fingerprint load attempt
+                            (key = export dir name; raise = unreadable,
+                            corrupt = torn/garbage fingerprint — serving
+                            must continue WITHOUT drift monitoring)
 
 Arming a site OUTSIDE this list raises at arm time: a typo'd drill that
 silently probes nothing would "pass" by testing nothing. Libraries that
@@ -82,6 +86,7 @@ KNOWN_SITES = (
     "collective.stall",
     "heartbeat.miss",
     "checkpoint.shard_write",
+    "quality.baseline",
 )
 
 MODES = ("raise", "corrupt", "delay")

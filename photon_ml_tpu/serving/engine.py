@@ -183,6 +183,8 @@ class ScoringEngine:
         max_bucket: int = DEFAULT_MAX_BUCKET,
         device=None,
         stats: Optional[ServingStats] = None,
+        baseline=None,
+        drift=None,
     ):
         install_compile_listener()
         self.dtype = jnp.empty((), dtype).dtype  # canonicalized (x64 seam)
@@ -193,6 +195,20 @@ class ScoringEngine:
         self.shard_vocabs = dict(shard_vocabs or {})
         self.re_vocabs = dict(re_vocabs or {})
         self.stats = stats if stats is not None else ServingStats()
+        # drift monitor: live request-feature/score sketches vs the
+        # model's train-time baseline (obs.quality). Lives ON the engine
+        # so a registry hot-reload swaps baseline atomically with the
+        # model; gauges/events go to this engine's stats registry.
+        if drift is not None:
+            self.drift = drift
+        elif baseline is not None:
+            from photon_ml_tpu.obs.quality import DriftMonitor
+
+            self.drift = DriftMonitor(
+                baseline, registry=self.stats.registry
+            )
+        else:
+            self.drift = None
         self._coord_order = sorted(params)
 
         def put(x):
@@ -253,12 +269,19 @@ class ScoringEngine:
     def from_model_dir(cls, root: str, **kw) -> "ScoringEngine":
         """Load a GAME model export (training-output layout) and stand up
         an engine over it. Integrity verification belongs to the registry
-        (:mod:`.registry`) — this loads whatever is on disk."""
+        (:mod:`.registry`) — this loads whatever is on disk. The export's
+        quality fingerprint, when present and readable, becomes the
+        engine's drift baseline; a missing/corrupt one is counted
+        (``quality.baseline_*``) and the engine serves without drift
+        monitoring — never refuses to serve."""
         from photon_ml_tpu.io.models import load_game_model_auto
+        from photon_ml_tpu.obs.quality import try_load_fingerprint
 
         params, shards, random_effects, shard_vocabs, re_vocabs = (
             load_game_model_auto(root)
         )
+        if "baseline" not in kw and "drift" not in kw:
+            kw = dict(kw, baseline=try_load_fingerprint(root))
         return cls(
             params, shards, random_effects, shard_vocabs, re_vocabs, **kw
         )
@@ -535,6 +558,15 @@ class ScoringEngine:
                 )
         if offsets is not None:
             out = out + np.asarray(offsets, out.dtype)
+        if self.drift is not None and not fixed_only:
+            # sample this batch's (unpadded) features + scores into the
+            # live drift window. Degraded batches are skipped — fixed-
+            # effect-only scores are a different distribution by design
+            # and would read as model drift.
+            self.drift.observe(
+                {s: np.asarray(features[s]) for s in self._used_shards},
+                out,
+            )
         return out
 
     def score(

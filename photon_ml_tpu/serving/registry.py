@@ -368,7 +368,12 @@ class ModelRegistry:
         MicroBatcher`."""
         v = self.acquire()
         try:
-            return v.engine.score(requests)
+            scores = v.engine.score(requests)
+            # per-version score-distribution histogram: "did the score
+            # distribution move when the model did" straight from one
+            # stats snapshot (serving.stats.record_scores)
+            self.stats.record_scores(v.version_id, scores)
+            return scores
         finally:
             self.release(v)
 
@@ -388,6 +393,20 @@ class ModelRegistry:
         """Version + breaker state for the serve ``{"cmd": "health"}``
         endpoint."""
         v = self.current
+        drift = None
+        if v is not None and v.engine is not None:
+            monitor = getattr(v.engine, "drift", None)
+            if monitor is not None:
+                snap = monitor.snapshot()
+                drift = {
+                    "checks": snap["checks"],
+                    "alarms": snap["alarms"],
+                    "psi_max": (
+                        snap["last_report"]["psi_max"]
+                        if snap["last_report"]
+                        else None
+                    ),
+                }
         return {
             "version": v.version_id if v is not None else None,
             "inflight": v.inflight if v is not None else 0,
@@ -395,6 +414,7 @@ class ModelRegistry:
             "reload_failures": int(self.stats.reload_failures),
             "retired_versions": list(self.retired_versions),
             "breaker": self.breaker.snapshot(),
+            "drift": drift,
         }
 
     # -- watch mode --------------------------------------------------------

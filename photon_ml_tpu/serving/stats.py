@@ -31,6 +31,7 @@ from photon_ml_tpu.obs.metrics import (  # noqa: F401
     LatencyHistogram,
     MetricsRegistry,
 )
+from photon_ml_tpu.obs.sketches import HistogramSketch
 
 __all__ = [
     "LatencyHistogram",
@@ -86,6 +87,10 @@ class ServingStats:
         # per-bucket row counts keyed by padded size; kept as a host dict
         # (dynamic keys) and mirrored into `serving.bucket.<size>` counters
         self.bucket_counts: Dict[int, int] = collections.Counter()
+        # per-model-version score-distribution sketches (fixed linear
+        # bins over logit space — obs.sketches): "did the scores move
+        # when the model did" is answerable from one stats snapshot
+        self.score_hists: Dict[str, HistogramSketch] = {}
         self._recent = collections.deque(maxlen=qps_window)
 
     def __getattr__(self, name: str):
@@ -140,6 +145,18 @@ class ServingStats:
             peak = self.registry.gauge("serving.queue_depth_peak")
             if depth > peak.value:
                 peak.set(depth)
+
+    def record_scores(self, version: str, scores) -> None:
+        """Fold one batch's scores into the per-model-version score
+        histogram (``snapshot()['score_distribution']``) — the cheap
+        always-on companion to the DriftMonitor's baseline compare."""
+        with self._lock:
+            h = self.score_hists.get(version)
+            if h is None:
+                h = self.score_hists[version] = (
+                    HistogramSketch.for_scores()
+                )
+            h.add(scores)
 
     def record_compile(self) -> None:
         with self._lock:
@@ -236,6 +253,10 @@ class ServingStats:
                     self.registry.gauge("serving.queue_depth_peak").value
                 ),
                 "bucket_latency": self._bucket_latency_snapshot(),
+                "score_distribution": {
+                    v: h.summary()
+                    for v, h in sorted(self.score_hists.items())
+                },
             }
 
     def _bucket_latency_snapshot(self) -> Dict[str, dict]:

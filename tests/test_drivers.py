@@ -1260,3 +1260,91 @@ class TestMultiprocessValidation:
         ]
         with pytest.raises(ValueError, match="not str"):
             _ordered_entity_ids("userId", {7: 0, "u1": 1})
+
+
+class TestQualityFingerprintExport:
+    """Train-time baseline fingerprints ride the standard driver outputs
+    (docs/OBSERVABILITY.md "Quality & drift")."""
+
+    def test_glm_driver_exports_fingerprint(self, rng, tmp_path):
+        from photon_ml_tpu.io.ingest import make_training_example
+
+        records = [
+            make_training_example(
+                float(rng.uniform() < 0.5),
+                {("a", ""): float(rng.normal()),
+                 ("b", ""): float(rng.normal())},
+            )
+            for _ in range(60)
+        ]
+        train = write_records(str(tmp_path / "fp.avro"), records)
+        run = run_glm_training(
+            {
+                "train_input": [train],
+                "output_dir": str(tmp_path / "fpout"),
+                "task": "LOGISTIC_REGRESSION",
+                "reg_weights": [1.0],
+                "max_iters": 8,
+            }
+        )
+        from photon_ml_tpu.obs.quality import BaselineFingerprint
+
+        fp = BaselineFingerprint.load(str(tmp_path / "fpout"))
+        assert fp.rows == 60
+        assert "features" in fp.shards
+        # margin sketch present: the exported model's training scores
+        assert fp.margin.histogram.weight == 60
+        assert run.num_training_rows == 60
+
+    def test_glm_driver_opt_out(self, rng, tmp_path):
+        from photon_ml_tpu.io.ingest import make_training_example
+
+        records = [
+            make_training_example(
+                float(i % 2), {("a", ""): float(i)}
+            )
+            for i in range(20)
+        ]
+        train = write_records(str(tmp_path / "nofp.avro"), records)
+        run_glm_training(
+            {
+                "train_input": [train],
+                "output_dir": str(tmp_path / "nofpout"),
+                "task": "LOGISTIC_REGRESSION",
+                "reg_weights": [1.0],
+                "max_iters": 4,
+                "quality_fingerprint": False,
+            }
+        )
+        assert not os.path.exists(
+            str(tmp_path / "nofpout" / "quality-fingerprint.json")
+        )
+
+    def test_game_export_carries_baseline_into_serving(
+        self, rng, game_fixture
+    ):
+        """game_train writes the fingerprint into the export subdir and
+        the scoring engine loads it as its drift baseline — the
+        hot-reload path swaps baselines atomically with the model."""
+        train, valid, gs, us, tmp = game_fixture
+        run = run_game_training(
+            game_params(
+                train, valid, gs, us, str(tmp / "qout"),
+                model_output_mode="BEST",
+            )
+        )
+        export = run.output_dirs[0]
+        assert os.path.exists(
+            os.path.join(export, "quality-fingerprint.json")
+        )
+        from photon_ml_tpu.obs.quality import BaselineFingerprint
+        from photon_ml_tpu.serving.engine import ScoringEngine
+
+        fp = BaselineFingerprint.load(export)
+        assert fp.rows == 12 * 25
+        assert set(fp.shards) == {"gshard", "ushard"}
+        assert fp.margin.histogram.weight == 12 * 25
+        assert "userId" in fp.categoricals
+        engine = ScoringEngine.from_model_dir(export)
+        assert engine.drift is not None
+        assert engine.drift.baseline.rows == 12 * 25

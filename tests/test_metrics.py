@@ -188,3 +188,47 @@ class TestStats:
         np.testing.assert_allclose(np.asarray(s1.min), np.asarray(s0.min), atol=1e-12)
         np.testing.assert_allclose(np.asarray(s1.max), np.asarray(s0.max), atol=1e-12)
         assert float(s1.count) == 30
+
+
+class TestStreamingAgreement:
+    """The streaming-online quality path (obs.quality.exact_auc — the
+    numpy mirror behind the serving feedback loop) must agree with the
+    exact device kernel on the SAME stream, edge cases included:
+    weighted ties, single-class degeneracy, zero-weight rows."""
+
+    def _agree(self, y, s, w):
+        from photon_ml_tpu.obs.quality import exact_auc
+
+        device = float(
+            metrics.area_under_roc_curve(
+                jnp.asarray(y, jnp.float64),
+                jnp.asarray(s, jnp.float64),
+                jnp.asarray(w, jnp.float64),
+            )
+        )
+        online = exact_auc(y, s, w)
+        assert abs(device - online) <= 1e-6, (device, online)
+        return device
+
+    def test_weighted_ties_agree(self, rng):
+        y = (rng.uniform(size=400) < 0.5).astype(float)
+        s = np.round(rng.normal(size=400) + y, 1)  # heavy ties
+        w = rng.uniform(0.1, 3.0, size=400)
+        device = self._agree(y, s, w)
+        from sklearn import metrics as _skm
+
+        assert device == pytest.approx(
+            _skm.roc_auc_score(y, s, sample_weight=w), abs=1e-10
+        )
+
+    def test_single_class_degenerate_agree(self):
+        s = np.array([0.1, 0.7, 0.3])
+        for y in (np.ones(3), np.zeros(3)):
+            assert self._agree(y, s, np.ones(3)) == pytest.approx(0.5)
+
+    def test_zero_weight_rows_agree(self, rng):
+        y = (rng.uniform(size=200) < 0.5).astype(float)
+        s = rng.normal(size=200)
+        w = rng.uniform(0.5, 1.5, size=200)
+        w[::3] = 0.0  # padding rows on both paths
+        self._agree(y, s, w)

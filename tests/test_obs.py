@@ -385,6 +385,10 @@ class TestServingStatsCompat:
         # additive keys; everything above is byte-compatible
         "expired", "shed", "degraded", "degraded_batches",
         "reload_failures",
+        # model-quality observability (docs/OBSERVABILITY.md "Quality &
+        # drift"): per-model-version score-distribution histograms —
+        # additive key; everything above keeps its shape
+        "score_distribution",
     }
 
     def test_snapshot_schema_unchanged(self):
@@ -399,9 +403,11 @@ class TestServingStatsCompat:
         st.record_rejected()
         st.record_error()
         st.record_reload()
+        st.record_scores("v1", [0.5, -0.5, 1.5, 2.0])
         snap = st.snapshot()
         assert set(snap) == self.GOLDEN_KEYS
         assert snap["requests"] == 4 and snap["batches"] == 1
+        assert snap["score_distribution"]["v1"]["count"] == 4
         assert snap["buckets"] == {"8": 2}
         assert snap["bucket_hits"] == 1 and snap["bucket_misses"] == 1
         assert isinstance(snap["requests"], int)

@@ -1,0 +1,725 @@
+"""Model-quality observability drills (marker ``quality``, tier-1).
+
+Covers the obs.sketches / obs.quality layer end to end:
+
+- sketch merge EXACTNESS over arbitrary chunkings (pod-merged ==
+  single-pass — the acceptance criterion of the quality layer),
+- streaming-online AUC / calibration equality with the exact
+  ``ops.metrics`` replay on the same stream (≤1e-6),
+- baseline fingerprints through the REAL ingest paths (collector
+  install → IngestSource / IngestPipeline feeds → save/load),
+- the serving DriftMonitor: quiet on unshifted traffic, alarming on
+  covariate shift, atomic baseline swap on hot-reload,
+- the ``quality.baseline`` fault site (serve-without-monitoring
+  degradation),
+- the serve-CLI feedback protocol and ``photon-obs drift`` / ``merge``
+  fingerprint folding exit contracts.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.obs.quality import (
+    BaselineFingerprint,
+    DriftMonitor,
+    OnlineQuality,
+    calibration_error,
+    compare_fingerprints,
+    exact_auc,
+    fingerprint_collector,
+    install_fingerprint_collector,
+    try_load_fingerprint,
+    uninstall_fingerprint_collector,
+)
+from photon_ml_tpu.obs.sketches import (
+    HistogramSketch,
+    MomentSketch,
+    TopKSketch,
+    coarsen_counts,
+    js_divergence,
+    psi,
+    psi_and_js,
+)
+
+pytestmark = pytest.mark.quality
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_collector():
+    """A leaked global collector would silently blur every later test's
+    ingest into one fingerprint."""
+    uninstall_fingerprint_collector()
+    yield
+    uninstall_fingerprint_collector()
+
+
+def chunkings(n, sizes=(1, 7, 64, 317, 1000)):
+    for size in sizes:
+        yield [(lo, min(lo + size, n)) for lo in range(0, n, size)]
+
+
+# ---------------------------------------------------------------------------
+# sketches: merge exactness, quantiles, serialization
+# ---------------------------------------------------------------------------
+
+
+class TestSketches:
+    def test_moment_merge_exact_over_arbitrary_chunkings(self, rng):
+        v = rng.normal(size=5000) * 3.0 + 1.5
+        w = rng.uniform(size=5000)
+        w[::13] = 0.0  # padding rows must stay invisible
+        single = MomentSketch().add(v, w)
+        for chunks in chunkings(5000):
+            merged = MomentSketch()
+            for lo, hi in chunks:
+                merged.merge(MomentSketch().add(v[lo:hi], w[lo:hi]))
+            assert merged.count == single.count
+            assert merged.weight == pytest.approx(single.weight, abs=1e-9)
+            assert merged.mean == pytest.approx(single.mean, abs=1e-12)
+            assert merged.m2 == pytest.approx(single.m2, rel=1e-12)
+            assert merged.min == single.min and merged.max == single.max
+
+    def test_moment_zero_weight_rows_invisible(self):
+        sk = MomentSketch().add([1.0, 100.0, -50.0], [1.0, 0.0, 0.0])
+        assert sk.mean == 1.0
+        assert sk.min == 1.0 and sk.max == 1.0
+
+    def test_histogram_merge_exact_over_arbitrary_chunkings(self, rng):
+        v = rng.normal(size=5000) * 10.0
+        w = rng.uniform(size=5000)
+        single = HistogramSketch.for_features().add(v, w)
+        for chunks in chunkings(5000):
+            merged = HistogramSketch.for_features()
+            for lo, hi in chunks:
+                merged.merge(
+                    HistogramSketch.for_features().add(v[lo:hi], w[lo:hi])
+                )
+            np.testing.assert_allclose(
+                merged.counts, single.counts, atol=1e-9
+            )
+            assert merged.weight == pytest.approx(single.weight)
+
+    def test_histogram_quantiles_track_distribution(self, rng):
+        v = rng.normal(size=200_000)
+        h = HistogramSketch.for_features().add(v)
+        # symlog resolution is bin-level; quantiles must land close
+        assert abs(h.quantile(0.5) - np.median(v)) < 0.05
+        assert abs(h.quantile(0.99) - np.quantile(v, 0.99)) < 0.3
+        assert h.quantile(0.0) <= h.quantile(0.5) <= h.quantile(1.0)
+
+    def test_histogram_empty_and_overflow(self):
+        h = HistogramSketch(scale="linear", lo=0.0, hi=1.0, bins=4)
+        assert h.quantile(0.5) == 0.0
+        h.add([-5.0, 0.5, 99.0, np.nan])
+        assert h.counts[0] == 1.0  # underflow
+        assert h.counts[-1] == 2.0  # overflow + NaN
+        assert h.weight == 4.0
+
+    def test_histogram_config_mismatch_refuses_merge(self):
+        with pytest.raises(ValueError, match="configs differ"):
+            HistogramSketch.for_features().merge(
+                HistogramSketch.for_scores()
+            )
+
+    def test_histogram_roundtrip(self, rng):
+        h = HistogramSketch.for_scores().add(rng.normal(size=1000))
+        h2 = HistogramSketch.from_dict(
+            json.loads(json.dumps(h.to_dict()))
+        )
+        np.testing.assert_array_equal(h2.counts, h.counts)
+        assert h2.config() == h.config()
+
+    def test_matrix_fast_path_matches_per_column(self, rng):
+        from photon_ml_tpu.obs.sketches import (
+            histogram_add_matrix,
+            moments_add_matrix,
+        )
+
+        m = rng.normal(size=(500, 6)).astype(np.float32)
+        w = rng.uniform(size=500)
+        slow_h = [HistogramSketch.for_features() for _ in range(6)]
+        slow_m = [MomentSketch() for _ in range(6)]
+        for j in range(6):
+            slow_h[j].add(m[:, j], w)
+            slow_m[j].add(m[:, j], w)
+        fast_h = [HistogramSketch.for_features() for _ in range(6)]
+        fast_m = [MomentSketch() for _ in range(6)]
+        histogram_add_matrix(fast_h, m, w)
+        moments_add_matrix(fast_m, m, w)
+        for j in range(6):
+            np.testing.assert_allclose(
+                fast_h[j].counts, slow_h[j].counts, atol=1e-9
+            )
+            assert fast_m[j].mean == pytest.approx(
+                slow_m[j].mean, abs=1e-12
+            )
+            assert fast_m[j].m2 == pytest.approx(
+                slow_m[j].m2, rel=1e-9
+            )
+
+    def test_topk_merge_exact_within_capacity(self, rng):
+        keys = [f"k{int(i)}" for i in rng.integers(0, 40, size=3000)]
+        single = TopKSketch().add_many(keys)
+        merged = TopKSketch()
+        for lo in range(0, 3000, 113):
+            merged.merge(TopKSketch().add_many(keys[lo : lo + 113]))
+        assert merged.counts == single.counts
+        assert merged.weight == single.weight
+        assert merged.top(3) == single.top(3)
+
+    def test_topk_overflow_conserves_mass(self):
+        sk = TopKSketch(max_keys=4)
+        for i in range(100):
+            sk.add(f"key{i}", float(i + 1))
+        d = sk.to_dict()
+        assert len(d["counts"]) <= 4
+        assert sum(d["counts"].values()) + d["other"] == pytest.approx(
+            sk.weight
+        )
+        # deterministic truncation: heaviest keys survive
+        assert "key99" in d["counts"]
+
+    def test_psi_js_properties(self, rng):
+        base = HistogramSketch.for_features().add(rng.normal(size=50_000))
+        same = HistogramSketch.for_features().add(rng.normal(size=50_000))
+        shifted = HistogramSketch.for_features().add(
+            rng.normal(size=50_000) + 3.0
+        )
+        assert psi(base, base) == 0.0
+        assert psi(base, same) < 0.05  # sampling noise only
+        assert psi(base, shifted) > 1.0
+        assert 0.0 <= js_divergence(base, shifted) <= 1.0
+        assert js_divergence(base, same) < js_divergence(base, shifted)
+        p, j = psi_and_js(base, shifted)
+        assert p == pytest.approx(psi(base, shifted))
+        assert j == pytest.approx(js_divergence(base, shifted))
+
+    def test_coarsen_exact_and_refuses_nondivisor(self):
+        h = HistogramSketch(scale="linear", lo=0.0, hi=1.0, bins=8)
+        h.add(np.linspace(0.01, 0.99, 80))
+        c = coarsen_counts(h, 4)
+        assert c.sum() == h.counts.sum()
+        assert c.size == 6
+        with pytest.raises(ValueError, match="coarsen"):
+            coarsen_counts(h, 3)
+
+
+# ---------------------------------------------------------------------------
+# streaming-online vs exact-replay equality (ops.metrics agreement)
+# ---------------------------------------------------------------------------
+
+
+class TestOnlineQuality:
+    def _replay(self, labels, scores, weights):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.ops.metrics import area_under_roc_curve
+
+        return float(
+            area_under_roc_curve(
+                jnp.asarray(labels), jnp.asarray(scores),
+                jnp.asarray(weights),
+            )
+        )
+
+    def test_streaming_auc_equals_exact_replay(self, rng):
+        q = OnlineQuality()
+        scores = rng.normal(size=1500)
+        labels = (
+            rng.uniform(size=1500) < 1.0 / (1.0 + np.exp(-scores))
+        ).astype(float)
+        weights = rng.uniform(0.1, 2.0, size=1500)
+        for y, s, w in zip(labels, scores, weights):
+            q.record(y, s, w)
+        snap = q.snapshot()
+        la, sc, we = q.window_arrays()
+        assert abs(snap["auc"] - self._replay(la, sc, we)) <= 1e-6
+        assert snap["window_n"] == 1500
+
+    def test_streaming_gauges_exported(self, rng):
+        from photon_ml_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        q = OnlineQuality(registry=reg, refresh_every=8)
+        for i in range(16):
+            score = float(i) - 8.0
+            q.record(float(score > 0), score)
+        snap = reg.snapshot()
+        assert snap["gauges"]["quality.auc"] > 0.9
+        assert snap["counters"]["quality.feedback_total"] == 16
+        assert snap["gauges"]["quality.window_n"] == 16
+
+    def test_window_bound(self):
+        q = OnlineQuality(max_samples=64)
+        for i in range(200):
+            q.record(float(i % 2), float(i % 7))
+        assert q.window_n == 64
+
+    def test_rejects_nonfinite_feedback(self):
+        q = OnlineQuality()
+        with pytest.raises(ValueError, match="finite"):
+            q.record(1.0, float("nan"))
+
+    def test_calibration_error_zero_for_calibrated(self):
+        # scores whose sigmoids average exactly to the label rate
+        labels = np.array([1.0, 0.0])
+        scores = np.array([0.0, 0.0])  # sigmoid = 0.5 each
+        assert calibration_error(labels, scores) == pytest.approx(0.0)
+        assert calibration_error(
+            np.array([0.0, 0.0]), np.array([5.0, 5.0])
+        ) == pytest.approx(1.0 / (1.0 + np.exp(-5.0)), abs=1e-9)
+
+
+class TestExactAucEdgeCases:
+    """ops.metrics edge cases the streaming path must agree with."""
+
+    def _both(self, labels, scores, weights):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.ops.metrics import area_under_roc_curve
+
+        exact = float(
+            area_under_roc_curve(
+                jnp.asarray(labels, jnp.float64),
+                jnp.asarray(scores, jnp.float64),
+                jnp.asarray(weights, jnp.float64),
+            )
+        )
+        online = exact_auc(labels, scores, weights)
+        assert abs(exact - online) <= 1e-6, (exact, online)
+        return exact
+
+    def test_weighted_ties(self):
+        # three rows share one score: the tie term 0.5*P(s+ = s-) must
+        # be pair-weight exact on both paths
+        labels = np.array([1.0, 0.0, 1.0, 0.0, 1.0])
+        scores = np.array([0.5, 0.5, 0.5, 0.1, 0.9])
+        weights = np.array([2.0, 3.0, 1.0, 1.0, 0.5])
+        auc = self._both(labels, scores, weights)
+        # hand-computed: pos mass {0.5:3, 0.9:0.5}, neg {0.5:3, 0.1:1}
+        # pairs = 3*(1 + .5*3) + 0.5*(1+3) = 7.5 + 2 = 9.5; denom 3.5*4
+        assert auc == pytest.approx(9.5 / 14.0)
+
+    def test_all_ties_is_half(self):
+        labels = np.array([1.0, 0.0, 1.0, 0.0])
+        scores = np.zeros(4)
+        weights = np.ones(4)
+        assert self._both(labels, scores, weights) == pytest.approx(0.5)
+
+    def test_single_class_degenerate(self):
+        for lab in (np.ones(4), np.zeros(4)):
+            auc = self._both(
+                lab, np.array([0.1, 0.2, 0.3, 0.4]), np.ones(4)
+            )
+            assert auc == pytest.approx(0.5)
+
+    def test_zero_weight_rows_invisible(self, rng):
+        scores = rng.normal(size=200)
+        labels = (rng.uniform(size=200) < 0.5).astype(float)
+        weights = rng.uniform(0.5, 1.0, size=200)
+        dead = rng.uniform(size=200) < 0.3
+        weights_dead = weights.copy()
+        weights_dead[dead] = 0.0
+        a_masked = self._both(labels, scores, weights_dead)
+        a_dropped = self._both(
+            labels[~dead], scores[~dead], weights[~dead]
+        )
+        assert a_masked == pytest.approx(a_dropped, abs=1e-12)
+
+    def test_empty_stream(self):
+        z = np.zeros(0)
+        assert exact_auc(z, z, z) == 0.5
+
+
+# ---------------------------------------------------------------------------
+# baseline fingerprints: chunked == single-pass, io integration
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineFingerprint:
+    def test_chunked_merge_equals_single_pass(self, rng):
+        X = rng.normal(size=(4000, 5))
+        y = (rng.uniform(size=4000) < 0.4).astype(float)
+        w = rng.uniform(size=4000)
+        single = BaselineFingerprint(max_features=5)
+        single.observe_batch(X, y, w, shard="s")
+        for chunks in chunkings(4000, sizes=(64, 317, 1000)):
+            merged = BaselineFingerprint(max_features=5)
+            for lo, hi in chunks:
+                part = BaselineFingerprint(max_features=5)
+                part.observe_batch(
+                    X[lo:hi], y[lo:hi], w[lo:hi], shard="s"
+                )
+                merged.merge(part)
+            assert merged.rows == single.rows
+            for j in range(5):
+                np.testing.assert_allclose(
+                    merged.shards["s"][j].histogram.counts,
+                    single.shards["s"][j].histogram.counts,
+                    atol=1e-9,
+                )
+                assert merged.shards["s"][j].moments.mean == pytest.approx(
+                    single.shards["s"][j].moments.mean, abs=1e-12
+                )
+            np.testing.assert_allclose(
+                merged.label.histogram.counts,
+                single.label.histogram.counts,
+                atol=1e-9,
+            )
+
+    def test_roundtrip_and_max_features_cap(self, rng, tmp_path):
+        fp = BaselineFingerprint(max_features=3)
+        fp.observe_batch(
+            rng.normal(size=(100, 8)),
+            np.ones(100),
+            shard="wide",
+            names=[f"c{j}" for j in range(8)],
+        )
+        fp.observe_margins(rng.normal(size=100))
+        fp.observe_categorical("userId", ["u1", "u2", "u1"])
+        assert sorted(fp.shards["wide"]) == [0, 1, 2]  # capped
+        path = fp.save(str(tmp_path))
+        assert os.path.basename(path) == "quality-fingerprint.json"
+        fp2 = BaselineFingerprint.load(str(tmp_path))
+        assert fp2.rows == 100
+        assert fp2.shards["wide"][1].name == "c1"
+        assert fp2.margin.histogram.weight == 100
+        assert fp2.categoricals["userId"].top(1) == [("u1", 2.0)]
+
+    def test_collector_fed_by_in_core_ingest(self, tmp_path, rng):
+        from photon_ml_tpu.io import (
+            TRAINING_EXAMPLE_SCHEMA,
+            write_avro_file,
+        )
+        from photon_ml_tpu.io.ingest import IngestSource, make_training_example
+
+        records = [
+            make_training_example(
+                label=float(i % 2),
+                features={("f0", ""): float(i), ("f1", ""): 1.0},
+                weight=1.0,
+            )
+            for i in range(24)
+        ]
+        path = str(tmp_path / "train.avro")
+        write_avro_file(path, TRAINING_EXAMPLE_SCHEMA, records)
+        source = IngestSource([path])
+        vocab = source.build_vocab(add_intercept=True)
+        coll = install_fingerprint_collector()
+        source.labeled_batch(vocab)
+        assert coll.rows == 24
+        assert "features" in coll.shards
+        # label sketch saw both classes
+        assert coll.label.moments.mean == pytest.approx(0.5)
+        # vocab names rode along for the capped columns
+        names = [sk.name for sk in coll.shards["features"].values()]
+        assert any(n and n.startswith("f0") for n in names)
+
+    def test_collector_not_installed_costs_nothing(self, rng):
+        assert fingerprint_collector() is None
+        from photon_ml_tpu.io.ingest import _feed_fingerprint
+
+        # must be a no-op, not an error
+        _feed_fingerprint({"s": rng.normal(size=(4, 2))}, None, None)
+
+    def test_compare_fingerprints_flags_shift(self, rng):
+        base = BaselineFingerprint(max_features=4)
+        base.observe_batch(
+            rng.normal(size=(4000, 4)), np.zeros(4000), shard="s"
+        )
+        same = BaselineFingerprint(max_features=4)
+        same.observe_batch(
+            rng.normal(size=(4000, 4)), np.zeros(4000), shard="s"
+        )
+        rep = compare_fingerprints(base, same)
+        assert not rep["alarm"] and rep["psi_max"] < 0.1
+        shifted = BaselineFingerprint(max_features=4)
+        X = rng.normal(size=(4000, 4))
+        X[:, 2] += 4.0  # shift ONE feature
+        shifted.observe_batch(X, np.zeros(4000), shard="s")
+        rep = compare_fingerprints(base, shifted)
+        assert rep["alarm"] and rep["flagged"] == ["s.2"]
+
+    def test_try_load_missing_and_corrupt(self, tmp_path):
+        from photon_ml_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        assert try_load_fingerprint(str(tmp_path), registry=reg) is None
+        assert reg.counter("quality.baseline_missing").value == 1
+        (tmp_path / "quality-fingerprint.json").write_text("{torn")
+        assert try_load_fingerprint(str(tmp_path), registry=reg) is None
+        assert reg.counter("quality.baseline_errors").value == 1
+
+
+# ---------------------------------------------------------------------------
+# serving integration: DriftMonitor on the engine, hot-reload swap
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine(rng):
+    from photon_ml_tpu.resilience.drills import build_drill_engine
+
+    return build_drill_engine(rng, d_fixed=6, d_user=3, n_users=16)
+
+
+class TestDriftServing:
+    def test_engine_feeds_monitor_and_alarms_on_shift(self, rng):
+        engine = _tiny_engine(rng)
+        base = BaselineFingerprint(max_features=9)
+        base.observe_batch(
+            rng.normal(size=(2000, 6)), np.zeros(2000), shard="g"
+        )
+        base.observe_rows("u", rng.normal(size=(2000, 3)))
+        engine.drift = DriftMonitor(
+            base,
+            registry=engine.stats.registry,
+            check_every_rows=128,
+            min_rows=64,
+            sample_every=1,
+        )
+        for _ in range(4):
+            engine.score_arrays(
+                {
+                    "g": rng.normal(size=(64, 6)),
+                    "u": rng.normal(size=(64, 3)),
+                }
+            )
+        assert engine.drift.checks >= 1 and engine.drift.alarms == 0
+        for _ in range(4):
+            engine.score_arrays(
+                {
+                    "g": rng.normal(size=(64, 6)) + 3.0,
+                    "u": rng.normal(size=(64, 3)) + 3.0,
+                }
+            )
+        assert engine.drift.alarms >= 1
+        reg = engine.stats.registry.snapshot()
+        assert reg["counters"]["drift.alarms"] >= 1
+        assert reg["gauges"]["drift.psi_max"] > 0.25
+
+    def test_degraded_batches_not_observed(self, rng):
+        engine = _tiny_engine(rng)
+        base = BaselineFingerprint(max_features=6)
+        base.observe_batch(
+            rng.normal(size=(500, 6)), np.zeros(500), shard="g"
+        )
+        engine.drift = DriftMonitor(base, sample_every=1)
+        engine.score_arrays(
+            {"g": rng.normal(size=(8, 6)), "u": rng.normal(size=(8, 3))},
+            fixed_only=True,
+        )
+        assert engine.drift.snapshot()["window_rows"] == 0
+
+    def test_registry_hot_reload_swaps_baseline(self, rng, tmp_path):
+        """The DriftMonitor lives on the engine, so a registry reload
+        swaps the baseline atomically with the model, and the export's
+        fingerprint loads through from_model_dir."""
+        from photon_ml_tpu.resilience.drills import _save_drill_export
+        from photon_ml_tpu.serving.engine import ScoringEngine
+        from photon_ml_tpu.serving.registry import ModelRegistry
+
+        root = str(tmp_path / "v1")
+        _save_drill_export(root, rng)
+        fp = BaselineFingerprint(max_features=4)
+        fp.observe_batch(
+            rng.normal(size=(300, 4)), np.zeros(300), shard="s"
+        )
+        fp.save(root)
+        # fingerprint written AFTER the manifest: re-manifest so the
+        # integrity gate covers it (game_train writes it before)
+        from photon_ml_tpu.io.models import write_model_manifest
+
+        write_model_manifest(root)
+        engine = ScoringEngine.from_model_dir(root)
+        assert engine.drift is not None
+        assert engine.drift.baseline.rows == 300
+
+        reg = ModelRegistry(warmup_max_batch=8)
+        reg.load(root)
+        assert reg.current.engine.drift is not None
+        health = reg.health()
+        assert health["drift"]["alarms"] == 0
+        # a second version WITHOUT a fingerprint serves monitorless
+        root2 = str(tmp_path / "v2")
+        _save_drill_export(root2, rng, scale=2.0)
+        reg.load(root2)
+        assert reg.current.engine.drift is None
+        assert reg.health()["drift"] is None
+
+    def test_per_version_score_distribution(self, rng, tmp_path):
+        from photon_ml_tpu.resilience.drills import _save_drill_export
+        from photon_ml_tpu.serving.engine import ScoreRequest
+        from photon_ml_tpu.serving.registry import ModelRegistry
+
+        root = str(tmp_path / "va")
+        _save_drill_export(root, rng)
+        reg = ModelRegistry(warmup_max_batch=8)
+        reg.load(root)
+        reg.score([ScoreRequest(features={"f0": 1.0})] * 4)
+        snap = reg.stats.snapshot()
+        assert snap["score_distribution"]["va"]["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: serve feedback protocol, photon-obs drift + merge folding
+# ---------------------------------------------------------------------------
+
+
+class TestServeFeedback:
+    def test_feedback_quality_drift_commands(self, rng):
+        import io as io_mod
+
+        from photon_ml_tpu.serving.batcher import MicroBatcher
+        from photon_ml_tpu.cli.serve import serve_lines
+
+        engine = _tiny_engine(rng)
+        quality = OnlineQuality(registry=engine.stats.registry)
+        batcher = MicroBatcher(engine.score, max_batch=8, stats=engine.stats)
+        lines = [
+            json.dumps({"cmd": "feedback", "label": 1, "score": 0.7}),
+            json.dumps(
+                {"cmd": "feedback", "label": 0, "score": -0.4,
+                 "weight": 2.0}
+            ),
+            json.dumps({"cmd": "quality"}),
+            json.dumps({"cmd": "feedback", "label": 1}),  # missing score
+            json.dumps({"cmd": "drift"}),  # no registry -> error reply
+        ]
+        out = io_mod.StringIO()
+        serve_lines(lines, out, batcher, quality=quality)
+        batcher.drain()
+        replies = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert replies[0] == {"ok": True, "window_n": 1}
+        assert replies[1] == {"ok": True, "window_n": 2}
+        assert replies[2]["window_n"] == 2
+        assert replies[2]["auc"] == 1.0
+        assert "error" in replies[3]
+        assert "error" in replies[4]
+
+    def test_feedback_without_tracker_replies_error(self, rng):
+        import io as io_mod
+
+        from photon_ml_tpu.serving.batcher import MicroBatcher
+        from photon_ml_tpu.cli.serve import serve_lines
+
+        engine = _tiny_engine(rng)
+        batcher = MicroBatcher(engine.score, max_batch=8, stats=engine.stats)
+        out = io_mod.StringIO()
+        serve_lines(
+            [json.dumps({"cmd": "feedback", "label": 1, "score": 1.0})],
+            out,
+            batcher,
+        )
+        batcher.drain()
+        assert "error" in json.loads(out.getvalue())
+
+
+class TestObsToolsDrift:
+    def _write_fp(self, rng, path, shift=0.0, rows=3000):
+        fp = BaselineFingerprint(max_features=3)
+        fp.observe_batch(
+            rng.normal(size=(rows, 3)) + shift,
+            np.zeros(rows),
+            shard="s",
+            names=["a", "b", "c"],
+        )
+        fp.observe_margins(rng.normal(size=rows) + shift)
+        fp.save(str(path))
+        return str(path)
+
+    def test_drift_quiet_exit_zero(self, rng, tmp_path, capsys):
+        from photon_ml_tpu.cli.obs_tools import main
+
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        a.mkdir()
+        b.mkdir()
+        self._write_fp(rng, a)
+        self._write_fp(rng, b)
+        rc = main(["drift", str(a), str(b)])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(out)
+        assert rc == 0
+        assert rec["metric"] == "drift_psi_max"
+        assert rec["extra"]["alarm"] is False
+
+    def test_drift_alarm_exit_one(self, rng, tmp_path, capsys):
+        from photon_ml_tpu.cli.obs_tools import main
+
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        a.mkdir()
+        b.mkdir()
+        self._write_fp(rng, a)
+        self._write_fp(rng, b, shift=4.0)
+        rc = main(["drift", str(a), str(b)])
+        rec = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert rc == 1
+        assert rec["extra"]["alarm"] is True
+        assert rec["extra"]["flagged"]
+        assert rec["extra"]["margin_psi"] > 0.25
+
+    def test_drift_unreadable_exit_two(self, tmp_path):
+        from photon_ml_tpu.cli.obs_tools import main
+
+        assert main(["drift", str(tmp_path), str(tmp_path)]) == 2
+
+    def test_merge_folds_fingerprints_exactly(self, rng, tmp_path, capsys):
+        """Pod-merged fingerprint == single-pass fingerprint over all
+        hosts' rows — the exact-fold acceptance criterion end to end
+        through the photon-obs merge CLI."""
+        from photon_ml_tpu import obs
+        from photon_ml_tpu.cli.obs_tools import main
+
+        X = rng.normal(size=(900, 3))
+        y = (rng.uniform(size=900) < 0.5).astype(float)
+        single = BaselineFingerprint(max_features=3)
+        single.observe_batch(X, y, shard="s")
+        shard_dirs = []
+        for h, (lo, hi) in enumerate(((0, 300), (300, 620), (620, 900))):
+            d = tmp_path / f"host{h}"
+            with obs.trace(str(d)):
+                pass  # a minimal real trace shard per host
+            part = BaselineFingerprint(max_features=3)
+            part.observe_batch(X[lo:hi], y[lo:hi], shard="s")
+            part.save(str(d))
+            shard_dirs.append(str(d))
+        out_dir = tmp_path / "pod"
+        rc = main(["merge", "--out", str(out_dir), *shard_dirs])
+        assert rc == 0
+        rec = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1]
+        )
+        assert rec["extra"]["fingerprint_shards"] == 3
+        merged = BaselineFingerprint.load(str(out_dir))
+        assert merged.rows == single.rows
+        for j in range(3):
+            np.testing.assert_allclose(
+                merged.shards["s"][j].histogram.counts,
+                single.shards["s"][j].histogram.counts,
+                atol=1e-9,
+            )
+        # and the folded fingerprint is indistinguishable to the
+        # comparer: zero drift against the single-pass one
+        rep = compare_fingerprints(single, merged)
+        assert rep["psi_max"] == pytest.approx(0.0, abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill itself rides tier-1 (quick smoke shape)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_drift_alarm_drill_passes():
+    from photon_ml_tpu.resilience.drills import drill_drift_alarm
+
+    out = drill_drift_alarm(smoke=True)
+    assert out["quiet_checks"] >= 1
+    assert out["alarm_latency_rows"] <= 1024
+    assert out["flight_alarm_records"] >= 1
