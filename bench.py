@@ -1283,44 +1283,14 @@ def bench_sparse():
     }
 
 
-def bench_sparse_feature_scaling(print_json=False):
-    """Feature-sharded sparse solve at d=120k over 1/2/4/8-way 'feature'
-    meshes (virtual CPU devices — the multichip stand-in, VERDICT r3 #1b).
-
-    The bench host exposes ONE physical core, so virtual devices timeshare
-    it and WALL-CLOCK cannot speed up; the honest evidence the curve
-    records instead is (a) wall-clock stays ~flat as the mesh widens —
-    sharding conserves work, no overhead blowup — while (b) per-device
-    solver state (coefficients + gradient + scatter target) shrinks ~1/F
-    (compiled per-device memory from XLA's memory_analysis) and (c) the
-    ONLY collective in the compiled objective pass is one all-reduce of
-    the (n,) margin partials — O(n) bytes per pass, independent of d.
-    On real chips (b)+(c) are what linear scaling in d follows from: the
-    per-pass irregular-access cost is proportional to per-device stored
-    slots, which the curve shows dividing by F."""
-    import jax
+def _fs_scaling_batch():
+    """The d=120k sparse logistic workload shared by the scaling and
+    overlap phases (one builder: the two curves must measure the SAME
+    dataset)."""
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from photon_ml_tpu.core.types import LabeledBatch
-    from photon_ml_tpu.models import (
-        GLMTrainingConfig,
-        OptimizerType,
-        TaskType,
-    )
-    from photon_ml_tpu.ops import RegularizationContext
     from photon_ml_tpu.ops import sparse as sparse_ops
-    from photon_ml_tpu.ops.losses import LOGISTIC_LOSS
-    from photon_ml_tpu.ops.objective import GLMObjective
-    from photon_ml_tpu.parallel import (
-        feature_sharded_train_glm,
-        make_feature_mesh,
-    )
-    from photon_ml_tpu.parallel.mesh import (
-        DATA_AXIS,
-        FEATURE_AXIS,
-        set_mesh,
-    )
 
     n, d, nnz = 60_000, 120_000, 32
     rng = np.random.default_rng(13)
@@ -1335,7 +1305,170 @@ def bench_sparse_feature_scaling(print_json=False):
     y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-logits))).astype(
         np.float32
     )
-    batch = LabeledBatch.create(sf, y, dtype=jnp.float32)
+    return LabeledBatch.create(sf, y, dtype=jnp.float32), n
+
+
+def _fs_compiled_pass(batch, f_shards, mode):
+    """Compile one objective value+grad pass at width ``f_shards`` under
+    ``PHOTON_COLLECTIVE_MODE=mode`` (fused = flat blocked layout +
+    single trailing all-reduce, the PR-5 oracle; overlap = row-balanced
+    layout + chunked reduce-scatter/all-gather pipeline). Returns
+    (compiled, w0, placed batch, blocked container)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from photon_ml_tpu.ops import sparse as sparse_ops
+    from photon_ml_tpu.ops.losses import LOGISTIC_LOSS
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.parallel import make_feature_mesh
+    from photon_ml_tpu.parallel.mesh import (
+        DATA_AXIS,
+        FEATURE_AXIS,
+        set_mesh,
+    )
+    from photon_ml_tpu.parallel.overlap import COLLECTIVE_MODE_ENV
+
+    prev_mode = os.environ.get(COLLECTIVE_MODE_ENV)
+    os.environ[COLLECTIVE_MODE_ENV] = mode
+    try:
+        mesh = make_feature_mesh(1, f_shards)
+        blocked = sparse_ops.shard_columns(
+            batch.features,
+            f_shards,
+            balance_rows=(mode == "overlap" and f_shards > 1),
+        )
+        spec3 = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS, None))
+        spec2 = NamedSharding(mesh, P(None, FEATURE_AXIS))
+        placed = dataclasses.replace(
+            blocked,
+            indices=jax.device_put(blocked.indices, spec3),
+            values=jax.device_put(blocked.values, spec3),
+            row_map=(
+                None
+                if blocked.row_map is None
+                else jax.device_put(blocked.row_map, spec2)
+            ),
+        )
+        w0 = jax.device_put(
+            jnp.zeros((f_shards * blocked.d_shard,), jnp.float32),
+            NamedSharding(mesh, P(FEATURE_AXIS)),
+        )
+        pb = dataclasses.replace(batch, features=placed)
+        obj = GLMObjective(loss=LOGISTIC_LOSS, l2_weight=1.0)
+        with set_mesh(mesh):
+            comp = (
+                jax.jit(lambda w, b: obj.value_and_grad(w, b))
+                .lower(w0, pb)
+                .compile()
+            )
+        return comp, w0, pb, blocked
+    finally:
+        if prev_mode is None:
+            os.environ.pop(COLLECTIVE_MODE_ENV, None)
+        else:
+            os.environ[COLLECTIVE_MODE_ENV] = prev_mode
+
+
+def _best_pass_wall(comp, w0, pb, repeats=3):
+    import jax
+
+    walls = []
+    for _ in range(repeats):
+        tp = time.perf_counter()
+        jax.block_until_ready(comp(w0, pb))
+        walls.append(time.perf_counter() - tp)
+    return min(walls)
+
+
+def bench_overlap(batch=None, floor_wall=None):
+    """Fused-vs-overlap objective-pass walls + ``collective_wall_frac``
+    per mesh width (ISSUE 14): the DIRECT overlap gate. Per width, the
+    pass compiles under both PHOTON_COLLECTIVE_MODE strategies;
+    ``collective_wall_frac`` is the share of the sharded pass wall NOT
+    explained by the width-1 single-device compute floor — partition
+    overhead plus exposed collective wall, exactly what the overlap
+    strategy (row-balanced blocking + chunked reduce-scatter/all-gather)
+    exists to remove. Both series land in the metrics registry as
+    ``collective.overlap.objective_pass.w<W>.wall_frac`` /
+    ``collective.fused.objective_pass.w<W>.wall_frac`` gauges
+    (obs.collectives.record_collective_share) and in the record as
+    sentinel-gated lower-is-better numbers."""
+    from photon_ml_tpu.obs import collectives as obs_coll
+
+    if batch is None:
+        batch, _ = _fs_scaling_batch()
+    if floor_wall is None:
+        comp, w0, pb, _ = _fs_compiled_pass(batch, 1, "overlap")
+        floor_wall = _best_pass_wall(comp, w0, pb)
+    out = {
+        "1": {
+            "floor_pass_ms": round(floor_wall * 1e3, 3),
+        }
+    }
+    for f_shards in (2, 4, 8):
+        row = {}
+        for mode in ("fused", "overlap"):
+            comp, w0, pb, blocked = _fs_compiled_pass(
+                batch, f_shards, mode
+            )
+            wall = _best_pass_wall(comp, w0, pb)
+            frac = obs_coll.record_collective_share(
+                f"{mode}.objective_pass",
+                mesh_width=f_shards,
+                collective_wall_s=max(wall - floor_wall, 0.0),
+                pass_wall_s=wall,
+            )
+            row[f"{mode}_pass_ms"] = round(wall * 1e3, 3)
+            row[
+                "collective_wall_frac"
+                if mode == "overlap"
+                else "collective_wall_frac_fused"
+            ] = round(frac, 4)
+            row[
+                f"slots_m_{mode}"
+            ] = round(int(np.prod(blocked.indices.shape)) / 1e6, 3)
+        log(
+            f"overlap F={f_shards}: fused {row['fused_pass_ms']:.0f}ms "
+            f"(frac {row['collective_wall_frac_fused']}) -> overlap "
+            f"{row['overlap_pass_ms']:.0f}ms "
+            f"(frac {row['collective_wall_frac']})"
+        )
+        out[str(f_shards)] = row
+    return out
+
+
+def bench_sparse_feature_scaling(print_json=False):
+    """Feature-sharded sparse solve at d=120k over 1/2/4/8-way 'feature'
+    meshes (virtual CPU devices — the multichip stand-in, VERDICT r3 #1b),
+    solved under the production overlap strategy
+    (PHOTON_COLLECTIVE_MODE=overlap: row-balanced blocked layout +
+    chunked reduce-scatter/all-gather — docs/PARALLEL.md).
+
+    The bench host exposes ONE physical core, so virtual devices
+    timeshare it and WALL-CLOCK cannot speed up; the honest evidence is
+    (a) wall-clock stays near-flat as the mesh widens (r06's INVERSE
+    curve — 3.8s at width 1, 10.4s at width 8 — was the flat blocked
+    layout's padding inflation plus the trailing fused all-reduce),
+    (b) per-device solver state shrinks ~1/F, and (c) the compiled
+    pass's collective structure is the chunked pipeline whose exposed
+    wall ``bench_overlap`` gates directly via collective_wall_frac.
+    Returns {"widths": per-width rows, "overlap": bench_overlap rows}.
+    """
+    import jax
+
+    from photon_ml_tpu.models import (
+        GLMTrainingConfig,
+        OptimizerType,
+        TaskType,
+    )
+    from photon_ml_tpu.ops import RegularizationContext
+    from photon_ml_tpu.parallel import (
+        feature_sharded_train_glm,
+        make_feature_mesh,
+    )
+
+    batch, n = _fs_scaling_batch()
     cfg = GLMTrainingConfig(
         task=TaskType.LOGISTIC_REGRESSION,
         optimizer=OptimizerType.LBFGS,
@@ -1347,47 +1480,39 @@ def bench_sparse_feature_scaling(print_json=False):
     )
     out = {}
     w_ref = None
+    floor_wall = None
     for f_shards in (1, 2, 4, 8):
         mesh = make_feature_mesh(1, f_shards)
-        # per-device footprint + collectives of ONE objective pass
-        blocked = sparse_ops.shard_columns(batch.features, f_shards)
-        spec = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS, None))
-        placed = sparse_ops.FeatureShardedSparse(
-            indices=jax.device_put(blocked.indices, spec),
-            values=jax.device_put(blocked.values, spec),
-            d_shard=blocked.d_shard,
-            d_orig=blocked.d_orig,
+        # the PRODUCTION pass: overlap strategy (balanced layout +
+        # chunked pipeline); per-device footprint + collectives via the
+        # shared cost book
+        comp, w0, pb, blocked = _fs_compiled_pass(
+            batch, f_shards, "overlap"
         )
-        d_block = f_shards * blocked.d_shard
-        w0 = jax.device_put(
-            jnp.zeros((d_block,), jnp.float32),
-            NamedSharding(mesh, P(FEATURE_AXIS)),
-        )
-        pb = dataclasses.replace(batch, features=placed)
-        obj = GLMObjective(loss=LOGISTIC_LOSS, l2_weight=1.0)
-        # compat wrapper: newer jax exposes jax.set_mesh, 0.4.x spells
-        # it jax.sharding.use_mesh / set_mesh — parallel.mesh bridges
-        with set_mesh(mesh):
-            comp = (
-                jax.jit(lambda w, b: obj.value_and_grad(w, b))
-                .lower(w0, pb)
-                .compile()
-            )
-        # per-device footprint + collective counts via the shared cost
-        # book (memory_analysis + the generalized collective regex that
-        # used to be inlined right here — obs.xla_cost.count_collectives)
         from photon_ml_tpu import obs
 
         rec = obs.cost_book().record(
             "sparse.objective_pass", comp, bucket=f"F{f_shards}"
         )
         colls = rec.collectives
-        # BEFORE/AFTER for the bucketed feature-space reduction (ISSUE 5
-        # satellite: the 2-device regression chase): compile the same
-        # pass with fuse_feature_reductions=False — the one-collective-
-        # per-contraction formulation every round up to r05 ran — and
-        # cost-book it next to the fused record so the collective delta
-        # is machine-readable in the BENCH history
+        pass_wall = _best_pass_wall(comp, w0, pb)
+        if f_shards == 1:
+            floor_wall = pass_wall
+        # the FUSED oracle's collective structure (the PR-5 single
+        # bucketed all-reduce over the flat layout) rides along so the
+        # before/after is machine-readable in the record — and the
+        # legacy unfused (one-collective-per-contraction) count next to
+        # it, as every round since r05 recorded
+        comp_f, w0_f, pb_f, _ = _fs_compiled_pass(
+            batch, f_shards, "fused"
+        )
+        rec_fused = obs.cost_book().record(
+            "sparse.objective_pass_fused", comp_f, bucket=f"F{f_shards}"
+        )
+        from photon_ml_tpu.ops.losses import LOGISTIC_LOSS
+        from photon_ml_tpu.ops.objective import GLMObjective
+        from photon_ml_tpu.parallel.mesh import set_mesh
+
         obj_unfused = GLMObjective(
             loss=LOGISTIC_LOSS, l2_weight=1.0,
             fuse_feature_reductions=False,
@@ -1395,7 +1520,7 @@ def bench_sparse_feature_scaling(print_json=False):
         with set_mesh(mesh):
             comp_unfused = (
                 jax.jit(lambda w, b: obj_unfused.value_and_grad(w, b))
-                .lower(w0, pb)
+                .lower(w0_f, pb_f)
                 .compile()
             )
         rec_unfused = obs.cost_book().record(
@@ -1403,21 +1528,6 @@ def bench_sparse_feature_scaling(print_json=False):
             comp_unfused,
             bucket=f"F{f_shards}",
         )
-        colls_unfused = rec_unfused.collectives
-        # collective profiler (obs.collectives): the in-solve psums have
-        # no per-execution host seam, so wall time is recorded at the
-        # dispatch granularity that CONTAINS them — one blocked
-        # execution of the compiled objective pass per mesh width (best
-        # of 3, first run warms buffer donation). For F=1 the same
-        # measurement is the collective-free baseline; the F>=2 deltas
-        # are the per-pass communication price the ROADMAP item-4
-        # overlap work must hide.
-        pass_walls = []
-        for _ in range(3):
-            tp = time.perf_counter()
-            jax.block_until_ready(comp(w0, pb))
-            pass_walls.append(time.perf_counter() - tp)
-        pass_wall = min(pass_walls)
         from photon_ml_tpu.obs import collectives as obs_coll
 
         obs_coll.record_collective(
@@ -1427,10 +1537,21 @@ def bench_sparse_feature_scaling(print_json=False):
             nbytes=n * 4,  # the (n,) f32 margin-partials payload
             wall_s=pass_wall,
         )
-        t0 = time.perf_counter()
-        (tm,) = feature_sharded_train_glm(batch, cfg, mesh)
-        w_sol = np.asarray(tm.model.coefficients.means)
-        wall = time.perf_counter() - t0
+        # the solve itself (compile incl.), overlap strategy
+        from photon_ml_tpu.parallel.overlap import COLLECTIVE_MODE_ENV
+
+        prev_mode = os.environ.get(COLLECTIVE_MODE_ENV)
+        os.environ[COLLECTIVE_MODE_ENV] = "overlap"
+        try:
+            t0 = time.perf_counter()
+            (tm,) = feature_sharded_train_glm(batch, cfg, mesh)
+            w_sol = np.asarray(tm.model.coefficients.means)
+            wall = time.perf_counter() - t0
+        finally:
+            if prev_mode is None:
+                os.environ.pop(COLLECTIVE_MODE_ENV, None)
+            else:
+                os.environ[COLLECTIVE_MODE_ENV] = prev_mode
         if w_ref is None:
             w_ref = w_sol
         drift = float(np.max(np.abs(w_sol - w_ref)))
@@ -1441,37 +1562,48 @@ def bench_sparse_feature_scaling(print_json=False):
                 (rec.argument_bytes or 0) / 1e6, 2
             ),
             "per_device_temp_mb": round((rec.temp_bytes or 0) / 1e6, 2),
-            "per_device_coef_kb": round(d_block / f_shards * 4 / 1e3, 1),
+            "per_device_coef_kb": round(
+                f_shards * blocked.d_shard / f_shards * 4 / 1e3, 1
+            ),
             "per_device_slots_m": round(per_dev_slots / 1e6, 3),
-            "collectives": dict(colls),
-            "collectives_unfused": dict(colls_unfused),
-            "collective_count": int(sum(colls.values())),
+            # the fused oracle's count (the PR-5 single all-reduce) keeps
+            # its historical key; the overlap pipeline's richer structure
+            # (C reduce-scatter-shaped chunk reductions + gathers) is
+            # DELIBERATE and recorded separately
+            "collectives": dict(rec_fused.collectives),
+            "collectives_overlap": dict(colls),
+            "collectives_unfused": dict(rec_unfused.collectives),
+            "collective_count": int(sum(rec_fused.collectives.values())),
             "collective_wall_ms": round(pass_wall * 1e3, 3),
             "max_dw_vs_1dev": round(drift, 8),
         }
         log(
             f"sparse scaling F={f_shards}: wall {wall:.2f}s "
-            f"(compile incl.), per-dev arg {out[str(f_shards)]['per_device_arg_mb']} MB, "
-            f"coef {out[str(f_shards)]['per_device_coef_kb']} KB, "
+            f"(compile incl.), per-dev arg "
+            f"{out[str(f_shards)]['per_device_arg_mb']} MB, "
             f"slots {out[str(f_shards)]['per_device_slots_m']}M, "
-            f"collectives {dict(colls)} (unfused: {dict(colls_unfused)}), "
+            f"overlap colls {dict(colls)} (fused oracle: "
+            f"{dict(rec_fused.collectives)}), "
             f"pass {pass_wall * 1e3:.1f}ms, max|dw|={drift:.1e}"
         )
-    # sentinel-gated scaling efficiency (ROADMAP item 4):
+    # sentinel-gated scaling efficiency (ROADMAP item 1):
     # wall_1dev / (N * wall_Ndev) — 1.0 is perfect linear scaling; on
     # this timeshared-CPU stand-in wall stays ~flat so ~1/N is the
-    # honest ceiling. The sentinel holds an absolute floor per width
-    # (obs.sentinel.metric_floor) on top of the history band, so a
-    # future change that re-breaks 2-device scaling fails the gate.
+    # honest ceiling. The sentinel holds RAISED absolute floors per
+    # width (obs.sentinel._SCALING_FLOORS) on top of the history band.
     wall_1 = out["1"]["wall_s"]
     for f_str, row in out.items():
         f = int(f_str)
         row["scaling_efficiency"] = round(
             wall_1 / (f * row["wall_s"]), 4
         )
+    # fused-vs-overlap pass walls + collective_wall_frac per width (the
+    # bench_overlap phase, sharing this phase's dataset + floor)
+    overlap = bench_overlap(batch=batch, floor_wall=floor_wall)
+    result = {"widths": out, "overlap": overlap}
     if print_json:
-        print(json.dumps(out))
-    return out
+        print(json.dumps(result))
+    return result
 
 
 def bench_sparse_kernel_passes():
@@ -2270,7 +2402,13 @@ def main():
             game_multi["iters_per_s"] / game_multi_cpu["iters_per_s"], 3
         )
     if sparse_scaling:
-        extra["sparse_fs_scaling"] = sparse_scaling
+        # {"widths": per-width rows, "overlap": bench_overlap rows} since
+        # r07 (bare per-width rows before)
+        if "widths" in sparse_scaling:
+            extra["sparse_fs_scaling"] = sparse_scaling["widths"]
+            extra["bench_overlap"] = sparse_scaling["overlap"]
+        else:
+            extra["sparse_fs_scaling"] = sparse_scaling
     if ingest_pipe:
         # the HEADLINE ingest number is now the pipelined decode on the
         # same smoke workload (sharded across part files); the one-shot

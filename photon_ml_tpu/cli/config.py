@@ -166,10 +166,23 @@ class GLMDriverParams:
     # and export <output_dir>/quality-fingerprint.json — the baseline
     # `photon-obs drift` and the serving DriftMonitor compare against
     quality_fingerprint: bool = True
+    # collective reduction strategy for mesh solves (docs/PARALLEL.md):
+    # None = the PHOTON_COLLECTIVE_MODE env default ("overlap":
+    # row-balanced blocking + chunked reduce-scatter/all-gather
+    # pipeline); "fused" = the PR-5 single trailing all-reduce oracle
+    collective_mode: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input:
             raise ValueError("train_input is required")
+        if self.collective_mode is not None and self.collective_mode not in (
+            "fused",
+            "overlap",
+        ):
+            raise ValueError(
+                f"collective_mode must be 'fused' or 'overlap', got "
+                f"{self.collective_mode!r}"
+            )
         if self.model_output_mode not in MODEL_OUTPUT_MODES:
             raise ValueError(
                 f"model_output_mode must be one of {MODEL_OUTPUT_MODES}"
@@ -453,12 +466,59 @@ class GameDriverParams:
     # export subdir (next to model-manifest.json, manifest-covered) —
     # the baseline the serving DriftMonitor hot-loads with the model
     quality_fingerprint: bool = True
+    # entity-sharded GAME descent (docs/PARALLEL.md): shard the random-
+    # effect table, its bucket lanes, and the (entity-partitioned) row
+    # space over an N-device 'entity' mesh via shard_map — zero
+    # collectives in the random-effect update; only the fixed-effect
+    # coordinate reduces. 0/1 = off. Requires exactly one PLAIN
+    # (identity, dense-shard) random-effect coordinate; ownership
+    # follows the sharded-checkpoint round-robin rule, so --sharded-ckpt
+    # composes entity-keyed (restore at any width re-keys rows).
+    entity_shards: int = 0
+    # collective reduction strategy (docs/PARALLEL.md): None = the
+    # PHOTON_COLLECTIVE_MODE env default ("overlap": row-balanced
+    # blocking + chunked reduce-scatter/all-gather pipeline); "fused" =
+    # the PR-5 single trailing all-reduce, kept as the equivalence
+    # oracle
+    collective_mode: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input:
             raise ValueError("train_input is required")
         if not self.updating_sequence:
             raise ValueError("updating_sequence is required")
+        if self.collective_mode is not None and self.collective_mode not in (
+            "fused",
+            "overlap",
+        ):
+            raise ValueError(
+                f"collective_mode must be 'fused' or 'overlap', got "
+                f"{self.collective_mode!r}"
+            )
+        if self.entity_shards < 0:
+            raise ValueError(
+                f"entity_shards must be >= 0, got {self.entity_shards}"
+            )
+        if self.entity_shards > 1:
+            plain_res = [
+                n
+                for n, c in self.coordinates.items()
+                if c.random_effect is not None
+                and c.latent_dim is None
+                and not c.projector
+                and c.shard not in set(self.sparse_shards)
+            ]
+            other_res = [
+                n
+                for n, c in self.coordinates.items()
+                if c.random_effect is not None and n not in plain_res
+            ]
+            if len(plain_res) != 1 or other_res:
+                raise ValueError(
+                    "entity_shards requires exactly one PLAIN random-"
+                    "effect coordinate (identity projector, dense "
+                    f"shard); got plain={plain_res} other={other_res}"
+                )
         sparse = set(self.sparse_shards)
         for name, spec in self.coordinates.items():
             uses_sparse = spec.shard in sparse

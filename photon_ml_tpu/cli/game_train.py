@@ -190,6 +190,7 @@ def build_coordinates(
     shard_vocabs: Optional[Dict[str, FeatureVocabulary]] = None,
     design_cache: Optional[Dict[str, object]] = None,
     multiproc: Optional[dict] = None,
+    entity_sharded: Optional[dict] = None,
 ):
     """One training coordinate per updating-sequence entry.
 
@@ -200,7 +201,14 @@ def build_coordinates(
     ``multiproc`` (multi-process runs): {"mesh", "row_base",
     "entity_spaces": re -> (E_global, entity_base),
     "local_entity_counts"} — local builds are globalized into
-    mesh-spanning arrays (``parallel.multihost``)."""
+    mesh-spanning arrays (``parallel.multihost``).
+
+    ``entity_sharded`` (docs/PARALLEL.md): {"mesh", "assignment",
+    "partition"} — ``data`` is already in the entity-PARTITIONED row
+    order; fixed-effect batches place row-sharded over the 'entity'
+    mesh and the (single, plain) random-effect coordinate builds as an
+    :class:`EntityShardedRandomEffectCoordinate` (zero collectives in
+    its update)."""
     coords = {}
     for name in params.updating_sequence:
         spec = params.coordinates[name]
@@ -229,6 +237,16 @@ def build_coordinates(
                 from photon_ml_tpu.parallel import make_global_batch
 
                 fe_batch = make_global_batch(fe_batch, multiproc["mesh"])
+            if entity_sharded is not None:
+                from photon_ml_tpu.parallel.mesh import batch_sharding
+
+                _mesh = entity_sharded["mesh"]
+                fe_batch = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(
+                        x, batch_sharding(_mesh, np.ndim(x))
+                    ),
+                    fe_batch,
+                )
             coords[name] = FixedEffectCoordinate(
                 fe_batch, cfg, hybrid_pack=hybrid_pack
             )
@@ -360,13 +378,29 @@ def build_coordinates(
                 else ("IDENTITY", None)
             )
             if kind == "IDENTITY":
-                coords[name] = RandomEffectCoordinate(
-                    design=design,
-                    row_features=row_features,
-                    row_entities=row_entities,
-                    full_offsets_base=offsets_base,
-                    config=cfg,
-                )
+                if entity_sharded is not None:
+                    from photon_ml_tpu.game import (
+                        EntityShardedRandomEffectCoordinate,
+                    )
+
+                    coords[name] = EntityShardedRandomEffectCoordinate(
+                        design=design,
+                        row_features=row_features,
+                        row_entities=row_entities,
+                        full_offsets_base=offsets_base,
+                        config=cfg,
+                        mesh=entity_sharded["mesh"],
+                        assignment=entity_sharded["assignment"],
+                        partition=entity_sharded["partition"],
+                    )
+                else:
+                    coords[name] = RandomEffectCoordinate(
+                        design=design,
+                        row_features=row_features,
+                        row_entities=row_entities,
+                        full_offsets_base=offsets_base,
+                        config=cfg,
+                    )
             else:
                 d_orig = data.features[spec.shard].shape[1]
                 cache_key = f"{name}\x00projected"
@@ -414,15 +448,20 @@ def build_coordinates(
 def materialize_original_space(model: GameModel, coords: Dict) -> GameModel:
     """Back-project any projected coordinate's table so the model is in
     original feature space (``RandomEffectModelInProjectedSpace.scala:31-97``
-    — persistence and scoring never see projected coefficients)."""
-    params = {
-        n: (
-            coords[n].back_project(p)
-            if isinstance(coords.get(n), ProjectedRandomEffectCoordinate)
-            else p
-        )
-        for n, p in model.params.items()
-    }
+    — persistence and scoring never see projected coefficients), and
+    bridge entity-SHARDED tables from their stored (shard-major, padded)
+    layout back to the global entity order (docs/PARALLEL.md)."""
+    from photon_ml_tpu.game import EntityShardedRandomEffectCoordinate
+
+    def bridge(n, p):
+        c = coords.get(n)
+        if isinstance(c, ProjectedRandomEffectCoordinate):
+            return c.back_project(p)
+        if isinstance(c, EntityShardedRandomEffectCoordinate):
+            return jnp.asarray(c.global_table(p))
+        return p
+
+    params = {n: bridge(n, p) for n, p in model.params.items()}
     return dataclasses.replace(model, params=params)
 
 
@@ -487,6 +526,12 @@ def run_game_training(params) -> GameTrainingRun:
     prev_resilience = configure_collective_resilience(
         timeout_s=params.collective_timeout_s
     )
+    # collective strategy (docs/PARALLEL.md): trace-time env state —
+    # pin process-wide before any solve traces
+    if params.collective_mode is not None:
+        from photon_ml_tpu.parallel.overlap import COLLECTIVE_MODE_ENV
+
+        os.environ[COLLECTIVE_MODE_ENV] = params.collective_mode
     monitor = None
     if params.heartbeat_s > 0:
         monitor = HeartbeatMonitor(interval_s=params.heartbeat_s).start()
@@ -738,6 +783,61 @@ def _run_game_training(
             )
             logger.info(f"read {len(vdata.labels)} validation records")
 
+    # ---- entity-sharded layout (docs/PARALLEL.md) -----------------------
+    entity_sharded = None
+    if params.entity_shards > 1:
+        if multi:
+            raise ValueError(
+                "entity_shards is the single-process entity mesh; "
+                "multi-process runs shard entities via the multiproc "
+                "path (one process per host)"
+            )
+        if params.entity_shards > jax.device_count():
+            raise ValueError(
+                f"entity_shards={params.entity_shards} exceeds "
+                f"{jax.device_count()} visible devices"
+            )
+        from photon_ml_tpu.game import (
+            entity_partition_game_data,
+            entity_shard_assignment,
+        )
+        from photon_ml_tpu.parallel.mesh import make_entity_mesh
+
+        re_name = next(
+            n
+            for n, c in params.coordinates.items()
+            if c.random_effect is not None
+        )
+        re_key = params.coordinates[re_name].random_effect
+        es_mesh = make_entity_mesh(
+            params.entity_shards,
+            devices=jax.devices()[: params.entity_shards],
+        )
+        es_assignment = entity_shard_assignment(
+            entity_counts[re_key], params.entity_shards
+        )
+        from photon_ml_tpu import obs as _obs_mod
+
+        with _obs_mod.span(
+            "partition.entity_layout", cat="partition",
+            shards=params.entity_shards,
+            entities=entity_counts[re_key],
+        ):
+            data, es_partition = entity_partition_game_data(
+                data, re_key, es_assignment
+            )
+        entity_sharded = {
+            "mesh": es_mesh,
+            "assignment": es_assignment,
+            "partition": es_partition,
+        }
+        logger.info(
+            f"entity-sharded descent: {params.entity_shards} shards, "
+            f"{es_assignment.rows_per_shard} entities/shard, "
+            f"{es_partition.rows_per_shard} rows/shard "
+            f"(padded from {es_partition.row_perm.size} stored rows)"
+        )
+
     # ---- grid sweep ------------------------------------------------------
     shards_by_coord = {
         n: params.coordinates[n].shard for n in params.updating_sequence
@@ -760,6 +860,13 @@ def _run_game_training(
             ordered = [None] * len(vocab)
             for raw, i in vocab.items():
                 ordered[i] = raw
+            if entity_sharded is not None:
+                # the device table is stored SHARD-MAJOR (padded); label
+                # its rows in that order so checkpoint shards carry the
+                # keys the restore re-keys by (pad rows keyed uniquely)
+                ordered = entity_sharded[
+                    "assignment"
+                ].stored_entity_keys(ordered)
             ckpt_entity_keys[n] = ordered
 
     def validation_metric(model: GameModel) -> float:
@@ -819,6 +926,7 @@ def _run_game_training(
 
     vmappable = (
         len(grid_combos) > 1
+        and params.entity_shards <= 1
         and vdata is None
         and not warm_params
         and params.checkpoint_every <= 0
@@ -885,7 +993,7 @@ def _run_game_training(
             coords = build_coordinates(
                 params, data, task, combo, entity_counts, dtype=dtype,
                 shard_vocabs=shard_vocabs, design_cache=design_cache,
-                multiproc=multiproc,
+                multiproc=multiproc, entity_sharded=entity_sharded,
             )
             initial_model = None
             if warm_params:
@@ -893,9 +1001,28 @@ def _run_game_training(
                 for n in params.updating_sequence:
                     p = warm_params.get(n)
                     coord = coords[n]
+                    from photon_ml_tpu.game import (
+                        EntityShardedRandomEffectCoordinate as _ESRE,
+                    )
+
                     plain_coord = not isinstance(
                         coord, ProjectedRandomEffectCoordinate
                     ) and not hasattr(coord, "factored")
+                    if (
+                        p is not None
+                        and not hasattr(p, "gamma")
+                        and isinstance(coord, _ESRE)
+                    ):
+                        # global-order saved table -> stored shard-major
+                        # layout, placed entity-sharded
+                        stored = coord.assignment.table_from_global(
+                            np.asarray(p, dtype)
+                        )
+                        init[n] = jax.device_put(
+                            jnp.asarray(stored),
+                            coord.initial_params().sharding,
+                        )
+                        continue
                     if p is not None and not hasattr(p, "gamma") and plain_coord:
                         init[n] = jnp.asarray(np.asarray(p), dtype)
                         continue
@@ -929,6 +1056,16 @@ def _run_game_training(
                 labels_arr = _mk(data.labels)
                 offsets_arr = _mk(data.offsets)
                 weights_arr = _mk(data.weights)
+            elif entity_sharded is not None:
+                from photon_ml_tpu.parallel.mesh import batch_sharding
+
+                _mesh = entity_sharded["mesh"]
+                _put = lambda x: jax.device_put(
+                    jnp.asarray(x, dtype), batch_sharding(_mesh, 1)
+                )
+                labels_arr = _put(data.labels)
+                offsets_arr = _put(data.offsets)
+                weights_arr = _put(data.weights)
             else:
                 labels_arr = jnp.asarray(data.labels, dtype)
                 offsets_arr = jnp.asarray(data.offsets, dtype)
@@ -1286,6 +1423,20 @@ def main(argv=None) -> None:
         "(quality-fingerprint.json in every export subdir — the "
         "serving drift-detection baseline; docs/OBSERVABILITY.md)",
     )
+    p.add_argument(
+        "--entity-shards", type=int, default=None,
+        help="entity-sharded GAME descent over an N-device 'entity' "
+        "mesh (shard_map: the random-effect table, bucket lanes, and "
+        "entity-partitioned rows all shard; ZERO collectives in the "
+        "random-effect update — docs/PARALLEL.md). 0/1 = off",
+    )
+    p.add_argument(
+        "--collective-mode", choices=("fused", "overlap"), default=None,
+        help="collective reduction strategy (docs/PARALLEL.md): "
+        "'overlap' (default) = row-balanced blocking + chunked "
+        "reduce-scatter/all-gather pipeline; 'fused' = the single "
+        "trailing all-reduce equivalence oracle",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1337,6 +1488,10 @@ def main(argv=None) -> None:
         base["sharded_ckpt"] = args.sharded_ckpt
     if args.quality_fingerprint is not None:
         base["quality_fingerprint"] = args.quality_fingerprint
+    if args.entity_shards is not None:
+        base["entity_shards"] = args.entity_shards
+    if args.collective_mode is not None:
+        base["collective_mode"] = args.collective_mode
     try:
         run_game_training(base)
     except BaseException as e:

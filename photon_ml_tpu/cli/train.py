@@ -195,6 +195,13 @@ def run_glm_training(params) -> GLMTrainingRun:
     prev_resilience = configure_collective_resilience(
         timeout_s=params.collective_timeout_s
     )
+    # collective strategy (docs/PARALLEL.md): the knob is trace-time
+    # env state (ops.sparse reads it while building mesh reductions), so
+    # the driver pins it process-wide before any solve traces
+    if params.collective_mode is not None:
+        from photon_ml_tpu.parallel.overlap import COLLECTIVE_MODE_ENV
+
+        os.environ[COLLECTIVE_MODE_ENV] = params.collective_mode
     monitor = None
     if params.heartbeat_s > 0:
         monitor = HeartbeatMonitor(interval_s=params.heartbeat_s).start()
@@ -764,6 +771,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "point this driver reaches (parity with game_train; the GLM "
         "path itself has no mid-run checkpoint cadence yet — "
         "docs/MULTIHOST.md)",
+    )
+    p.add_argument(
+        "--collective-mode", dest="collective_mode",
+        choices=("fused", "overlap"), default=None,
+        help="collective reduction strategy for mesh solves "
+        "(docs/PARALLEL.md): 'overlap' (default) row-balances blocked "
+        "sparse designs and chunks the feature-space reduction into a "
+        "reduce-scatter/all-gather pipeline that hides under the next "
+        "row block's compute; 'fused' pins the single trailing "
+        "all-reduce — the equivalence oracle",
     )
     return p
 

@@ -24,13 +24,19 @@ Architecture vs the reference:
 
 from photon_ml_tpu.game.data import (
     BucketedRandomEffectDesign,
+    EntityRowPartition,
+    EntityShardAssignment,
     GameData,
     RandomEffectDesign,
     build_bucketed_random_effect_design,
     build_random_effect_design,
+    entity_partition_game_data,
+    entity_partition_rows,
+    entity_shard_assignment,
 )
 from photon_ml_tpu.game.coordinates import (
     CoordinateConfig,
+    EntityShardedRandomEffectCoordinate,
     FixedEffectCoordinate,
     RandomEffectCoordinate,
 )
@@ -61,8 +67,14 @@ __all__ = [
     "build_random_effect_design",
     "build_bucketed_random_effect_design",
     "CoordinateConfig",
+    "EntityRowPartition",
+    "EntityShardAssignment",
+    "EntityShardedRandomEffectCoordinate",
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",
     "CoordinateDescent",
     "GameModel",
+    "entity_partition_game_data",
+    "entity_partition_rows",
+    "entity_shard_assignment",
 ]

@@ -787,6 +787,359 @@ class RandomEffectCoordinate:
         return jnp.sum(0.5 * l2 * sq + l1 * ab)
 
 
+class EntityShardedRandomEffectCoordinate:
+    """Entity-sharded random-effect coordinate: the per-entity vmapped
+    solves run under ``shard_map`` over the 'entity' mesh axis with ZERO
+    collectives in the update (docs/PARALLEL.md) — each shard gathers
+    warm starts from ITS table block, solves ITS entities, scatters back
+    locally, and rescores ITS rows. Only the fixed-effect coordinate's
+    objective reduces across devices.
+
+    Contract (``game.data``): entity ownership follows the sharded
+    checkpoint writer's round-robin rule (``EntityShardAssignment``),
+    the table is stored SHARD-MAJOR (pad rows zero), and the batch row
+    space is entity-PARTITIONED (``EntityRowPartition``) so every
+    entity's rows live on its owner shard — the device analog of the
+    reference's ``RandomEffectIdPartitioner`` placement. All per-row
+    inputs here are in the PERMUTED row order; sentinel lanes/rows mask
+    to zero and their scattered solutions drop.
+
+    Exposes the full fused surface (update_step / fused_state /
+    with_fused_state / wrap_tracker), so whole-pass and superpass
+    dispatches compose — the shard_map nests inside the pass jit.
+    """
+
+    def __init__(
+        self,
+        design,  # BucketedRandomEffectDesign on the PERMUTED rows, GLOBAL ids
+        row_features: jax.Array,  # (n_pad, d) permuted
+        row_entities: jax.Array,  # (n_pad,) permuted GLOBAL ids, -1 unknown
+        full_offsets_base: jax.Array,  # (n_pad,) permuted
+        config: CoordinateConfig,
+        mesh,
+        assignment,  # game.data.EntityShardAssignment
+        partition,  # game.data.EntityRowPartition
+        reg_weights: Optional[jax.Array] = None,  # (E,) GLOBAL order
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.game.data import BucketedRandomEffectDesign
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, shard_map
+
+        if config.random_effect is None:
+            raise ValueError("config lacks random_effect; wrong coordinate")
+        if isinstance(design, RandomEffectDesign):
+            design = BucketedRandomEffectDesign(
+                buckets=[design],
+                entity_index=[
+                    np.arange(design.num_entities, dtype=np.int32)
+                ],
+                num_entities=design.num_entities,
+            )
+        n_shards = mesh.shape[ENTITY_AXIS]
+        if assignment.num_shards != n_shards:
+            raise ValueError(
+                f"assignment built for {assignment.num_shards} shards, "
+                f"mesh 'entity' axis has {n_shards}"
+            )
+        if partition.num_shards != n_shards:
+            raise ValueError(
+                f"row partition built for {partition.num_shards} shards, "
+                f"mesh 'entity' axis has {n_shards}"
+            )
+        e_global = assignment.num_entities
+        if design.num_entities != e_global:
+            raise ValueError(
+                f"design covers {design.num_entities} entities, "
+                f"assignment {e_global}"
+            )
+        b_rows = assignment.rows_per_shard
+        r_rows = partition.rows_per_shard
+        n_pad = partition.padded_rows
+        if int(np.shape(row_entities)[0]) != n_pad:
+            raise ValueError(
+                f"row arrays must be in the partitioned row space "
+                f"({n_pad} rows), got {np.shape(row_entities)[0]}"
+            )
+        self.config = config
+        self.mesh = mesh
+        self.assignment = assignment
+        self.partition = partition
+        self.design = design
+
+        ent_spec = lambda nd: NamedSharding(
+            mesh, P(ENTITY_AXIS, *([None] * (nd - 1)))
+        )
+
+        def place(x):
+            x = jnp.asarray(x)
+            return jax.device_put(x, ent_spec(x.ndim))
+
+        # per-entity reg weights, stored shard-major (pad rows keep the
+        # config weight — their scattered solutions drop anyway)
+        self._uniform_reg = reg_weights is None
+        if reg_weights is None:
+            reg_stored = np.full(
+                (assignment.padded_rows,), config.reg_weight, np.float32
+            )
+        else:
+            reg_weights = np.asarray(reg_weights, np.float32)
+            if reg_weights.shape != (e_global,):
+                raise ValueError(
+                    f"reg_weights must be ({e_global},), got "
+                    f"{reg_weights.shape}"
+                )
+            reg_stored = assignment.table_from_global(reg_weights)
+        self.reg_weights = place(reg_stored)
+
+        # regroup every bucket's lanes by owner shard: shard p's lanes
+        # contiguous, padded to the max per-shard count; indices go
+        # shard-LOCAL (table rows within the block, sentinel b_rows;
+        # offset rows within the block, sentinel -1)
+        g2s = assignment.global_to_stored
+        buckets = []
+        eidx_local = []
+        self._valid_lanes = []
+        self._lane_entities = []
+        for bucket, eidx in zip(design.buckets, design.entity_index):
+            eidx = np.asarray(eidx, np.int64)
+            stored = np.where(
+                eidx < e_global, g2s[np.minimum(eidx, e_global)],
+                assignment.padded_rows,
+            )
+            owner = assignment.shard_of_stored(
+                np.minimum(stored, assignment.padded_rows - 1)
+            )
+            owner = np.where(
+                stored < assignment.padded_rows, owner, 0
+            )  # sentinels balance onto shard 0's padding
+            counts = np.bincount(owner, minlength=n_shards)
+            l_b = max(int(counts.max()), 1)
+            order = np.argsort(owner, kind="stable")
+            starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+            slot = np.arange(eidx.size) - starts[owner[order]]
+            lane_of = owner[order] * l_b + slot  # new lane of old lane
+            new_lanes = n_shards * l_b
+            new_stored = np.full(new_lanes, assignment.padded_rows, np.int64)
+            new_stored[lane_of] = stored[order]
+            local = np.where(
+                new_stored < assignment.padded_rows,
+                new_stored - (np.arange(new_lanes) // l_b) * b_rows,
+                b_rows,
+            ).astype(np.int32)
+
+            def regroup(x, fill=0.0):
+                x = np.asarray(x)
+                out = np.full(
+                    (new_lanes,) + x.shape[1:], fill, x.dtype
+                )
+                out[lane_of] = x[order]
+                return out
+
+            ri = np.asarray(bucket.row_index, np.int64)
+            shard_of_lane = np.arange(new_lanes) // l_b
+            ri_new = regroup(ri, fill=-1)
+            ri_local = np.where(
+                ri_new >= 0,
+                ri_new - shard_of_lane[:, None] * r_rows,
+                -1,
+            ).astype(np.int32)
+            buckets.append(
+                RandomEffectDesign(
+                    features=place(regroup(bucket.features)),
+                    labels=place(regroup(bucket.labels)),
+                    weights=place(regroup(bucket.weights)),
+                    mask=place(regroup(bucket.mask)),
+                    row_index=place(ri_local),
+                )
+            )
+            eidx_local.append(place(local))
+            self._valid_lanes.append(
+                new_stored < assignment.padded_rows
+            )
+            glob = np.full(new_lanes, e_global, np.int64)
+            real = new_stored < assignment.padded_rows
+            glob[real] = assignment.stored_to_global[new_stored[real]]
+            self._lane_entities.append(glob.astype(np.int32))
+        self._buckets = tuple(buckets)
+        self._entity_indices = tuple(eidx_local)
+
+        # per-row scoring inputs, shard-local entity rows
+        re_ids = np.asarray(row_entities, np.int64)
+        known = re_ids >= 0
+        ents_local = np.full(re_ids.shape, -1, np.int32)
+        shard_of_row = np.arange(n_pad) // r_rows
+        ents_local[known] = (
+            g2s[re_ids[known]] - shard_of_row[known] * b_rows
+        ).astype(np.int32)
+        self.row_features = place(row_features)
+        self.row_entities_local = place(ents_local)
+        self.full_offsets_base = place(full_offsets_base)
+
+        solve = _make_solve(config, batched=True)
+        from photon_ml_tpu.solvers.common import final_grad_norm
+
+        spec_of = lambda x: P(ENTITY_AXIS, *([None] * (jnp.ndim(x) - 1)))
+
+        def update_all(table, reg, offsets, eidx, buckets_in, feats, ents):
+            def update_shard(
+                table_blk, reg_blk, off_blk, eidx_blk, bks, f_blk, e_blk
+            ):
+                trackers = []
+                for li, bucket in zip(eidx_blk, bks):
+                    offs = bucket.gather_offsets(off_blk)
+                    w0 = jnp.take(table_blk, li, axis=0, mode="clip")
+                    lam = jnp.take(reg_blk, li, mode="clip")
+                    result = solve(
+                        w0, lam, bucket.features, bucket.labels, offs,
+                        bucket.weights, bucket.mask,
+                    )
+                    table_blk = table_blk.at[li].set(
+                        result.w, mode="drop"
+                    )
+                    trackers.append(
+                        (
+                            result.reason,
+                            result.iterations,
+                            final_grad_norm(result),
+                        )
+                    )
+                scores = _score_rows_by_entity(table_blk, f_blk, e_blk)
+                return table_blk, tuple(trackers), scores
+
+            args = (table, reg, offsets, eidx, buckets_in, feats, ents)
+            in_specs = jax.tree_util.tree_map(spec_of, args)
+            out_shape = jax.eval_shape(
+                lambda *a: update_shard(*a), *args
+            )
+            out_specs = jax.tree_util.tree_map(spec_of, out_shape)
+            return shard_map(
+                update_shard,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                # per-shard solver while_loops have no replication rule;
+                # every output is genuinely shard-varying anyway
+                check_rep=False,
+            )(*args)
+
+        self._update_all = jax.jit(update_all)
+
+        def score_fn(table, feats, ents):
+            args = (table, feats, ents)
+            in_specs = jax.tree_util.tree_map(spec_of, args)
+            return shard_map(
+                _score_rows_by_entity,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=P(ENTITY_AXIS),
+                check_rep=False,
+            )(*args)
+
+        self._score = jax.jit(score_fn)
+
+    @property
+    def num_entities(self) -> int:
+        return self.assignment.num_entities
+
+    @property
+    def dim(self) -> int:
+        return self.design.dim
+
+    def initial_params(self) -> jax.Array:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.models.training import solve_dtype
+        from photon_ml_tpu.parallel.mesh import ENTITY_AXIS
+
+        return jax.device_put(
+            jnp.zeros(
+                (self.assignment.padded_rows, self.dim),
+                solve_dtype(self._buckets[0]),
+            ),
+            NamedSharding(self.mesh, P(ENTITY_AXIS, None)),
+        )
+
+    def global_table(self, table: jax.Array) -> np.ndarray:
+        """Stored (shard-major, padded) table -> global entity order —
+        the equivalence bridge to an unsharded RandomEffectCoordinate."""
+        return self.assignment.table_to_global(np.asarray(table))
+
+    def update(self, table, partial_scores, key=None):
+        table, summary, _ = self.update_and_score(
+            table, partial_scores, key=key
+        )
+        return table, summary
+
+    def update_and_score(self, table, partial_scores, key=None):
+        table, trackers, scores = self.update_step(
+            table, partial_scores, key
+        )
+        return table, self.wrap_tracker(trackers), scores
+
+    def update_step(self, table, partial_scores, key=None):
+        """Trace-safe: the whole multi-bucket update + rescore is ONE
+        shard_map'd program with no collective instructions (asserted in
+        tests/test_partition.py via the compiled HLO)."""
+        return self._update_all(
+            table,
+            self.reg_weights,
+            self.full_offsets_base + partial_scores,
+            self._entity_indices,
+            self._buckets,
+            self.row_features,
+            self.row_entities_local,
+        )
+
+    def wrap_tracker(self, trackers: tuple) -> "RandomEffectUpdateSummary":
+        pending = [
+            (reason, iters, gnorm, valid, ents)
+            for (reason, iters, gnorm), valid, ents in zip(
+                trackers, self._valid_lanes, self._lane_entities
+            )
+        ]
+        return RandomEffectUpdateSummary(pending=pending)
+
+    def fused_state(self):
+        """See ``FixedEffectCoordinate.fused_state``."""
+        return (
+            self.reg_weights,
+            self.full_offsets_base,
+            self._entity_indices,
+            self._buckets,
+            self.row_features,
+            self.row_entities_local,
+        )
+
+    def with_fused_state(self, state):
+        import copy
+
+        c = copy.copy(self)
+        (
+            c.reg_weights,
+            c.full_offsets_base,
+            c._entity_indices,
+            c._buckets,
+            c.row_features,
+            c.row_entities_local,
+        ) = state
+        return c
+
+    def score(self, table: jax.Array) -> jax.Array:
+        return self._score(
+            table, self.row_features, self.row_entities_local
+        )
+
+    def reg_term(self, table: jax.Array) -> jax.Array:
+        """Per-entity penalty; pad rows are zero so their lam is inert."""
+        lam = self.reg_weights.astype(table.dtype)
+        l2 = lam * (1.0 - self.config.l1_ratio)
+        l1 = lam * self.config.l1_ratio
+        sq = jnp.sum(table * table, axis=-1)
+        ab = jnp.sum(jnp.abs(table), axis=-1)
+        return jnp.sum(0.5 * l2 * sq + l1 * ab)
+
+
 # -- down-samplers (``sampler/``) -------------------------------------------
 
 

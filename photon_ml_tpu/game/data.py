@@ -549,6 +549,241 @@ def build_bucketed_random_effect_design(
     )
 
 
+# ---------------------------------------------------------------------------
+# entity-sharded layout (docs/PARALLEL.md): shard_map'd GAME descent
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EntityShardAssignment:
+    """Entity -> mesh-shard ownership for entity-sharded GAME descent.
+
+    Ownership uses the SAME round-robin rule as the sharded checkpoint
+    writer (``io.checkpoint.shard_rows``: shard p owns rows ``p::P`` of
+    the global entity order), so the device layout and the checkpoint
+    shard layout derive from one rule and compose entity-keyed: a
+    restore at ANY width re-keys rows by entity
+    (``reindex_entity_params``), pad rows re-initialize to zero.
+
+    The device table stores entities SHARD-MAJOR (shard p's entities
+    contiguous, each shard padded to ``rows_per_shard``) so a plain
+    NamedSharding block split puts each shard's rows on its device.
+
+    stored_to_global: (padded_rows,) int64 stored row -> global entity
+                      (``num_entities`` = pad sentinel).
+    global_to_stored: (num_entities + 1,) int64 inverse; the last slot
+                      maps the global sentinel to the stored sentinel
+                      ``padded_rows``.
+    """
+
+    num_entities: int
+    num_shards: int
+    rows_per_shard: int
+    stored_to_global: np.ndarray
+    global_to_stored: np.ndarray
+
+    @property
+    def padded_rows(self) -> int:
+        return self.num_shards * self.rows_per_shard
+
+    def shard_of_stored(self, stored: np.ndarray) -> np.ndarray:
+        return np.minimum(
+            np.asarray(stored, np.int64) // self.rows_per_shard,
+            self.num_shards - 1,
+        )
+
+    def stored_entity_keys(self, global_keys) -> list:
+        """Global entity-key list -> the STORED (shard-major) order the
+        device table holds, pad rows keyed uniquely so checkpoint
+        re-keying never aliases them onto real entities."""
+        keys = list(global_keys)
+        if len(keys) != self.num_entities:
+            raise ValueError(
+                f"{len(keys)} entity keys for {self.num_entities} entities"
+            )
+        return [
+            (
+                str(keys[g])
+                if g < self.num_entities
+                else f"__entity_pad__:{i}"
+            )
+            for i, g in enumerate(self.stored_to_global)
+        ]
+
+    def table_to_global(self, stored_table: np.ndarray) -> np.ndarray:
+        """Stored (shard-major, padded) table -> global entity order."""
+        stored_table = np.asarray(stored_table)
+        return stored_table[self.global_to_stored[: self.num_entities]]
+
+    def table_from_global(self, global_table: np.ndarray) -> np.ndarray:
+        """Global entity order -> stored (shard-major, padded) layout;
+        pad rows zero."""
+        global_table = np.asarray(global_table)
+        out = np.zeros(
+            (self.padded_rows,) + global_table.shape[1:],
+            global_table.dtype,
+        )
+        real = self.stored_to_global < self.num_entities
+        out[real] = global_table[self.stored_to_global[real]]
+        return out
+
+
+def entity_shard_assignment(
+    num_entities: int, num_shards: int
+) -> EntityShardAssignment:
+    """Build the round-robin entity -> shard assignment (shared rule:
+    ``io.checkpoint.shard_rows``)."""
+    from photon_ml_tpu.io.checkpoint import shard_rows
+
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    per_shard = -(-num_entities // num_shards) if num_entities else 1
+    padded = per_shard * num_shards
+    stored_to_global = np.full(padded, num_entities, np.int64)
+    for p in range(num_shards):
+        rows = np.asarray(
+            list(shard_rows(num_entities, p, num_shards)), np.int64
+        )
+        stored_to_global[
+            p * per_shard : p * per_shard + rows.size
+        ] = rows
+    global_to_stored = np.full(num_entities + 1, padded, np.int64)
+    real = stored_to_global < num_entities
+    global_to_stored[stored_to_global[real]] = np.flatnonzero(real)
+    return EntityShardAssignment(
+        num_entities=num_entities,
+        num_shards=num_shards,
+        rows_per_shard=per_shard,
+        stored_to_global=stored_to_global,
+        global_to_stored=global_to_stored,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class EntityRowPartition:
+    """Row-space permutation grouping batch rows by their entity's owner
+    shard (entity-PARTITIONED rows — the device analog of the
+    reference's ``RandomEffectIdPartitioner`` placement): shard p's rows
+    sit in the contiguous block ``[p*R, (p+1)*R)``, padded with -1
+    sentinel rows so every shard holds the same count. Applying the
+    permutation ONCE at setup keeps every per-row array of the descent
+    loop (labels, offsets, weights, scores, entity lanes) aligned with
+    the 'entity' mesh axis — the random-effect update then never
+    crosses shards.
+
+    row_perm: (padded_rows,) int64 permuted position -> original row
+              (-1 = pad).
+    """
+
+    num_shards: int
+    rows_per_shard: int
+    row_perm: np.ndarray
+
+    @property
+    def padded_rows(self) -> int:
+        return self.num_shards * self.rows_per_shard
+
+    def apply(self, column: np.ndarray, fill=0.0) -> np.ndarray:
+        """Permute one per-row array into the sharded order (pad rows
+        carry ``fill``)."""
+        column = np.asarray(column)
+        out = np.full(
+            (self.padded_rows,) + column.shape[1:], fill, column.dtype
+        )
+        real = self.row_perm >= 0
+        out[real] = column[self.row_perm[real]]
+        return out
+
+    def restore(self, column: np.ndarray) -> np.ndarray:
+        """Inverse of :meth:`apply` (drops pad rows)."""
+        column = np.asarray(column)
+        n = int((self.row_perm >= 0).sum())
+        out = np.zeros((n,) + column.shape[1:], column.dtype)
+        real = self.row_perm >= 0
+        out[self.row_perm[real]] = column[real]
+        return out
+
+
+def entity_partition_game_data(
+    data: GameData, random_effect: str, assignment: EntityShardAssignment
+):
+    """Permute a :class:`GameData` into the entity-partitioned row order
+    of ``random_effect`` (rows grouped by their entity's owner shard,
+    pad rows masked by zero weight): the ONE-time layout step of
+    entity-sharded GAME descent. Returns ``(permuted GameData,
+    EntityRowPartition)``. Dense and padded-ELL feature shards both
+    permute; other random effects' id columns ride along row-aligned
+    (but only ``random_effect`` is shard-local — a second entity-sharded
+    coordinate needs its own partition and therefore its own descent)."""
+    from photon_ml_tpu.ops.sparse import SparseFeatures, is_sparse, is_structured
+
+    part = entity_partition_rows(
+        data.entity_ids[random_effect], assignment
+    )
+
+    def permute_features(v):
+        if is_sparse(v):
+            ind = np.asarray(v.indices)
+            val = np.asarray(v.values)
+            out_i = np.full(
+                (part.padded_rows,) + ind.shape[1:], v.d, ind.dtype
+            )
+            out_v = np.zeros(
+                (part.padded_rows,) + val.shape[1:], val.dtype
+            )
+            real = part.row_perm >= 0
+            out_i[real] = ind[part.row_perm[real]]
+            out_v[real] = val[part.row_perm[real]]
+            return SparseFeatures(indices=out_i, values=out_v, d=v.d)
+        if is_structured(v):
+            raise ValueError(
+                "entity partitioning permutes dense or plain-ELL "
+                f"shards; got {type(v).__name__}"
+            )
+        return part.apply(v)
+
+    permuted = GameData(
+        features={
+            k: permute_features(v) for k, v in data.features.items()
+        },
+        labels=part.apply(data.labels),
+        offsets=part.apply(data.offsets),
+        weights=part.apply(data.weights),  # pad rows weight 0: masked out
+        entity_ids={
+            k: part.apply(v, fill=-1) for k, v in data.entity_ids.items()
+        },
+    )
+    return permuted, part
+
+
+def entity_partition_rows(
+    entity_ids: np.ndarray, assignment: EntityShardAssignment
+) -> EntityRowPartition:
+    """Group rows by their entity's owner shard (stable within a
+    shard). Rows with unknown entities (-1) spread round-robin — they
+    participate in no random-effect solve, so any shard balances."""
+    eids = np.asarray(entity_ids, np.int64)
+    n = eids.shape[0]
+    known = eids >= 0
+    owner = np.empty(n, np.int64)
+    owner[known] = assignment.shard_of_stored(
+        assignment.global_to_stored[eids[known]]
+    )
+    owner[~known] = np.arange(int((~known).sum())) % assignment.num_shards
+    counts = np.bincount(owner, minlength=assignment.num_shards)
+    per = int(counts.max()) if counts.size else 1
+    row_perm = np.full(per * assignment.num_shards, -1, np.int64)
+    order = np.argsort(owner, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    slot = np.arange(n) - starts[owner[order]]
+    row_perm[owner[order] * per + slot] = order
+    return EntityRowPartition(
+        num_shards=assignment.num_shards,
+        rows_per_shard=per,
+        row_perm=row_perm,
+    )
+
+
 def build_entity_vocabulary(raw_ids: np.ndarray):
     """Map raw entity keys -> dense [0, E) indices (the analog of the
     reference's per-entity partitioner + index maps). Returns (vocab dict,

@@ -394,6 +394,14 @@ def _shard_rows(n: int, p: int, num_shards: int) -> range:
     return range(p, n, num_shards)
 
 
+def shard_rows(n: int, p: int, num_shards: int) -> range:
+    """Public alias of the shard-ownership rule: entity-sharded GAME
+    descent (``game.data.entity_shard_assignment``) derives its device
+    layout from THIS rule so the device and checkpoint shard layouts
+    cannot drift (docs/PARALLEL.md)."""
+    return _shard_rows(n, p, num_shards)
+
+
 def _write_one_shard(
     staging: str,
     p: int,

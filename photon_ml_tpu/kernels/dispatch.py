@@ -33,6 +33,7 @@ that decides:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import Dict, Optional, Tuple
@@ -52,6 +53,8 @@ __all__ = [
     "record_kernel_cost",
     "design_reads",
     "reset_probe_cache",
+    "shard_local",
+    "in_shard_local",
 ]
 
 ENV_VAR = "PHOTON_SPARSE_KERNEL"
@@ -82,6 +85,71 @@ _probe_result: Dict[str, bool] = {}
 
 _record_lock = threading.Lock()
 _recorded = set()
+
+# one-shot multidevice-fallback signal (the eligibility rule below used
+# to fire SILENTLY: every >1-device run quietly lost the Pallas kernels
+# with nothing in any artifact saying so)
+_fallback_lock = threading.Lock()
+_fallback_logged = False
+
+_shard_local_depth = threading.local()
+
+
+@contextlib.contextmanager
+def shard_local():
+    """Mark the dynamic extent as SHARD-LOCAL: the caller guarantees the
+    traced code runs per-shard under ``shard_map`` (explicit-collective
+    paths like ``parallel.distributed.shard_map_value_and_grad`` /
+    ``hierarchical_value_and_grad`` and the entity-sharded GAME update),
+    so per-shard arrays are device-local and a Pallas custom call keeps
+    its semantics — the >1-device-mesh eligibility exclusion below is
+    LIFTED here. Under plain GSPMD jit the exclusion stands: the
+    partitioner would replicate the custom call and silently compute on
+    whole-array shapes."""
+    depth = getattr(_shard_local_depth, "value", 0)
+    _shard_local_depth.value = depth + 1
+    try:
+        yield
+    finally:
+        _shard_local_depth.value = depth
+
+
+def in_shard_local() -> bool:
+    return getattr(_shard_local_depth, "value", 0) > 0
+
+
+def _note_multidevice_fallback(devices: int) -> None:
+    """One-shot log + always-counted metric when the >1-device-mesh rule
+    routes an eligible contraction to XLA (ISSUE 14 bugfix: the silent
+    loss of the Pallas kernels on every multi-device run)."""
+    global _fallback_logged
+    try:
+        from photon_ml_tpu import obs
+
+        obs.registry().inc("kernels.dispatch.multidevice_fallback")
+        with _fallback_lock:
+            if _fallback_logged:
+                return
+            _fallback_logged = True
+        obs.emit_event(
+            "kernels.dispatch.multidevice_fallback",
+            cat="kernels",
+            devices=devices,
+            hint=(
+                "GSPMD meshes route ELL contractions to XLA; shard_map "
+                "paths keep Pallas via kernels.dispatch.shard_local()"
+            ),
+        )
+        from photon_ml_tpu.utils.logging import PhotonLogger
+
+        PhotonLogger(None).warn(
+            f"sparse ELL contractions falling back to the XLA lowering "
+            f"under a {devices}-device mesh (Pallas custom calls are "
+            "not GSPMD-partitionable); explicit shard_map paths can "
+            "keep the Pallas suite via kernels.dispatch.shard_local()"
+        )
+    except Exception:
+        pass  # dispatch must never fail on observability
 
 
 def kernel_mode() -> str:
@@ -198,7 +266,13 @@ def use_pallas(
         return False
     if d is not None and not accumulator_fits(d, itemsize):
         return False
-    if active_mesh_devices() > 1:
+    devices = active_mesh_devices()
+    if devices > 1 and not in_shard_local():
+        # GSPMD would replicate a Pallas custom call (wrong results at
+        # whole-array shapes), so sharded solves stay on XLA — but no
+        # longer silently (one-shot log + counter), and shard_map'd
+        # paths that declared themselves shard-local keep the kernels.
+        _note_multidevice_fallback(devices)
         return False
     if mode == "pallas":
         return True

@@ -47,6 +47,7 @@ from photon_ml_tpu.obs.trace import span as _span
 __all__ = [
     "collective_metric_key",
     "record_collective",
+    "record_collective_share",
     "collective_span",
     "note_traced_collective",
     "tree_bytes",
@@ -77,6 +78,37 @@ def record_collective(
         reg.inc(f"{key}.bytes", float(nbytes))
     if wall_s is not None:
         reg.observe(f"{key}.wall_ms", wall_s * 1e3)
+
+
+def record_collective_share(
+    name: str,
+    mesh_width: int,
+    collective_wall_s: float,
+    pass_wall_s: float,
+    registry: Optional[MetricsRegistry] = None,
+) -> float:
+    """Record ``collective_wall_frac`` — collective wall as a share of
+    the ENCLOSING pass wall — as the gauge
+    ``collective.<name>.w<W>.wall_frac`` (plus the underlying wall
+    histogram via :func:`record_collective`). THE direct overlap gate:
+    ``scaling_efficiency`` only infers that communication hid under
+    compute; this measures it, and the sentinel holds it lower-is-better
+    (``obs.sentinel``), so an overlap regression fails the gate even
+    when wall clocks are noisy. Clamped to [0, 1]; a degenerate pass
+    wall records 0."""
+    frac = 0.0
+    if pass_wall_s > 0:
+        frac = min(max(collective_wall_s / pass_wall_s, 0.0), 1.0)
+    reg = registry if registry is not None else _registry()
+    key = collective_metric_key(name, mesh_width)
+    reg.set_gauge(f"{key}.wall_frac", round(frac, 6))
+    record_collective(
+        name,
+        mesh_width=mesh_width,
+        wall_s=max(collective_wall_s, 0.0),
+        registry=reg,
+    )
+    return frac
 
 
 @contextlib.contextmanager

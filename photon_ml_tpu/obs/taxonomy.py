@@ -95,7 +95,16 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
     (
         "collective",
         r"collective\.[a-z_]+(\..+)?",
-        "collective profiler metrics/spans + stall/abandon events",
+        "collective profiler metrics/spans + stall/abandon events, incl. "
+        "the collective.overlap.* chunked-pipeline series and per-width "
+        "wall_frac overlap gauges (docs/PARALLEL.md)",
+    ),
+    (
+        "partition",
+        r"partition\.[a-z_]+(\..+)?",
+        "multi-device partition layer: entity-shard layout spans, "
+        "balanced-blocking stats, shard-skew drill events "
+        "(docs/PARALLEL.md)",
     ),
     (
         "heartbeat",

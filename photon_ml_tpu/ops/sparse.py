@@ -34,7 +34,7 @@ hybrid container's cold segments — switches with zero call-site changes.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -200,21 +200,57 @@ class FeatureShardedSparse:
     batch dimension on both operand and indices. This is the TPU analog of
     the reference's per-feature-block aggregation
     (``function/ValueAndGradientAggregator.scala:204-220``).
+
+    ROW-BALANCED layout (``shard_columns(..., balance_rows=True)``, the
+    ``PHOTON_COLLECTIVE_MODE=overlap`` strategy): the flat layout pads
+    every (row, block) lane to the DATASET max per-block entry count, so
+    at width F the stored slots — the irregular-access cost driver —
+    inflate toward max/mean over rows (measured 3.7x at F=8 on the
+    bench workload, THE inverse-scaling term of BENCH_r06's
+    ``sparse_fs_scaling``). Balanced blocks instead pack each block's
+    entries into width-``k`` VIRTUAL rows: a row with c entries in
+    block f occupies ceil(c/k) of them, and ``row_map[v, f]`` records
+    the original row virtual row v of block f contributes to (sentinel
+    ``num_rows`` = empty pad lane: gathers fill 0, scatters drop).
+    Margins then need one extra per-block scatter-add of the virtual-row
+    partials into (n,) — O(V) — against an O(slots) padding saving.
+
+    row_map:  (V, F) int32 virtual row -> original row, or None for the
+              flat layout.
+    num_rows: logical row count n when ``row_map`` is set (the leading
+              axis is V, not n).
+    aligned_rows: the first ``aligned_rows`` virtual rows are IDENTITY
+              mapped (virtual row v holds row v's first <= k entries in
+              every block), so their margin partials need no scatter
+              routing and their back-projection weights no gather — the
+              O(V) routing cost only touches the overflow tail.
     """
 
     indices: jax.Array
     values: jax.Array
     d_shard: int
     d_orig: int
+    row_map: Optional[jax.Array] = None
+    num_rows: Optional[int] = None
+    aligned_rows: int = 0
 
     @property
     def num_blocks(self) -> int:
         return self.indices.shape[-2]
 
     @property
+    def is_balanced(self) -> bool:
+        return self.row_map is not None
+
+    @property
     def shape(self) -> Tuple[int, int]:
         # solver-visible width: the blocked coefficient vector
-        return (self.indices.shape[-3], self.num_blocks * self.d_shard)
+        rows = (
+            self.num_rows
+            if self.num_rows is not None
+            else self.indices.shape[-3]
+        )
+        return (rows, self.num_blocks * self.d_shard)
 
     @property
     def ndim(self) -> int:
@@ -229,12 +265,21 @@ class FeatureShardedSparse:
 
 
 def _flatten_fsharded(fs: FeatureShardedSparse):
-    return (fs.indices, fs.values), (fs.d_shard, fs.d_orig)
+    return (
+        (fs.indices, fs.values, fs.row_map),
+        (fs.d_shard, fs.d_orig, fs.num_rows, fs.aligned_rows),
+    )
 
 
 def _unflatten_fsharded(aux, children):
     return FeatureShardedSparse(
-        indices=children[0], values=children[1], d_shard=aux[0], d_orig=aux[1]
+        indices=children[0],
+        values=children[1],
+        d_shard=aux[0],
+        d_orig=aux[1],
+        row_map=children[2],
+        num_rows=aux[2],
+        aligned_rows=aux[3],
     )
 
 
@@ -290,7 +335,16 @@ def cast_values(x, dtype):
                 for seg in x.cold_segments
             ),
         )
-    if is_sparse(x) or is_feature_sharded(x):
+    if is_feature_sharded(x):
+        return dataclasses.replace(
+            x,
+            indices=jnp.asarray(x.indices),
+            values=jnp.asarray(x.values, dtype),
+            row_map=(
+                None if x.row_map is None else jnp.asarray(x.row_map)
+            ),
+        )
+    if is_sparse(x):
         return dataclasses.replace(
             x,
             indices=jnp.asarray(x.indices),
@@ -321,17 +375,55 @@ def _low_precision_dot(x: jax.Array, w: jax.Array):
     return x @ w
 
 
+def _block_margin_partials(x: "FeatureShardedSparse", w: jax.Array):
+    """(F, n) per-block margin partials of a blocked container — the
+    payload whose block-axis sum is THE feature-space reduction of every
+    objective pass (``parallel.overlap.feature_block_sum`` owns the
+    fused-vs-overlap schedule). Flat layout: gather + row reduce.
+    Balanced layout: gather + virtual-row reduce + one per-block
+    scatter-add routing virtual rows to their original rows (sentinel
+    lanes drop)."""
+    w2 = w.reshape(x.num_blocks, x.d_shard)
+    gathered = jax.vmap(  # per-block local gather; block axis = batch dim
+        lambda wf, idxf: wf.at[idxf].get(mode="fill", fill_value=0.0),
+        in_axes=(0, 1),
+        out_axes=1,
+    )(w2, x.indices)
+    if not x.is_balanced:
+        return jnp.einsum("nfk,nfk->fn", x.values, gathered)
+    partial_v = jnp.einsum("vfk,vfk->fv", x.values, gathered)  # (F, V)
+    n = x.shape[0]
+    a = x.aligned_rows
+    if a:
+        # identity head: virtual row v == row v, no routing; only the
+        # overflow tail scatters (its rows route by row_map)
+        head = partial_v[:, :a]
+        if a < n:
+            head = jnp.pad(head, ((0, 0), (0, n - a)))
+        if partial_v.shape[1] == a:
+            return head
+
+        def route_tail(pv, rm):
+            return jnp.zeros((n,), pv.dtype).at[rm].add(pv, mode="drop")
+
+        tail = jax.vmap(route_tail)(
+            partial_v[:, a:], x.row_map[a:].T
+        )
+        return head + tail
+
+    def route(pv, rm):
+        return jnp.zeros((n,), pv.dtype).at[rm].add(pv, mode="drop")
+
+    return jax.vmap(route)(partial_v, x.row_map.T)
+
+
 def matvec(x, w: jax.Array) -> jax.Array:
     """margins contraction: (n, d) @ (d,) -> (n,). Hybrid output is in
     STORED (permuted) row order, matching the permuted batch."""
     if is_feature_sharded(x):
-        w2 = w.reshape(x.num_blocks, x.d_shard)
-        gathered = jax.vmap(  # per-block local gather; block axis = batch dim
-            lambda wf, idxf: wf.at[idxf].get(mode="fill", fill_value=0.0),
-            in_axes=(0, 1),
-            out_axes=1,
-        )(w2, x.indices)
-        return jnp.einsum("nfk,nfk->n", x.values, gathered)
+        from photon_ml_tpu.parallel.overlap import feature_block_sum
+
+        return feature_block_sum(_block_margin_partials(x, w))
     if is_hybrid(x):
         # dtype promotion mirrors the dense path (bf16 slab @ f32 w -> f32)
         cold = jnp.concatenate(
@@ -352,7 +444,24 @@ def rmatvec(x, a: jax.Array) -> jax.Array:
     """gradient back-projection: (n, d)^T @ (n,) -> (d,). Hybrid `a` is
     in stored row order."""
     if is_feature_sharded(x):
-        upd = x.values * a[:, None, None]
+        if x.is_balanced:
+            # route each virtual row's weight from its original row; the
+            # identity-aligned head broadcasts straight from ``a``
+            # (sentinel lanes gather-fill 0, so their slots contribute 0)
+            al = x.aligned_rows
+            if al:
+                head = jnp.broadcast_to(
+                    a[:al, None], (al, x.num_blocks)
+                )
+                tail = a.at[x.row_map[al:]].get(
+                    mode="fill", fill_value=0.0
+                )
+                a_v = jnp.concatenate([head, tail], axis=0)
+            else:
+                a_v = a.at[x.row_map].get(mode="fill", fill_value=0.0)
+            upd = x.values * a_v[..., None]
+        else:
+            upd = x.values * a[:, None, None]
         g2 = jax.vmap(  # per-block local scatter into the block's coefficients
             lambda idxf, updf: jnp.zeros((x.d_shard,), updf.dtype)
             .at[idxf.reshape(-1)]
@@ -431,21 +540,22 @@ def matvec_and_feature_dots(
     """
     if not is_feature_sharded(x) or not dot_pairs:
         return matvec(x, w), tuple(jnp.vdot(u, v) for u, v in dot_pairs)
-    n = x.indices.shape[-3]
-    w2 = w.reshape(x.num_blocks, x.d_shard)
-    gathered = jax.vmap(  # per-block local gather, as in matvec
-        lambda wf, idxf: wf.at[idxf].get(mode="fill", fill_value=0.0),
-        in_axes=(0, 1),
-        out_axes=1,
-    )(w2, x.indices)
-    zb = jnp.einsum("nfk,nfk->fn", x.values, gathered)  # (F, n) partials
+    from photon_ml_tpu.parallel.overlap import feature_block_sum
+
+    n = x.shape[0]
+    zb = _block_margin_partials(x, w)  # (F, n) partials
     cols = [zb]
     for u, v in dot_pairs:
         ub = u.reshape(x.num_blocks, x.d_shard)
         vb = v.reshape(x.num_blocks, x.d_shard)
         cols.append(jnp.sum(ub * vb, axis=-1, keepdims=True))  # (F, 1)
     payload = jnp.concatenate(cols, axis=-1)  # (F, n + P), sharded on F
-    total = jnp.sum(payload, axis=0)  # ONE all-reduce of (n + P,)
+    # fused: ONE bucketed all-reduce of (n + P,). overlap: the row axis
+    # chunks into reduce-scatters issued as their chunk's partials land,
+    # plus one trailing all-gather (parallel.overlap.feature_block_sum —
+    # the schedule is the PHOTON_COLLECTIVE_MODE knob, equivalence
+    # drilled in tests/test_partition.py).
+    total = feature_block_sum(payload)
     # collective profiler (obs.collectives): this function only ever
     # runs under tracing, so the note fires once per COMPILATION —
     # recording the bucketed reduction's payload geometry
@@ -466,6 +576,11 @@ def pad_rows(x, pad: int):
     """Append `pad` all-padding rows (index d, value 0), preserving the
     padding invariant that plain zero-padding would break."""
     if is_feature_sharded(x):
+        if x.is_balanced:
+            # appended rows hold no entries; only the logical row count
+            # (the scatter target / margin length) grows. Virtual-row
+            # sentinels already point at num_rows and keep dropping.
+            return dataclasses.replace(x, num_rows=x.shape[0] + pad)
         return dataclasses.replace(
             x,
             indices=jnp.pad(
@@ -543,12 +658,31 @@ def cold_as_single_ell(hf: HybridFeatures) -> SparseFeatures:
 def feature_sharded_as_ell(fs: FeatureShardedSparse) -> SparseFeatures:
     """View a blocked container as one flat ELL over the BLOCKED column
     space (width F * d_shard): global id = block * d_shard + local. For
-    once-per-run consumers (feature statistics), not hot kernels."""
+    once-per-run consumers (feature statistics), not hot kernels.
+    Balanced containers rebuild host-side through their row map (their
+    virtual rows are per-block packings, not batch rows)."""
+    d_block = fs.num_blocks * fs.d_shard
+    if fs.is_balanced:
+        ind = np.asarray(fs.indices)
+        val = np.asarray(fs.values)
+        rm = np.asarray(fs.row_map)
+        v_rows, F, k = ind.shape
+        keep = ind < fs.d_shard
+        vv, ff, _ = np.nonzero(keep)
+        rows = rm[vv, ff]
+        cols = ff.astype(np.int64) * fs.d_shard + ind[keep]
+        return from_coo(
+            rows,
+            cols,
+            val[keep],
+            fs.shape[0],
+            d_block,
+            dtype=fs.values.dtype,
+        )
     n, F, k = fs.indices.shape
     base = (
         jnp.arange(F, dtype=fs.indices.dtype) * fs.d_shard
     )[None, :, None]
-    d_block = F * fs.d_shard
     glob = jnp.where(fs.indices < fs.d_shard, fs.indices + base, d_block)
     return SparseFeatures(
         indices=glob.reshape(n, F * k),
@@ -567,8 +701,35 @@ def blocked_column_map(d: int, num_blocks: int) -> np.ndarray:
     return (c % num_blocks) * d_shard + c // num_blocks
 
 
+def balanced_virtual_width(counts: np.ndarray) -> int:
+    """The virtual-row width k0 minimizing the ALIGNED balanced
+    layout's cost proxy ``slots + 2 * routed_virtual_rows`` (a
+    scatter/gather routing touch costs ~2 stored-slot touches on the
+    measured backends), given the (F, n) per-(block, row) entry counts.
+    Every row owns one identity-aligned virtual row (n * k slots per
+    block); only entries past k spill into routed overflow rows. Exact
+    scan over candidate widths — counts are small ints."""
+    kmax = int(counts.max()) if counts.size else 1
+    if kmax <= 1:
+        return 1
+    best_k, best_cost = 1, None
+    F, n = counts.shape
+    for k in range(1, kmax + 1):
+        over = np.maximum(counts - k, 0)
+        # overflow rows pad to the max over blocks so the (V, F, k)
+        # arrays stay rectangular
+        v_ovf = int((-(-over // k)).sum(axis=1).max())
+        cost = F * (n + v_ovf) * k + 2 * F * v_ovf
+        if best_cost is None or cost < best_cost:
+            best_k, best_cost = k, cost
+    return best_k
+
+
 def shard_columns(
-    sf: SparseFeatures, num_blocks: int, dtype=None
+    sf: SparseFeatures,
+    num_blocks: int,
+    dtype=None,
+    balance_rows: bool = False,
 ) -> FeatureShardedSparse:
     """Block an ELL matrix by column for feature-sharded solves
     (host-side, once per dataset). Columns are assigned round-robin
@@ -576,8 +737,17 @@ def shard_columns(
     after ``cli/build_index`` — spread their hot columns evenly across
     blocks. ``blocked_column_map`` gives the induced coefficient layout.
 
-    The per-(row, block) width k is the max over the dataset; round-robin
-    keeps it near nnz/F for non-adversarial column distributions.
+    Flat layout (default): the per-(row, block) width k is the max over
+    the dataset; round-robin keeps the MEAN near nnz/F, but the max —
+    which every lane pads to — concentrates near the binomial tail, so
+    stored slots inflate as F grows (3.7x at F=8 on the bench workload).
+
+    ``balance_rows=True`` (the ``PHOTON_COLLECTIVE_MODE=overlap``
+    layout): each block packs its entries into width-k0 VIRTUAL rows
+    (``balanced_virtual_width`` picks k0), recorded in ``row_map`` —
+    stored slots then track the entry count instead of the max row.
+    Same round-robin column map, so coefficients are interchangeable
+    between layouts.
     """
     if num_blocks < 1:
         raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
@@ -595,10 +765,46 @@ def shard_columns(
     loc = cols // F
     key = rows * F + blk
     counts = np.bincount(key, minlength=n * F)
-    k_new = int(counts.max()) if counts.size and counts.max() > 0 else 1
     order = np.argsort(key, kind="stable")
     starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
     slot = np.arange(key.size) - starts[key[order]]
+    if balance_rows and F > 1:
+        cfr = counts.reshape(n, F).T  # (F, n) per-(block, row) counts
+        k0 = balanced_virtual_width(cfr)
+        # identity-aligned head: virtual row r == row r holds the first
+        # <= k0 entries of every block; entries past k0 spill into
+        # routed overflow rows appended after the head
+        over = np.maximum(cfr - k0, 0)
+        ovf_per = -(-over // k0)  # (F, n) overflow rows per (block, row)
+        v_ovf = int(ovf_per.sum(axis=1).max())
+        v_total = n + v_ovf if (n + v_ovf) else 1
+        base = np.zeros((F, n), np.int64)
+        base[:, 1:] = np.cumsum(ovf_per, axis=1)[:, :-1]
+        r_o, b_o, s_o = rows[order], blk[order], slot
+        in_head = s_o < k0
+        vrow = np.where(
+            in_head,
+            r_o,
+            n + base[b_o, r_o] + np.maximum(s_o - k0, 0) // k0,
+        )
+        pos = np.where(in_head, s_o, np.maximum(s_o - k0, 0) % k0)
+        indices = np.full((v_total, F, k0), d_shard, np.int32)
+        values = np.zeros((v_total, F, k0), out_dtype)
+        row_map = np.full((v_total, F), n, np.int32)
+        row_map[:n] = np.arange(n, dtype=np.int32)[:, None]
+        indices[vrow, b_o, pos] = loc[order]
+        values[vrow, b_o, pos] = vals[order]
+        row_map[vrow, b_o] = r_o
+        return FeatureShardedSparse(
+            indices=jnp.asarray(indices),
+            values=jnp.asarray(values),
+            d_shard=d_shard,
+            d_orig=sf.d,
+            row_map=jnp.asarray(row_map),
+            num_rows=n,
+            aligned_rows=n,
+        )
+    k_new = int(counts.max()) if counts.size and counts.max() > 0 else 1
     indices = np.full((n, F, k_new), d_shard, np.int32)
     values = np.zeros((n, F, k_new), out_dtype)
     indices[rows[order], blk[order], slot] = loc[order]

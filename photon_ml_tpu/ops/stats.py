@@ -57,6 +57,11 @@ def summarize_features(
     if sparse_ops.is_feature_sharded(x):
         import dataclasses as _dc
 
+        if x.is_balanced:
+            # virtual rows are per-block packings, not batch rows — the
+            # flat-ELL view is a host-side rebuild, so the balanced
+            # layout gets its own trace-safe direct summary
+            return _summarize_balanced_blocked(batch, axis_name)
         # flatten to one ELL over the blocked column space; statistics come
         # back in blocked layout, matching the solver's coefficient layout
         flat = _dc.replace(
@@ -143,6 +148,88 @@ def _summarize_hybrid(
         norm_l2=merge(cold.norm_l2, slab.norm_l2),
         mean_abs=merge(cold.mean_abs, slab.mean_abs),
         num_nonzeros=merge(cold.num_nonzeros, slab.num_nonzeros),
+    )
+
+
+def _summarize_balanced_blocked(
+    batch: LabeledBatch, axis_name: Optional[str] = None
+) -> BasicStatisticalSummary:
+    """Column statistics over a row-BALANCED blocked container
+    (docs/PARALLEL.md) without the flat-ELL view: the moment sums ride
+    the container's own colsum (whose back-projection routes per-entry
+    masks through the row map), and min/max scatter per entry into the
+    blocked column space with the same implicit-zero correction as
+    ``_summarize_sparse``. Statistics come back in BLOCKED layout,
+    matching the solver's coefficient layout."""
+    import dataclasses
+
+    x = batch.features
+    m = batch.mask
+    dtype = x.values.dtype
+    d_block = x.num_blocks * x.d_shard
+
+    def _psum(v):
+        return jax.lax.psum(v, axis_name) if axis_name is not None else v
+
+    n = _psum(jnp.sum(m))
+    s1 = _psum(sparse_ops.colsum(x, m))
+    s2 = _psum(sparse_ops.colsum(x, m, square=True))
+    absx = dataclasses.replace(x, values=jnp.abs(x.values))
+    sabs = _psum(sparse_ops.colsum(absx, m))
+    nzx = dataclasses.replace(x, values=(x.values != 0.0).astype(dtype))
+    nnz = _psum(sparse_ops.colsum(nzx, m))
+    onesx = dataclasses.replace(x, values=jnp.ones_like(x.values))
+    stored = _psum(sparse_ops.colsum(onesx, m))
+
+    # per-entry mask: the stored row of every virtual lane (identity for
+    # the aligned head, routed for the overflow tail; sentinel lanes
+    # gather-fill 0 = masked out)
+    al = x.aligned_rows
+    if al:
+        head = jnp.broadcast_to(m[:al, None], (al, x.num_blocks))
+        tail = m.at[x.row_map[al:]].get(mode="fill", fill_value=0.0)
+        m_v = jnp.concatenate([head, tail], axis=0)  # (V, F)
+    else:
+        m_v = m.at[x.row_map].get(mode="fill", fill_value=0.0)
+    big = jnp.asarray(jnp.inf, dtype)
+    blk = jnp.arange(x.num_blocks, dtype=x.indices.dtype)[None, :, None]
+    glob = jnp.where(
+        x.indices < x.d_shard, blk * x.d_shard + x.indices, d_block
+    )
+    entry_ok = (x.indices < x.d_shard) & (m_v[..., None] > 0)
+    flat_idx = jnp.where(entry_ok, glob, d_block).reshape(-1)
+    mn_stored = (
+        jnp.full((d_block,), big)
+        .at[flat_idx]
+        .min(jnp.where(entry_ok, x.values, big).reshape(-1), mode="drop")
+    )
+    mx_stored = (
+        jnp.full((d_block,), -big)
+        .at[flat_idx]
+        .max(jnp.where(entry_ok, x.values, -big).reshape(-1), mode="drop")
+    )
+    mn_stored = _psum_min(mn_stored, axis_name)
+    mx_stored = _psum_max(mx_stored, axis_name)
+    has_zero = stored < n  # some unmasked row lacks a stored entry
+    mn = jnp.where(has_zero, jnp.minimum(mn_stored, 0.0), mn_stored)
+    mx = jnp.where(has_zero, jnp.maximum(mx_stored, 0.0), mx_stored)
+    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    var = (s2 - n * mean * mean) / jnp.maximum(n - 1.0, 1.0)
+    var = jnp.where(jnp.isfinite(var) & (var > 0.0), var, 0.0)
+    return BasicStatisticalSummary(
+        mean=mean,
+        variance=var,
+        count=n,
+        min=mn,
+        max=mx,
+        norm_l1=sabs,
+        norm_l2=jnp.sqrt(s2),
+        mean_abs=sabs / safe_n,
+        num_nonzeros=nnz,
     )
 
 

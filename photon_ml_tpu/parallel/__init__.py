@@ -17,8 +17,10 @@ from photon_ml_tpu.parallel.mesh import (
     batch_sharding,
     default_mesh,
     entity_sharding,
+    make_entity_mesh,
     make_feature_mesh,
     make_game_mesh,
+    make_host_device_mesh,
     make_mesh,
     replicated,
     set_mesh,
@@ -26,6 +28,11 @@ from photon_ml_tpu.parallel.mesh import (
     shard_bucketed_design,
     shard_design,
     shard_map,
+)
+from photon_ml_tpu.parallel.overlap import (
+    collective_mode,
+    feature_block_sum,
+    overlap_chunks,
 )
 from photon_ml_tpu.parallel.heartbeat import (
     HeartbeatMonitor,
@@ -43,16 +50,19 @@ from photon_ml_tpu.parallel.multihost import (
     configure_collective_resilience,
     fetch_replicated,
     global_entity_space,
+    hierarchical_psum,
     initialize_multihost,
     make_global_array,
     make_global_batch,
     make_global_re_design,
     process_local_paths,
     process_local_rows,
+    resilient_host_exchange,
 )
 from photon_ml_tpu.parallel.distributed import (
     distributed_train_glm,
     feature_sharded_train_glm,
+    hierarchical_value_and_grad,
     shard_map_value_and_grad,
 )
 
@@ -60,7 +70,15 @@ __all__ = [
     "make_mesh",
     "make_feature_mesh",
     "make_game_mesh",
+    "make_entity_mesh",
+    "make_host_device_mesh",
     "default_mesh",
+    "collective_mode",
+    "feature_block_sum",
+    "overlap_chunks",
+    "hierarchical_psum",
+    "hierarchical_value_and_grad",
+    "resilient_host_exchange",
     "batch_sharding",
     "entity_sharding",
     "replicated",

@@ -134,7 +134,22 @@ def feature_sharded_train_glm(
     row_spec = NamedSharding(mesh, P(DATA_AXIS))
 
     if sparse_ops.is_sparse(batch.features):
-        blocked = sparse_ops.shard_columns(batch.features, n_col_shards)
+        # PHOTON_COLLECTIVE_MODE=overlap row-balances the blocked
+        # container (stored slots track entries, not the max lane —
+        # the BENCH_r06 inverse-scaling term); the balanced virtual-row
+        # scatter routes within a block, so it requires the row axis
+        # unsharded. fused keeps the PR-5 flat layout as the
+        # equivalence oracle (docs/PARALLEL.md).
+        from photon_ml_tpu.parallel.overlap import collective_mode
+
+        balance = (
+            collective_mode() == "overlap"
+            and n_rows_shards == 1
+            and n_col_shards > 1
+        )
+        blocked = sparse_ops.shard_columns(
+            batch.features, n_col_shards, balance_rows=balance
+        )
         col_map = sparse_ops.blocked_column_map(d, n_col_shards)
         d_block = n_col_shards * blocked.d_shard
         padded = LabeledBatch.pad_to(
@@ -151,9 +166,20 @@ def feature_sharded_train_glm(
         )
         feat_spec = NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
 
+    def _place_feature_leaf(x):
+        # a balanced container's (V, F) row map shards over 'feature'
+        # only; the 3-D indices/values keep the full spec
+        if np.ndim(x) == 2 and sparse_ops.is_feature_sharded(
+            padded.features
+        ):
+            return jax.device_put(
+                x, NamedSharding(mesh, P(None, FEATURE_AXIS))
+            )
+        return jax.device_put(x, feat_spec)
+
     padded = LabeledBatch(
         features=jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, feat_spec), padded.features
+            _place_feature_leaf, padded.features
         ),
         labels=jax.device_put(padded.labels, row_spec),
         offsets=jax.device_put(padded.offsets, row_spec),
@@ -224,6 +250,60 @@ def feature_sharded_train_glm(
     return out
 
 
+def hierarchical_value_and_grad(objective: GLMObjective, mesh: Mesh):
+    """Explicit-collective value+grad over a 2-D ('host', 'device') mesh
+    with the HIERARCHICAL reduction order (docs/PARALLEL.md): per-shard
+    partials reduce-scatter over the fast intra-host axis first, the
+    1/D shards all-reduce over DCN, and one intra-host all-gather
+    re-replicates — ``parallel.multihost.hierarchical_psum`` applied to
+    the same (value, gradient) tuple ``shard_map_value_and_grad`` psums
+    flat. Returns f(w, sharded_batch) -> (val, grad), rows sharded over
+    both axes flattened (``mesh.batch_sharding``). Equivalence with the
+    flat psum path is drilled <= 1e-12 in tests/test_partition.py."""
+    from photon_ml_tpu.parallel.mesh import DEVICE_AXIS, HOST_AXIS
+    from photon_ml_tpu.parallel.multihost import hierarchical_psum
+
+    if HOST_AXIS not in mesh.axis_names or DEVICE_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"hierarchical_value_and_grad needs a ('{HOST_AXIS}', "
+            f"'{DEVICE_AXIS}') mesh (make_host_device_mesh); got axes "
+            f"{mesh.axis_names}"
+        )
+    # L2 applies to the REPLICATED w once, after the reduction — the
+    # shard-local objective must produce pure data partials (the same
+    # split objective.value_grad_curvature makes around its psum)
+    obj0 = dataclasses.replace(objective, axis_name=None, l2_weight=0.0)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P((HOST_AXIS, DEVICE_AXIS))),
+        out_specs=(P(), P()),
+        # the replication checker cannot see through the
+        # psum_scatter -> psum -> all_gather chain (it infers 'host'
+        # replication from the psum but not the gathered 'device' axis);
+        # the outputs ARE replicated by construction
+        check_rep=False,
+    )
+    def vg(w, batch: LabeledBatch):
+        from photon_ml_tpu.kernels import dispatch as _kdispatch
+
+        with _kdispatch.shard_local():
+            val, grad = obj0.value_and_grad(w, batch)
+        val, grad = hierarchical_psum(
+            (val, grad), intra_axis=DEVICE_AXIS, inter_axis=HOST_AXIS
+        )
+        if not (
+            isinstance(objective.l2_weight, (int, float))
+            and objective.l2_weight == 0.0
+        ):
+            val = val + 0.5 * objective.l2_weight * jnp.vdot(w, w)
+            grad = grad + objective.l2_weight * w
+        return val, grad
+
+    return vg
+
+
 def _eager_and_traced() -> bool:
     """True when we are on the HOST side of a dispatch (not inside a jit
     trace) AND a tracer is active — the only situation where wrapping a
@@ -265,7 +345,14 @@ def shard_map_value_and_grad(
         out_specs=(P(), P()),
     )
     def vg_raw(w, batch: LabeledBatch):
-        return obj.value_and_grad(w, batch)
+        # shard-local by construction: per-shard rows with replicated w,
+        # partials psum-reduced — so the Pallas ELL suite stays eligible
+        # under this >1-device mesh (kernels.dispatch.shard_local; the
+        # GSPMD jit path keeps the XLA fallback + one-shot signal)
+        from photon_ml_tpu.kernels import dispatch as _kdispatch
+
+        with _kdispatch.shard_local():
+            return obj.value_and_grad(w, batch)
 
     def vg(w, batch: LabeledBatch):
         if not _eager_and_traced():

@@ -25,6 +25,10 @@ from photon_ml_tpu.core.types import LabeledBatch
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
 FEATURE_AXIS = "feature"
+# 2-D hierarchical reductions (docs/PARALLEL.md): 'host' is the slow
+# (DCN, inter-host) axis, 'device' the fast (ICI, intra-host) one.
+HOST_AXIS = "host"
+DEVICE_AXIS = "device"
 
 
 def set_mesh(mesh: Mesh):
@@ -97,6 +101,43 @@ def make_feature_mesh(
         )
     grid = np.asarray(devs[: n_data * n_feature]).reshape(n_data, n_feature)
     return Mesh(grid, (DATA_AXIS, FEATURE_AXIS))
+
+
+def make_entity_mesh(
+    n_entity: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1D 'entity' mesh for entity-sharded GAME descent: the SAME
+    devices a 'data' mesh would use, viewed entity-wise — random-effect
+    tables, their bucket lanes, and the entity-partitioned row space all
+    shard over this one axis (docs/PARALLEL.md)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_entity is None:
+        n_entity = len(devs)
+    if n_entity > len(devs):
+        raise ValueError(
+            f"mesh of {n_entity} 'entity' devices requested, have "
+            f"{len(devs)}"
+        )
+    return Mesh(np.asarray(devs[:n_entity]), (ENTITY_AXIS,))
+
+
+def make_host_device_mesh(
+    n_host: int, n_device: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2D ('host', 'device') mesh for hierarchical two-level reductions
+    (docs/PARALLEL.md): 'device' is the fast intra-host (ICI) axis,
+    'host' the slow inter-host (DCN) one. On a real pod build it with
+    each process's local devices forming one 'host' row; single-process
+    it partitions the virtual CPU devices the same way so tier-1 drills
+    the ICI-then-DCN reduction order without hardware."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_host * n_device > len(devs):
+        raise ValueError(
+            f"mesh {n_host}x{n_device} needs {n_host * n_device} "
+            f"devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs[: n_host * n_device]).reshape(n_host, n_device)
+    return Mesh(grid, (HOST_AXIS, DEVICE_AXIS))
 
 
 def default_mesh() -> Mesh:

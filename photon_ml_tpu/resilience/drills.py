@@ -1137,6 +1137,139 @@ def drill_drift_alarm(smoke: bool = True) -> dict:
     }
 
 
+def drill_shard_skew(smoke: bool = True) -> dict:
+    """Entity-sharded GAME under a deliberately SLOW shard
+    (docs/PARALLEL.md): one shard's pass-boundary sync stalls past the
+    collective watchdog deadline — the run must COMPLETE (retry through
+    the backoff seam, result equal to the unskewed run) and the stall
+    must be ATTRIBUTABLE: a ``collective.stall`` event naming the skewed
+    shard's sync label, counters bumped. An unattributable straggler is
+    the pod failure mode where one slow host silently caps every pass
+    and nobody knows which."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import obs as _obs
+    from photon_ml_tpu.parallel import multihost
+
+    reg = _obs.registry()
+    stalls_before = reg.counter("collective.stalls").value
+
+    n_shards = 2
+    prev = multihost.configure_collective_resilience(
+        timeout_s=0.15, retries=2
+    )
+    try:
+        # (1) the skewed pass-boundary sync: shard 1 stalls 2s against a
+        # 0.15s watchdog -> timeout -> retry (fault is one-shot) -> the
+        # sync completes; wall bounded by the deadline, not the skew
+        t0 = time.perf_counter()
+        with inject(
+            # the site's call counter is global across shard keys:
+            # shard-0 syncs first (call 1, filtered by key), shard-1's
+            # first sync is call 2 — the one that stalls; its retry
+            # (call 3) runs clean
+            FaultSpec(
+                "partition.shard_skew", "delay", key="shard-1",
+                nth=2, delay=2.0,
+            )
+        ):
+            for p in range(n_shards):
+                def sync(p=p):
+                    fire("partition.shard_skew", key=f"shard-{p}")
+                    return p
+
+                got = multihost.resilient_host_exchange(
+                    f"shard_sync.h{p}", sync
+                )
+                assert got == p
+        skew_recovery_s = time.perf_counter() - t0
+        assert skew_recovery_s < 1.9, (
+            f"watchdog waited out the skewed shard "
+            f"({skew_recovery_s:.2f}s) instead of abandoning the attempt"
+        )
+        stalls = reg.counter("collective.stalls").value - stalls_before
+        assert stalls >= 1, "skewed shard never recorded a stall"
+
+        # (2) the run completes with the correct result: a tiny
+        # entity-sharded descent on a 2-shard entity mesh (skipped on a
+        # single-device backend — the layout needs real shards)
+        completed = False
+        if jax.device_count() >= n_shards:
+            import numpy as _np
+
+            from photon_ml_tpu.core.tasks import TaskType
+            from photon_ml_tpu.game import (
+                CoordinateConfig,
+                CoordinateDescent,
+                EntityShardedRandomEffectCoordinate,
+                GameData,
+                build_bucketed_random_effect_design,
+                entity_partition_game_data,
+                entity_shard_assignment,
+            )
+            from photon_ml_tpu.parallel.mesh import (
+                batch_sharding,
+                make_entity_mesh,
+            )
+
+            rng = _np.random.default_rng(11)
+            n_users, rows = 6, 5
+            users = _np.repeat(_np.arange(n_users), rows).astype(_np.int32)
+            n = users.size
+            xu = rng.normal(size=(n, 3))
+            y = (rng.uniform(size=n) < 0.5).astype(float)
+            data = GameData.create(
+                features={"u": xu}, labels=y,
+                entity_ids={"userId": users},
+            )
+            cfg = CoordinateConfig(
+                shard="u", random_effect="userId", reg_weight=1.0,
+                max_iters=8, tolerance=1e-8,
+            )
+            mesh = make_entity_mesh(
+                n_shards, devices=jax.devices()[:n_shards]
+            )
+            assignment = entity_shard_assignment(n_users, n_shards)
+            pdata, part = entity_partition_game_data(
+                data, "userId", assignment
+            )
+            design = build_bucketed_random_effect_design(
+                pdata, "userId", "u", n_users, num_buckets=1,
+                dtype=jnp.float64,
+            )
+            re = EntityShardedRandomEffectCoordinate(
+                design=design,
+                row_features=jnp.asarray(pdata.features["u"], jnp.float64),
+                row_entities=jnp.asarray(pdata.entity_ids["userId"]),
+                full_offsets_base=jnp.asarray(pdata.offsets, jnp.float64),
+                config=cfg, mesh=mesh, assignment=assignment,
+                partition=part,
+            )
+            put = lambda x: jax.device_put(
+                jnp.asarray(x), batch_sharding(mesh, _np.ndim(x))
+            )
+            cd = CoordinateDescent(
+                {"per-user": re},
+                labels=put(pdata.labels),
+                base_offsets=put(pdata.offsets),
+                weights=put(pdata.weights),
+                task=TaskType.LOGISTIC_REGRESSION,
+            )
+            model, history = cd.run(num_iterations=1)
+            assert history and _np.isfinite(history[-1].objective)
+            completed = True
+    finally:
+        multihost.configure_collective_resilience(
+            prev.timeout_s, prev.retries
+        )
+    return {
+        "skew_recovery_s": round(skew_recovery_s, 4),
+        "stalls_recorded": int(stalls),
+        "sharded_run_completed": completed,
+    }
+
+
 DRILLS: Dict[str, Callable[[bool], dict]] = {
     "site_registry": drill_site_registry,
     "serving_score": drill_serving_score,
@@ -1153,6 +1286,9 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     "heartbeat_loss": drill_heartbeat_loss,
     "host_loss_recovery": drill_host_loss_recovery,
     "torn_shard": drill_torn_shard,
+    # overlap-scaled partitioning (docs/PARALLEL.md): one deliberately
+    # slow shard -> straggler-attributed collective.stall, run completes
+    "shard_skew": drill_shard_skew,
     # model-quality observability (docs/OBSERVABILITY.md): covariate
     # shift alarms, quiet unshifted replay, flight-recorded snapshot,
     # quality.baseline fault degradation
@@ -1168,6 +1304,7 @@ MULTIHOST_DRILLS = (
     "heartbeat_loss",
     "host_loss_recovery",
     "torn_shard",
+    "shard_skew",
 )
 
 

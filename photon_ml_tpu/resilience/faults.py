@@ -87,6 +87,7 @@ KNOWN_SITES = (
     "heartbeat.miss",
     "checkpoint.shard_write",
     "quality.baseline",
+    "partition.shard_skew",
 )
 
 MODES = ("raise", "corrupt", "delay")

@@ -81,9 +81,11 @@ def _push_history(h: _History, s: jax.Array, y: jax.Array) -> _History:
     return lax.cond(ok, push, lambda h: h, h)
 
 
-def _two_loop(h: _History, grad: jax.Array) -> jax.Array:
-    """Classic two-loop recursion: returns the ASCENT direction H.grad
-    (caller negates). Invalid ring slots have rho=0 so they contribute 0."""
+def _two_loop_sequential(h: _History, grad: jax.Array) -> jax.Array:
+    """Classic two-loop recursion, one (d,)-vector dot/axpy per history
+    slot. Kept as the readable reference implementation; production uses
+    the Gram form below (identical recurrence — drilled to 1e-12 in
+    tests/test_solvers.py)."""
     m = h.s.shape[0]
 
     def backward(i, carry):
@@ -115,6 +117,87 @@ def _two_loop(h: _History, grad: jax.Array) -> jax.Array:
         return r + jnp.where(valid, alphas[j] - beta, 0.0) * h.s[j]
 
     return lax.fori_loop(0, m, forward, r)
+
+
+def _two_loop(h: _History, grad: jax.Array) -> jax.Array:
+    """Two-loop recursion in GRAM form: the same alpha/beta recurrence,
+    but every (d,)-vector contraction batched into five (m, d) matmuls.
+
+    The sequential form issues ~4m small sharded-vector ops per
+    direction, and under a 'feature' mesh every ``vdot`` over the
+    sharded coefficient axis is its OWN scalar all-reduce — ~2m
+    collective latencies per L-BFGS iteration, which BENCH_r06's
+    inverse-scaling chase measured as a dominant per-width overhead
+    (docs/PARALLEL.md). Here the cross-terms come from one (m, m) Gram
+    ``G = S Y^T`` plus two stacked history-vector products, so a
+    direction costs O(1) collectives regardless of m; the recurrences
+    themselves run on (m,)-replicated scalars. Expanding the recursion:
+
+        alpha_i = rho_i (s_i.g - sum_{l newer} alpha_l s_i.y_l)
+        q       = g - Y^T alpha
+        beta_i  = rho_i (gamma y_i.q + sum_{l older} (alpha_l - beta_l)
+                                         y_i.s_l)
+        r       = gamma q + S^T (alpha - beta)
+
+    — algebraically identical to the sequential loop (the float
+    summation order inside each dot differs; equality is drilled to
+    1e-12 in tests/test_solvers.py). Invalid ring slots keep rho=0 and
+    mask to zero exactly as before."""
+    m = h.s.shape[0]
+    dtype = grad.dtype
+    pos = jnp.arange(m, dtype=jnp.int32)
+    # backward order: newest -> oldest; slot j processed at step i
+    order_b = (h.head - 1 - pos) % m
+    step_of = jnp.zeros((m,), jnp.int32).at[order_b].set(pos)
+    valid = pos < h.count  # by backward step
+    valid_slot = valid[step_of]  # by ring slot
+
+    G = h.s @ h.y.T  # (m, m): G[a, b] = s_a . y_b — ONE contraction
+    sg = h.s @ grad  # (m,)
+    rho = h.rho
+
+    def backward(i, alphas):
+        j = order_b[i]
+        cross = jnp.sum(
+            jnp.where(step_of < i, alphas * G[j, :], 0.0)
+        )
+        alpha = jnp.where(
+            valid[i], rho[j] * (sg[j] - cross), 0.0
+        )
+        return alphas.at[j].set(alpha)
+
+    alphas = lax.fori_loop(
+        0, m, backward, jnp.zeros((m,), dtype)
+    )
+    q = grad - h.y.T @ alphas
+
+    newest = (h.head - 1) % m
+    gamma = jnp.where(
+        h.count > 0,
+        G[newest, newest]
+        / jnp.maximum(jnp.vdot(h.y[newest], h.y[newest]), 1e-30),
+        1.0,
+    )
+    yq = h.y @ q  # (m,)
+    # forward order: oldest -> newest among valid; reuse G transposed
+    # (y_j . s_l = G[l, j])
+    order_f = (h.head - h.count + pos) % m
+    fstep_of = jnp.zeros((m,), jnp.int32).at[order_f].set(pos)
+
+    def forward(i, betas):
+        j = order_f[i]
+        coeff = jnp.where(
+            (fstep_of < i) & valid_slot, alphas - betas, 0.0
+        )
+        cross = jnp.sum(coeff * G[:, j])
+        beta = jnp.where(
+            valid[i], rho[j] * (gamma * yq[j] + cross), 0.0
+        )
+        return betas.at[j].set(beta)
+
+    betas = lax.fori_loop(0, m, forward, jnp.zeros((m,), dtype))
+    coeff = jnp.where(valid_slot, alphas - betas, 0.0)
+    return gamma * q + h.s.T @ coeff
 
 
 class _LbfgsState(NamedTuple):

@@ -1348,3 +1348,61 @@ class TestQualityFingerprintExport:
         engine = ScoringEngine.from_model_dir(export)
         assert engine.drift is not None
         assert engine.drift.baseline.rows == 12 * 25
+
+
+@pytest.mark.partition
+class TestGameDriverEntitySharded:
+    """`photon-game-train --entity-shards N` (docs/PARALLEL.md): the
+    driver-level wiring of entity-sharded descent — permuted row
+    layout, shard_map'd random-effect coordinate, exported tables back
+    in GLOBAL entity order, equal to the unsharded driver run."""
+
+    def test_entity_sharded_matches_unsharded(self, rng, game_fixture):
+        train, valid, gs, us, tmp = game_fixture
+        base = game_params(train, valid, gs, us, str(tmp / "ges0"))
+        run_plain = run_game_training(base)
+
+        params = game_params(train, valid, gs, us, str(tmp / "ges1"))
+        params["entity_shards"] = 4
+        run_sharded = run_game_training(params)
+
+        m_plain = run_plain.sweep[0]["model"]
+        m_sharded = run_sharded.sweep[0]["model"]
+        # exported tables are back in GLOBAL order: same shapes, same
+        # values to solver tolerance
+        np.testing.assert_allclose(
+            np.asarray(m_sharded.params["global"]),
+            np.asarray(m_plain.params["global"]),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_sharded.params["per-user"]),
+            np.asarray(m_plain.params["per-user"]),
+            atol=1e-6,
+        )
+        assert run_sharded.sweep[0]["validation_metric"] == pytest.approx(
+            run_plain.sweep[0]["validation_metric"], abs=1e-6
+        )
+
+    def test_entity_sharded_with_sharded_ckpt(self, rng, game_fixture):
+        """--entity-shards + --sharded-ckpt compose: the stored-order
+        entity keys land in the checkpoint shards and the run resumes."""
+        train, valid, gs, us, tmp = game_fixture
+        params = game_params(train, valid, gs, us, str(tmp / "ges2"))
+        params["entity_shards"] = 2
+        params["sharded_ckpt"] = True
+        params["checkpoint_every"] = 1
+        params["validate_per_coordinate"] = False
+        run1 = run_game_training(params)
+        assert run1.sweep[0]["validation_metric"] is not None
+        # checkpoints were written sharded; a resumed run reuses them
+        ckpt_root = os.path.join(str(tmp / "ges2"), "checkpoints")
+        assert os.path.isdir(ckpt_root)
+        params["overwrite"] = True
+        params["resume"] = True
+        run2 = run_game_training(params)
+        np.testing.assert_allclose(
+            np.asarray(run2.sweep[0]["model"].params["per-user"]),
+            np.asarray(run1.sweep[0]["model"].params["per-user"]),
+            atol=1e-10,
+        )

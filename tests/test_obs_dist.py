@@ -939,10 +939,12 @@ class TestScalingEfficiencySentinel:
             obs_sentinel.metric_direction(name)
             == obs_sentinel.HIGHER_IS_BETTER
         )
-        assert obs_sentinel.metric_floor(name) == pytest.approx(0.125)
+        # RAISED absolute per-width targets since the overlap path
+        # landed (docs/PARALLEL.md; was the 0.25/N rule)
+        assert obs_sentinel.metric_floor(name) == pytest.approx(0.25)
         assert obs_sentinel.metric_floor(
             "extra.sparse_fs_scaling.8.scaling_efficiency"
-        ) == pytest.approx(0.25 / 8)
+        ) == pytest.approx(0.055)
         assert obs_sentinel.metric_floor("extra.dense.wall_s") is None
 
     def test_floor_gates_without_history(self):
