@@ -1982,6 +1982,76 @@ def bench_overload():
     }
 
 
+def bench_serving_sharded():
+    """Entity-sharded serving + tiered entity cache (docs/SERVING.md)
+    under the Zipf multi-tenant load the subsystems exist for, on the
+    8-virtual-device CPU mesh. Sentinel-tracked: ``serving_sharded_qps``
+    / ``serving_cached_qps`` / ``serving_unsharded_qps`` (higher — the
+    routed and cache-hit paths must sustain the unsharded rate),
+    ``cache_hit_frac`` (higher — the HBM tier must keep absorbing the
+    Zipf head), and ``resident_re_bytes_per_process`` (lower — the ~P x
+    per-process footprint drop mesh partitioning buys). The hard
+    invariants (sharded == unsharded <= 1e-10, zero lost requests under
+    a shard fault) are asserted by tests and the ``shard_fault`` chaos
+    drill, not just recorded."""
+    import jax
+
+    from benchmarks import serving_lab
+
+    common = [
+        "--clients", "8", "--requests", "1600",
+        "--baseline-requests", "40", "--zipf-alpha", "1.1",
+        "--tenants", "2",
+    ]
+    base = serving_lab.run(common)
+    cached = serving_lab.run(common + ["--hbm-cache-entities", "128"])
+    shards = min(8, jax.device_count())
+    sharded = serving_lab.run(
+        common + ["--serving-shards", str(shards)]
+    )
+    out = {
+        "serving_shards": shards,
+        "zipf_alpha": 1.1,
+        "serving_unsharded_qps": base["extra"]["qps"],
+        "serving_cached_qps": cached["extra"]["qps"],
+        "serving_sharded_qps": sharded["extra"]["qps"],
+        "cache_hit_frac": cached["extra"]["cache_hit_frac"],
+        "cache_promotions": cached["extra"]["cache"]["promotions"],
+        "unsharded_p99_ms": base["extra"]["p99_ms"],
+        "cached_p99_ms": cached["extra"]["p99_ms"],
+        "sharded_p99_ms": sharded["extra"]["p99_ms"],
+        "resident_re_bytes_per_process": sharded["extra"][
+            "resident_re_bytes_per_process"
+        ],
+        "resident_re_bytes_unsharded": base["extra"][
+            "resident_re_bytes_per_process"
+        ],
+        "sharded_steady_state_compiles": sharded["extra"][
+            "steady_state_compiles"
+        ],
+        "cached_steady_state_compiles": cached["extra"][
+            "steady_state_compiles"
+        ],
+    }
+    log(
+        f"serving sharded: {out['serving_unsharded_qps']} qps unsharded "
+        f"-> {out['serving_cached_qps']} qps cache-tier (hit_frac "
+        f"{out['cache_hit_frac']:.3f}) / {out['serving_sharded_qps']} "
+        f"qps @ {shards} shards (resident "
+        f"{out['resident_re_bytes_unsharded']} -> "
+        f"{out['resident_re_bytes_per_process']} B/process, "
+        f"{out['sharded_steady_state_compiles']} steady compiles)"
+    )
+    return out
+
+
+def _serving_sharded_cpu():
+    """The serving-sharded bench in a CPU subprocess (needs the
+    8-virtual-device mesh; the live platform here may be a 1-chip
+    tunnel)."""
+    return _cpu_subprocess("--serving-sharded", "serving sharded")
+
+
 def bench_multihost_resilience():
     """Elastic multi-host resilience (docs/MULTIHOST.md), measured on
     the single-process emulation path. Sentinel-tracked:
@@ -2253,6 +2323,11 @@ def main():
         help="run only the sparse benchmark (iteration aid)",
     )
     parser.add_argument(
+        "--serving-sharded", action="store_true",
+        help="run only the entity-sharded serving bench (used with "
+        "--cpu: 8 virtual devices)",
+    )
+    parser.add_argument(
         "--sentinel", action="store_true",
         help="after printing the record, gate it against the repo's "
         "BENCH_r*.json history (benchmarks/regression_sentinel.py "
@@ -2264,7 +2339,9 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        if args.sparse_scaling:  # the curve needs the 8-device mesh
+        # the scaling curve and the sharded-serving bench need the
+        # 8-device mesh
+        if args.sparse_scaling or args.serving_sharded:
             from photon_ml_tpu.utils.compat import force_cpu_devices
 
             force_cpu_devices(8)
@@ -2288,6 +2365,10 @@ def main():
         out = bench_sparse()
         print(json.dumps(out))
         return
+    if args.serving_sharded:
+        out = bench_serving_sharded()
+        print(json.dumps(out))
+        return
 
     rtt = _phase("tunnel_rtt", measure_tunnel_rtt)
     log(f"tunnel RTT: {rtt}")
@@ -2309,6 +2390,7 @@ def main():
     ingest = _phase("ingest", bench_ingest)
     ingest_pipe = _phase("ingest_pipeline", bench_ingest_pipeline)
     overload = _phase("serving_overload", bench_overload)
+    serving_sharded = _phase("serving_sharded", _serving_sharded_cpu)
     multihost_res = _phase(
         "multihost_resilience", bench_multihost_resilience
     )
@@ -2447,6 +2529,12 @@ def main():
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in overload.items()
         }
+    if serving_sharded:
+        # entity-sharded serving + tiered entity cache (docs/SERVING.md):
+        # routed/cache-hit/unsharded throughput, the Zipf cache hit
+        # fraction, and the per-process resident RE footprint (sentinel:
+        # _qps/hit_frac higher, resident bytes lower)
+        extra["serving_sharded"] = serving_sharded
     if multihost_res:
         # elastic multi-host resilience (docs/MULTIHOST.md): sharded
         # checkpoint write bandwidth + watchdogged collective recovery

@@ -7,12 +7,24 @@ request, blocks on its score, repeats) — the canonical open-vs-closed-loop
 serving benchmark shape: throughput is client-limited, so latency numbers
 are honest (no coordinated omission from a fixed-rate generator stalling).
 
+Multi-tenant Zipf mode (docs/SERVING.md): ``--zipf-alpha A`` draws each
+request's entity from a rank-popularity power law (rank r with p ∝ r^-A —
+the skew the tiered HBM/host cache exists for) and ``--tenants T`` splits
+the clients into T tenants reported separately (per-tenant qps/p99).
+``--hbm-cache-entities N`` serves through the tiered cache (hot head in
+HBM, misses fixed-effect-only while promotion runs) and the record
+carries the cache ``hit_frac``; ``--serving-shards P`` serves through the
+entity-sharded engine (RE tables mesh-partitioned, shard-routed
+micro-batches) and the record carries ``serving_sharded_qps`` + the
+per-process ``resident_re_bytes_per_process`` gauge.
+
 Reported record (BENCH-style single JSON line on stdout):
 
     {"metric": "serving_p99_ms", "value": <p99>, "unit": "ms",
      "vs_baseline": <unbatched-sequential p99 / batched p99>,
      "extra": {qps, p50/p95/p99, occupancy, bucket counters,
-               steady-state compiles (must be 0), ...}}
+               steady-state compiles (must be 0), per_tenant, cache,
+               ...}}
 
 ``--smoke`` shrinks everything for a CPU-only sanity run
 (``JAX_PLATFORMS=cpu python benchmarks/serving_lab.py --smoke``).
@@ -36,15 +48,26 @@ sys.path.insert(
 
 
 def build_synthetic_engine(
-    rng, d_fixed=64, d_user=16, n_users=512, latent_k=4, dtype=None
+    rng,
+    d_fixed=64,
+    d_user=16,
+    n_users=512,
+    latent_k=4,
+    dtype=None,
+    serving_shards=1,
+    hbm_cache_entities=None,
 ):
     """In-memory model: 'global' fixed effect over shard 'g', 'per-user'
-    random effect and 'fact' factored coordinate over shard 'u'."""
+    random effect and 'fact' factored coordinate over shard 'u'. With
+    ``serving_shards > 1`` the engine is entity-sharded over that many
+    devices; with ``hbm_cache_entities`` the RE tables serve through the
+    tiered HBM/host cache."""
     import jax.numpy as jnp
 
     from photon_ml_tpu.game.factored import FactoredParams
     from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
     from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.sharding import ShardedScoringEngine
 
     g_vocab = FeatureVocabulary(
         [feature_key(f"g{j}", "") for j in range(d_fixed)]
@@ -62,8 +85,7 @@ def build_synthetic_engine(
         ),
     }
     re_vocab = {f"user{i}": i for i in range(n_users)}
-    return ScoringEngine(
-        params,
+    kw = dict(
         shards={"global": "g", "per-user": "u", "fact": "u"},
         random_effects={
             "global": None, "per-user": "userId", "fact": "userId"
@@ -72,9 +94,26 @@ def build_synthetic_engine(
         re_vocabs={"userId": re_vocab},
         **({"dtype": dtype} if dtype is not None else {}),
     )
+    if serving_shards > 1:
+        return ShardedScoringEngine(
+            params, num_shards=serving_shards, **kw
+        )
+    if hbm_cache_entities:
+        kw["hbm_cache_entities"] = hbm_cache_entities
+    return ScoringEngine(params, **kw)
 
 
-def make_request(rng, d_fixed, d_user, n_users, cold_rate=0.1):
+def zipf_probs(n: int, alpha: float) -> np.ndarray:
+    """Rank-popularity law over entity indices [0, n): p(r) ∝ (r+1)^-α
+    — index 0 is the hottest entity, so the 'hot head' of the tiered
+    cache is literally the low-index block."""
+    p = (np.arange(1, n + 1, dtype=np.float64)) ** (-float(alpha))
+    return p / p.sum()
+
+
+def make_request(
+    rng, d_fixed, d_user, n_users, cold_rate=0.1, entity_probs=None
+):
     from photon_ml_tpu.serving.engine import ScoreRequest
 
     feats = {
@@ -87,12 +126,21 @@ def make_request(rng, d_fixed, d_user, n_users, cold_rate=0.1):
             for j in rng.integers(0, d_user, size=4)
         }
     )
-    user = (
-        f"user{int(rng.integers(0, n_users))}"
-        if rng.uniform() > cold_rate
-        else f"coldstart{int(rng.integers(0, 1 << 30))}"
-    )
+    if rng.uniform() <= cold_rate:
+        user = f"coldstart{int(rng.integers(0, 1 << 30))}"
+    elif entity_probs is not None:
+        user = f"user{int(rng.choice(n_users, p=entity_probs))}"
+    else:
+        user = f"user{int(rng.integers(0, n_users))}"
     return ScoreRequest(features=feats, entities={"userId": user})
+
+
+def _window_hit_frac(before: dict, after: dict) -> float:
+    """Cache hit fraction over one measurement window (counter deltas)."""
+    hits = after["hits"] - before["hits"]
+    misses = after["misses"] - before["misses"]
+    total = hits + misses
+    return round(hits / total, 6) if total else 0.0
 
 
 def run(argv=None) -> dict:
@@ -104,6 +152,18 @@ def run(argv=None) -> dict:
     p.add_argument("--max-wait-ms", type=float, default=1.0)
     p.add_argument("--baseline-requests", type=int, default=200,
                    help="sequential unbatched calls for the baseline")
+    p.add_argument("--zipf-alpha", type=float, default=0.0,
+                   help="entity popularity skew (0 = uniform); the "
+                   "multi-tenant cache-tier load shape")
+    p.add_argument("--tenants", type=int, default=1,
+                   help="split the clients into N tenants reported "
+                   "separately (per-tenant qps/p99)")
+    p.add_argument("--serving-shards", type=int, default=1,
+                   help="serve through the entity-sharded engine over "
+                   "this many devices")
+    p.add_argument("--hbm-cache-entities", type=int, default=None,
+                   help="serve through the tiered HBM/host entity cache "
+                   "with this hot-head capacity")
     p.add_argument("--smoke", action="store_true",
                    help="tiny CPU-safe configuration")
     args = p.parse_args(argv)
@@ -111,20 +171,41 @@ def run(argv=None) -> dict:
         args.clients = min(args.clients, 4)
         args.requests = min(args.requests, 400)
         args.baseline_requests = min(args.baseline_requests, 50)
+    if args.tenants < 1 or args.clients % args.tenants:
+        p.error("--tenants must divide --clients")
 
     from photon_ml_tpu.serving.batcher import MicroBatcher
     from photon_ml_tpu.serving.stats import xla_compile_events
 
     rng = np.random.default_rng(20260804)
     d_fixed, d_user, n_users = (32, 8, 128) if args.smoke else (64, 16, 512)
-    engine = build_synthetic_engine(rng, d_fixed, d_user, n_users)
+    engine = build_synthetic_engine(
+        rng, d_fixed, d_user, n_users,
+        serving_shards=args.serving_shards,
+        hbm_cache_entities=args.hbm_cache_entities,
+    )
     engine.warmup(max_batch=args.max_batch)
 
     # pre-generate requests so the generator is not part of the loop
+    probs = (
+        zipf_probs(n_users, args.zipf_alpha) if args.zipf_alpha else None
+    )
     reqs = [
-        make_request(rng, d_fixed, d_user, n_users)
+        make_request(
+            rng, d_fixed, d_user, n_users, entity_probs=probs
+        )
         for _ in range(max(args.requests, args.baseline_requests))
     ]
+
+    if args.hbm_cache_entities:
+        # warm the HBM tier with the trace's Zipf head so the measured
+        # loop is the steady-state HIT path (a cold tier measures
+        # promotion throughput, not serving; the cold tail still
+        # misses). The warm pass rides the already-compiled buckets.
+        for lo in range(0, len(reqs), args.max_batch):
+            engine.score(reqs[lo: lo + args.max_batch])
+        for cache in engine._caches.values():
+            cache.flush()
 
     # -- baseline: sequential, unbatched (batch-of-1 engine calls) ---------
     base_lat = []
@@ -141,10 +222,12 @@ def run(argv=None) -> dict:
         max_wait_ms=args.max_wait_ms,
         queue_depth=4 * args.requests,
         stats=engine.stats,  # one ledger: bucket counters + batch latencies
+        presort_fn=getattr(engine, "shard_presort_key", None),
     )
     per_client = args.requests // args.clients
     latencies = [[] for _ in range(args.clients)]
     compiles_before = xla_compile_events()
+    cache_before = engine.stats.snapshot()["cache"]
 
     def client(ci: int) -> None:
         lo = ci * per_client
@@ -169,6 +252,23 @@ def run(argv=None) -> dict:
     lat = np.concatenate([np.asarray(c) for c in latencies])
     snap = batcher.stats.snapshot()
     p99 = float(np.percentile(lat, 99))
+    qps = lat.size / wall
+    # per-tenant view: clients partition round-robin into tenants; each
+    # tenant's qps is its own completed requests over the shared wall
+    per_tenant = {}
+    for t in range(args.tenants):
+        t_lat = np.concatenate(
+            [
+                np.asarray(latencies[ci])
+                for ci in range(t, args.clients, args.tenants)
+            ]
+        )
+        per_tenant[f"tenant{t}"] = {
+            "requests": int(t_lat.size),
+            "qps": round(t_lat.size / wall, 1),
+            "p50_ms": round(float(np.percentile(t_lat, 50)), 4),
+            "p99_ms": round(float(np.percentile(t_lat, 99)), 4),
+        }
     record = {
         "metric": "serving_p99_ms",
         "value": round(p99, 4),
@@ -176,8 +276,11 @@ def run(argv=None) -> dict:
         "vs_baseline": round(base_p99 / p99, 3) if p99 > 0 else None,
         "extra": {
             "clients": args.clients,
+            "tenants": args.tenants,
+            "zipf_alpha": args.zipf_alpha,
+            "serving_shards": args.serving_shards,
             "requests": int(lat.size),
-            "qps": round(lat.size / wall, 1),
+            "qps": round(qps, 1),
             "p50_ms": round(float(np.percentile(lat, 50)), 4),
             "p95_ms": round(float(np.percentile(lat, 95)), 4),
             "p99_ms": round(p99, 4),
@@ -190,9 +293,23 @@ def run(argv=None) -> dict:
             "steady_state_compiles": steady_compiles,
             "device_p50_ms": snap["device_latency"]["p50_ms"],
             "engine_compile_count": engine.compile_count,
+            "per_tenant": per_tenant,
+            "cache": snap["cache"],
+            # the measured loop's hit fraction (tier-warmup and baseline
+            # traffic excluded): the steady-state Zipf answer
+            "cache_hit_frac": _window_hit_frac(
+                cache_before, snap["cache"]
+            ),
+            "resident_re_bytes_per_process": snap[
+                "resident_re_bytes_per_process"
+            ],
             "smoke": bool(args.smoke),
         },
     }
+    if args.serving_shards > 1:
+        record["extra"]["serving_sharded_qps"] = round(qps, 1)
+        record["extra"]["shards"] = snap["shards"]
+    engine.close()
     print(json.dumps(record))
     return record
 

@@ -47,6 +47,13 @@ important request shed the oldest lower-priority queued one when the
 bounded queue is full. Under sustained queue pressure the batcher
 degrades to fixed-effect-only scoring (``--no-degrade`` disables).
 
+``--serving-shards P`` serves through the entity-sharded engine (RE
+tables mesh-partitioned over P devices by the sharded-checkpoint
+ownership rule, shard-routed micro-batches, zero cross-shard
+collectives); ``--hbm-cache-entities N`` serves through the tiered
+HBM/host entity cache (hot Zipf head in HBM, misses score
+fixed-effect-only while async promotion runs) — docs/SERVING.md.
+
 Unknown feature keys are ignored per shard vocabulary (ingest semantics);
 unknown entity ids score fixed-effect-only (cold start). SIGTERM/SIGINT
 drain the micro-batcher — accepted requests finish, new ones are refused —
@@ -365,8 +372,26 @@ def main(argv=None) -> None:
         help="initial backoff before a quarantined export is re-probed "
         "(doubles per failed probe)",
     )
+    p.add_argument(
+        "--serving-shards", type=int, default=1,
+        help="partition RE tables over an N-shard entity mesh (one "
+        "shard per device; requests route to owning shards and partial "
+        "scores merge — docs/SERVING.md). Default 1 = unsharded.",
+    )
+    p.add_argument(
+        "--hbm-cache-entities", type=int, default=None,
+        help="tiered entity cache: keep this many hot entities per RE "
+        "key in HBM, the cold tail in host RAM with async promotion; a "
+        "miss scores fixed-effect-only (cold-start semantics) while "
+        "the promotion is in flight",
+    )
     p.add_argument("--stats-json", help="dump a stats snapshot here on exit")
     args = p.parse_args(argv)
+    if args.serving_shards > 1 and args.hbm_cache_entities:
+        p.error(
+            "--hbm-cache-entities composes with the unsharded engine; "
+            "on a sharded mesh each shard's slice is the resident set"
+        )
     # after parse_args: --help / bad flags must not initialize the backend
     import jax.numpy as jnp
 
@@ -386,6 +411,12 @@ def main(argv=None) -> None:
         logger=logger,
         dtype={"float32": jnp.float32, "float64": jnp.float64}[args.dtype],
         min_bucket=args.min_bucket,
+        serving_shards=args.serving_shards,
+        **(
+            {"hbm_cache_entities": args.hbm_cache_entities}
+            if args.hbm_cache_entities
+            else {}
+        ),
     )
     registry.load(args.model_dir)
     slo = SloTracker(

@@ -592,6 +592,22 @@ class EntityShardAssignment:
             self.num_shards - 1,
         )
 
+    def owner_of_global(self, entities: np.ndarray) -> np.ndarray:
+        """Owning shard of each GLOBAL entity index — THE ownership
+        lookup shared by entity-sharded training, sharded checkpoints,
+        and shard-routed serving (all derive from ``shard_rows``).
+        Callers pass valid indices in [0, num_entities)."""
+        ents = np.asarray(entities, np.int64)
+        return self.shard_of_stored(self.global_to_stored[ents])
+
+    def local_of_global(self, entities: np.ndarray) -> np.ndarray:
+        """Row of each GLOBAL entity index within its owner shard's
+        block (the shard-LOCAL gather index a per-shard table slice
+        uses)."""
+        ents = np.asarray(entities, np.int64)
+        stored = self.global_to_stored[ents]
+        return (stored - self.shard_of_stored(stored) * self.rows_per_shard)
+
     def stored_entity_keys(self, global_keys) -> list:
         """Global entity-key list -> the STORED (shard-major) order the
         device table holds, pad rows keyed uniquely so checkpoint

@@ -236,6 +236,49 @@ def score_game_data(
     return total
 
 
+def compact_table_rows(rows: np.ndarray, k: int):
+    """Compact a BLOCK of dense table rows at a FORCED width ``k``
+    (columns ascending, pad column = d, pad value = 0) — exactly
+    ``_compact_table``'s per-row output, but with ``k`` imposed by the
+    caller so every shard of a partitioned table compacts to ONE static
+    executable shape. Raises when a row holds more than ``k`` nonzeros."""
+    t = np.asarray(rows)
+    e, d = t.shape
+    cols = np.full((e, k), d, np.int32)
+    vals = np.zeros((e, k), t.dtype)
+    if e == 0:
+        return cols, vals
+    ent, col = np.nonzero(t)
+    counts = np.bincount(ent, minlength=e)
+    if counts.size and int(counts.max()) > k:
+        raise ValueError(
+            f"row with {int(counts.max())} nonzeros cannot compact at "
+            f"width k={k}"
+        )
+    starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
+    slot = np.arange(ent.size) - starts[ent]
+    cols[ent, slot] = col
+    vals[ent, slot] = t[ent, col]
+    return cols, vals
+
+
+def shard_compact_table(compact: CompactReTable, assignment) -> CompactReTable:
+    """Reorder a GLOBAL :class:`CompactReTable` into the stored
+    (shard-major, padded) layout of an ``EntityShardAssignment``: shard
+    p's entities contiguous in block ``[p*R, (p+1)*R)``, pad rows all-
+    zero values (they score 0 wherever gathered). The compact-shard
+    bridge between the serving engine's mesh partitioning and the
+    checkpoint/device ownership rule (docs/PARALLEL.md)."""
+    cols = np.asarray(compact.columns, np.int32)
+    vals = np.asarray(compact.values)
+    out_c = np.zeros((assignment.padded_rows,) + cols.shape[1:], cols.dtype)
+    out_v = np.zeros((assignment.padded_rows,) + vals.shape[1:], vals.dtype)
+    real = assignment.stored_to_global < assignment.num_entities
+    out_c[real] = cols[assignment.stored_to_global[real]]
+    out_v[real] = vals[assignment.stored_to_global[real]]
+    return CompactReTable(columns=out_c, values=out_v)
+
+
 def precompact_model(params: Dict[str, object]) -> Dict[str, object]:
     """Replace every (E, d) random-effect coefficient table with its
     :class:`CompactReTable` — pre-compact ONCE instead of leaning on the

@@ -109,6 +109,15 @@ _DIRECTION_RULES = (
     # companion p99_under_overload_ms / breaker_recovery_s gate through
     # the generic _ms/_s lower-is-better rules below
     (re.compile(r"shed_frac$"), LOWER_IS_BETTER),
+    # entity-sharded serving + tiered entity cache (docs/SERVING.md,
+    # bench_serving_sharded): sustained throughput of the sharded and
+    # cache-tier hit paths, the cache hit fraction under the Zipf load
+    # the tier exists for, and the per-process resident RE-table
+    # footprint (the ~P x drop mesh partitioning buys — creep here is
+    # the capacity regression wall clocks cannot see)
+    (re.compile(r"_qps$"), HIGHER_IS_BETTER),
+    (re.compile(r"hit_frac$"), HIGHER_IS_BETTER),
+    (re.compile(r"resident.*bytes"), LOWER_IS_BETTER),
     # model-quality observability (docs/OBSERVABILITY.md "Quality &
     # drift", bench_quality): the serving path's wall with the
     # DriftMonitor sampling vs without (creep here is the quality

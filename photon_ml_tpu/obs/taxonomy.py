@@ -83,6 +83,19 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         "retry/fault/rollback/preemption/host-loss events + counters",
     ),
     (
+        "serving.cache",
+        r"serving\.cache\.[a-z_]+",
+        "tiered HBM/host entity cache: hit/miss/promotion/demotion "
+        "counters, tier-error counter (serving/cache.py)",
+    ),
+    (
+        "serving.shard",
+        r"serving\.shard\.[a-z0-9_.]+",
+        "entity-sharded serving: per-shard occupancy gauges + device "
+        "latency histograms, shard-degraded counters, the per-process "
+        "resident RE-table footprint gauge (serving/sharding.py)",
+    ),
+    (
         "serving",
         r"serving\.[a-z_]+(\..+)?",
         "ServingStats registry metrics, request spans, SLO gauges",

@@ -1270,6 +1270,157 @@ def drill_shard_skew(smoke: bool = True) -> dict:
     }
 
 
+def drill_shard_fault(smoke: bool = True) -> dict:
+    """Entity-sharded serving under a single-shard fault
+    (docs/SERVING.md): with ``serving.shard_route`` armed raise-mode for
+    ONE shard, every request still completes (zero lost), the faulted
+    shard's entities degrade to fixed-effect-only scores — bit-equal to
+    the engine's degraded executable on those rows — other shards'
+    entities stay exact, the latency ledger stays honest (every scored
+    request is in the histogram), and the next batch after the fault
+    clears is exact again. Also drills ``serving.cache_tier``: a failed
+    promotion batch leaves its entities cold (served fixed-effect-only),
+    and the retry after the fault clears promotes them to exact scores."""
+    import jax
+
+    from photon_ml_tpu.serving.batcher import MicroBatcher
+    from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.sharding import ShardedScoringEngine
+
+    from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+    rng = np.random.default_rng(13)
+    n_users, d_fixed, d_user = 24, 8, 4
+    params = {
+        "global": rng.normal(size=d_fixed),
+        "per-user": rng.normal(size=(n_users, d_user)),
+    }
+    kw = dict(
+        shards={"global": "g", "per-user": "u"},
+        random_effects={"global": None, "per-user": "userId"},
+        shard_vocabs={
+            "g": FeatureVocabulary(
+                [feature_key(f"g{j}", "") for j in range(d_fixed)]
+            ),
+            "u": FeatureVocabulary(
+                [feature_key(f"u{j}", "") for j in range(d_user)]
+            ),
+        },
+        re_vocabs={"userId": {f"user{i}": i for i in range(n_users)}},
+    )
+    n_shards = min(2, jax.device_count())
+    engine = ShardedScoringEngine(params, num_shards=n_shards, **kw)
+    reference = ScoringEngine(params, **kw)
+    b = 16
+    feats = {
+        "g": rng.normal(size=(b, d_fixed)),
+        "u": rng.normal(size=(b, d_user)),
+    }
+    ents = {"userId": rng.integers(0, n_users, size=b).astype(np.int32)}
+    exact = reference.score_arrays(feats, ents)
+    fixed_only = reference.score_arrays(feats, ents, fixed_only=True)
+    owner = engine.assignments["userId"].owner_of_global(ents["userId"])
+    victim = 0
+    on_victim = owner == victim
+
+    # (1) single-shard fault: victim's entities degrade, nothing is lost
+    with inject(
+        FaultSpec(
+            "serving.shard_route", "raise", nth=1, count=-1,
+            key=str(victim),
+        )
+    ):
+        scores = engine.score_arrays(feats, ents)
+    assert np.all(np.isfinite(scores)), "requests lost to the shard fault"
+    assert np.allclose(
+        scores[on_victim], fixed_only[on_victim], atol=1e-10
+    ), "faulted shard's entities must serve fixed-effect-only"
+    if n_shards > 1 and np.any(~on_victim):
+        assert np.allclose(
+            scores[~on_victim], exact[~on_victim], atol=1e-10
+        ), "healthy shards' entities must stay exact under the fault"
+    degraded_rows = int(
+        engine.stats.registry.counter("serving.shard.degraded_rows").value
+    )
+
+    # (2) recovery: the next routed batch is exact everywhere
+    recovered = engine.score_arrays(feats, ents)
+    assert np.allclose(recovered, exact, atol=1e-10)
+
+    # (3) honest ledger under the fault, through the batcher: every
+    # accepted request scores AND lands in the latency histogram
+    batcher = MicroBatcher(engine.score, max_batch=8, max_wait_ms=0.5)
+    try:
+        with inject(
+            FaultSpec(
+                "serving.shard_route", "raise", nth=1, count=-1,
+                key=str(victim),
+            )
+        ):
+            futs = [
+                batcher.submit(make_drill_request(rng, d_fixed, d_user,
+                                                  n_users))
+                for _ in range(24)
+            ]
+            results = [f.result(timeout=30.0) for f in futs]
+        assert all(np.isfinite(r) for r in results)
+        snap = batcher.stats.snapshot()
+        assert snap["request_latency"]["count"] == len(results), (
+            "p99 ledger must count every degraded-mode request"
+        )
+    finally:
+        batcher.drain(timeout=5.0)
+
+    # (4) cache tier fault: promotion fails -> entities stay cold
+    # (fixed-effect-only), retry after the fault clears promotes
+    cached = ScoringEngine(params, hbm_cache_entities=4, **kw)
+    try:
+        cold = np.asarray([20, 21, 22, 23], np.int32)  # outside the head
+        cold_feats = {k: v[:4] for k, v in feats.items()}
+        cold_exact = reference.score_arrays(
+            cold_feats, {"userId": cold}
+        )
+        cold_fixed = reference.score_arrays(
+            cold_feats, {"userId": cold}, fixed_only=True
+        )
+        with inject(
+            FaultSpec("serving.cache_tier", "raise", nth=1, count=-1)
+        ):
+            got = cached.score_arrays(cold_feats, {"userId": cold})
+            for cache in cached._caches.values():
+                cache.flush()
+            still_cold = cached.score_arrays(
+                cold_feats, {"userId": cold}
+            )
+        assert np.allclose(got, cold_fixed, atol=1e-10)
+        assert np.allclose(still_cold, cold_fixed, atol=1e-10), (
+            "a failed promotion must leave entities cold, not corrupt"
+        )
+        tier_errors = int(
+            cached.stats.registry.counter(
+                "serving.cache.tier_errors"
+            ).value
+        )
+        assert tier_errors >= 1, "failed promotion must be counted"
+        # the failed batch's entities re-enqueue on their NEXT miss;
+        # with the fault cleared the retry promotes them
+        cached.score_arrays(cold_feats, {"userId": cold})
+        for cache in cached._caches.values():
+            cache.flush()
+        promoted = cached.score_arrays(cold_feats, {"userId": cold})
+        assert np.allclose(promoted, cold_exact, atol=1e-10), (
+            "cleared fault must let the retry promote to exact scores"
+        )
+    finally:
+        cached.close()
+    return {
+        "serving_shards": n_shards,
+        "degraded_rows": degraded_rows,
+        "batched_requests": len(results),
+        "cache_tier_errors": tier_errors,
+    }
+
+
 DRILLS: Dict[str, Callable[[bool], dict]] = {
     "site_registry": drill_site_registry,
     "serving_score": drill_serving_score,
@@ -1293,6 +1444,11 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     # shift alarms, quiet unshifted replay, flight-recorded snapshot,
     # quality.baseline fault degradation
     "drift_alarm": drill_drift_alarm,
+    # entity-sharded serving + tiered cache (docs/SERVING.md): a single-
+    # shard routing fault degrades its entities to fixed-effect-only
+    # with zero lost requests and an honest p99 ledger; a failed cache
+    # promotion leaves entities cold, never corrupt
+    "shard_fault": drill_shard_fault,
 }
 
 # the subset `photon-chaos drill --multihost-smoke` runs: every drill of

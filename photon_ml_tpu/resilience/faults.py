@@ -33,6 +33,15 @@ Sites in-tree today::
                             (key = export dir name; raise = unreadable,
                             corrupt = torn/garbage fingerprint — serving
                             must continue WITHOUT drift monitoring)
+    serving.shard_route     per shard per routed batch of the entity-
+                            sharded engine (key = shard index; raise/
+                            corrupt = shard down — its entities degrade
+                            to fixed-effect-only, zero lost requests;
+                            delay = a slow route leg)
+    serving.cache_tier      per tiered-cache promotion batch (key = RE
+                            key; raise = failed host->HBM copy — the
+                            entities stay cold and serve fixed-effect-
+                            only; delay = a slow tier)
 
 Arming a site OUTSIDE this list raises at arm time: a typo'd drill that
 silently probes nothing would "pass" by testing nothing. Libraries that
@@ -88,6 +97,8 @@ KNOWN_SITES = (
     "checkpoint.shard_write",
     "quality.baseline",
     "partition.shard_skew",
+    "serving.shard_route",
+    "serving.cache_tier",
 )
 
 MODES = ("raise", "corrupt", "delay")

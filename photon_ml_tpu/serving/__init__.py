@@ -18,6 +18,12 @@ asks for:
 - :mod:`.stats`    — latency histograms (p50/p95/p99), QPS, batch
   occupancy, bucket/compile counters, shed/expired/degraded counters;
   JSON snapshots.
+- :mod:`.sharding` — entity-sharded serving: RE tables mesh-partitioned
+  by the sharded-checkpoint ownership rule, shard-routed micro-batches,
+  zero-collective shard_map scoring, sharded-checkpoint streaming loads.
+- :mod:`.cache`    — tiered HBM/host entity cache: hot Zipf head in the
+  HBM tier, cold tail in host RAM, async promotion/demotion off the
+  scoring path; a miss scores fixed-effect-only (cold-start semantics).
 
 Entry points: ``python -m photon_ml_tpu.cli.serve`` and
 ``benchmarks/serving_lab.py`` (closed-loop load generator);
@@ -45,6 +51,14 @@ from photon_ml_tpu.serving.registry import (
     ReloadCircuitBreaker,
     ReloadQuarantined,
 )
+from photon_ml_tpu.serving.cache import TieredEntityCache
+from photon_ml_tpu.serving.sharding import (
+    RoutedBatch,
+    ShardedCompactTable,
+    ShardedScoringEngine,
+    load_sharded_re_table,
+    route_batch,
+)
 from photon_ml_tpu.serving.stats import (
     LatencyHistogram,
     ServingStats,
@@ -53,6 +67,12 @@ from photon_ml_tpu.serving.stats import (
 )
 
 __all__ = [
+    "RoutedBatch",
+    "ShardedCompactTable",
+    "ShardedScoringEngine",
+    "TieredEntityCache",
+    "load_sharded_re_table",
+    "route_batch",
     "Backpressure",
     "DeadlineExceeded",
     "MicroBatcher",

@@ -1,0 +1,337 @@
+"""Tiered HBM/host entity cache for the serving engine.
+
+The GAME workload the paper serves — one tiny model per user/item at
+"hundreds of billions of coefficients" — has a Zipf-shaped access
+pattern: a small hot head of entities takes almost all traffic while the
+cold tail is touched rarely. Pinning EVERY entity's coefficients in HBM
+(what the engine did before) makes serving capacity a function of the
+coldest entity; this module makes it a function of the *working set*:
+
+- **HBM tier.** A fixed-capacity slab of ``capacity`` entity rows per
+  table, passed to every bucket executable as an ordinary parameter.
+  Promotion swaps row *contents* at fixed shapes, so the power-of-two
+  AOT executables never recompile.
+- **Host tier.** The full compact tables stay in host RAM (the
+  pinned-host-memory analog on a CPU build) — the durable source every
+  promotion copies from.
+- **Miss semantics.** A request whose entity is not resident maps to
+  slot ``-1``; every random-effect kernel scores ``-1`` as 0, so the
+  miss scores *fixed-effect-only* — numerically the engine's degraded
+  ``_score_padded_fixed`` answer and the cold-start answer, to 1e-10 —
+  while the promotion runs on a background worker. A miss costs
+  fidelity on that one request; it NEVER stalls the batch or holds the
+  scoring path behind a host->device copy.
+- **Async promotion/demotion.** Misses enqueue; the worker drains them
+  in first-miss order, evicting least-recently-used residents when the
+  tier is full. Promotions land through a jitted fixed-shape scatter
+  (``promote_batch`` rows per dispatch, sentinel-padded) so the update
+  path is also compile-free. With ``worker=False`` promotion is driven
+  explicitly (:meth:`promote_pending`) — the deterministic mode the
+  replay tests use.
+
+One cache serves one RE key and every coordinate keyed by it (all such
+coordinates must agree on slot ids because the traced scoring body
+gathers them with ONE entity column). Chaos drills arm the
+``serving.cache_tier`` fault site, probed once per promotion batch.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.resilience import faults as _faults
+
+DEFAULT_PROMOTE_BATCH = 64
+
+
+@jax.jit
+def _scatter_rows(tier, slots, rows):
+    """tier (C, ...) with rows (K, ...) written at ``slots`` (K,) —
+    sentinel slots (>= C; a NEGATIVE sentinel would wrap to a live
+    slot) drop. K is the fixed promote batch, so this compiles once
+    per table shape."""
+    return tier.at[slots].set(rows, mode="drop")
+
+
+class TieredEntityCache:
+    """Hot-head HBM tier + host-RAM tail for one RE key's row tables."""
+
+    def __init__(
+        self,
+        re_key: str,
+        *,
+        num_entities: int,
+        capacity: int,
+        dtype=jnp.float64,
+        stats=None,
+        worker: bool = True,
+        promote_batch: int = DEFAULT_PROMOTE_BATCH,
+        preload_head: bool = True,
+    ):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.re_key = re_key
+        self.num_entities = int(num_entities)
+        self.capacity = int(min(capacity, max(num_entities, 1)))
+        self.dtype = dtype
+        self.stats = stats
+        self.promote_batch = int(promote_batch)
+        self._preload_head = preload_head
+        self._worker_enabled = worker
+        # host tier: (name, field) -> (E, ...) numpy (the cold tail's
+        # durable copy); device tier filled at seal()
+        self._host: Dict[Tuple[str, str], np.ndarray] = {}
+        self._dev: Dict[Tuple[str, str], jax.Array] = {}
+        # slot bookkeeping: global entity -> HBM slot (-1 = cold) and
+        # the inverse; last_used drives LRU demotion
+        self.slot_of = np.full(self.num_entities, -1, np.int32)
+        self.entity_of = np.full(self.capacity, -1, np.int32)
+        self._last_used = np.zeros(self.capacity, np.int64)
+        self._tick = 0
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._pending: "collections.deque" = collections.deque()
+        self._pending_set: set = set()
+        # bumped on every promotion batch: lets the engine reuse its
+        # params view until the tier actually changed (the hit path
+        # then costs one integer compare, not a dict rebuild)
+        self.generation = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._sealed = False
+
+    # -- construction ------------------------------------------------------
+
+    def add_table(self, name: str, field: str, host: np.ndarray) -> None:
+        """Register one entity-keyed row table (e.g. a CompactReTable's
+        columns) with the host tier; rows [0, num_entities)."""
+        if self._sealed:
+            raise RuntimeError("cache already sealed")
+        host = np.ascontiguousarray(host)
+        if host.shape[0] != self.num_entities:
+            raise ValueError(
+                f"table {name}.{field} has {host.shape[0]} rows, cache "
+                f"covers {self.num_entities} entities"
+            )
+        self._host[(name, field)] = host
+
+    def seal(self) -> None:
+        """Allocate the HBM tier, optionally preload the head (entities
+        [0, capacity) — the Zipf hot set under a popularity-ranked
+        vocabulary), and start the promotion worker."""
+        if self._sealed:
+            return
+        self._sealed = True
+        for key, host in self._host.items():
+            self._dev[key] = jnp.zeros(
+                (self.capacity,) + host.shape[1:], host.dtype
+            )
+        if self._preload_head and self.num_entities:
+            head = list(range(min(self.capacity, self.num_entities)))
+            with self._lock:
+                for e in head:
+                    self._pending.append(e)
+                    self._pending_set.add(e)
+            self.promote_pending()
+        if self._worker_enabled:
+            self._thread = threading.Thread(
+                target=self._run, name=f"cache-tier-{self.re_key}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    # -- scoring-path surface ----------------------------------------------
+
+    def translate(self, ents: np.ndarray, with_tables: bool = False):
+        """Global entity indices -> HBM slot ids. Cold/unknown (< 0 or
+        not resident) -> -1; misses enqueue for async promotion. O(B)
+        numpy, no device work — this IS the scoring path, so it never
+        blocks on a copy.
+
+        With ``with_tables`` also returns ``(generation, tables)``
+        captured under the SAME lock as the slot resolution — the
+        consistent pair a scoring call must use: a promotion landing
+        between slot resolution and the device call may EVICT a
+        resolved slot, and a slot id is only meaningful against the
+        tier contents it was resolved for."""
+        ents = np.asarray(ents, np.int32)
+        known = (ents >= 0) & (ents < self.num_entities)
+        slots = np.full(ents.shape, -1, np.int32)
+        with self._lock:
+            slots[known] = self.slot_of[ents[known]]
+            hit = slots >= 0
+            self._tick += 1
+            self._last_used[slots[hit]] = self._tick
+            missed = np.unique(ents[known & ~hit])
+            for e in missed.tolist():
+                if e not in self._pending_set:
+                    self._pending.append(e)
+                    self._pending_set.add(e)
+            snapshot = (
+                (self.generation, dict(self._dev)) if with_tables else None
+            )
+        hits = int(np.count_nonzero(hit))
+        misses = int(np.count_nonzero(known) - hits)
+        if self.stats is not None:
+            self.stats.record_cache(hits, misses)
+        if misses and self._thread is not None:
+            self._wake.set()
+        if with_tables:
+            return slots, snapshot
+        return slots
+
+    def tables_snapshot(self):
+        """(generation, tables) under the lock — the no-entities-in-
+        this-batch counterpart of ``translate(with_tables=True)``."""
+        with self._lock:
+            return (self.generation, dict(self._dev))
+
+    def device_tables(self) -> Dict[Tuple[str, str], jax.Array]:
+        """Snapshot of the current HBM tier arrays (atomic: promotion
+        swaps whole arrays under the lock)."""
+        with self._lock:
+            return dict(self._dev)
+
+    # -- promotion / demotion ----------------------------------------------
+
+    def _claim_slots(self, entities: List[int]) -> List[Tuple[int, int]]:
+        """Assign a slot per entity (free first, then LRU victim),
+        updating the maps; returns (entity, slot) pairs. Caller holds
+        the lock."""
+        out = []
+        demoted = 0
+        for e in entities:
+            if self.slot_of[e] >= 0:
+                continue  # raced: already resident
+            if self._free:
+                slot = self._free.pop()
+            else:
+                # LRU victim: oldest last_used, lowest slot on ties —
+                # deterministic under a replayed trace
+                slot = int(np.argmin(self._last_used))
+                old = int(self.entity_of[slot])
+                if old >= 0:
+                    self.slot_of[old] = -1
+                    demoted += 1
+            self.slot_of[e] = slot
+            self.entity_of[slot] = e
+            self._last_used[slot] = self._tick
+            out.append((e, slot))
+        if demoted and self.stats is not None:
+            self.stats.record_demotions(demoted)
+        return out
+
+    def promote_pending(self, max_batches: Optional[int] = None) -> int:
+        """Drain the miss queue into the HBM tier, ``promote_batch``
+        entities per jitted scatter. Returns the number promoted. The
+        worker calls this; tests call it directly for deterministic
+        replay. A ``serving.cache_tier`` fault (raise-mode) fails the
+        batch — the entities stay cold and re-enqueue on their next
+        miss; the scoring path never sees the error."""
+        total = 0
+        batches = 0
+        while max_batches is None or batches < max_batches:
+            with self._lock:
+                batch = []
+                while self._pending and len(batch) < self.promote_batch:
+                    e = self._pending.popleft()
+                    self._pending_set.discard(e)
+                    batch.append(e)
+            if not batch:
+                break
+            batches += 1
+            try:
+                # chaos seam: the host->HBM promotion copy. raise = a
+                # failed tier transfer (entities stay cold, served
+                # fixed-effect-only); delay = a slow tier.
+                _faults.fire("serving.cache_tier", key=self.re_key)
+            except OSError:
+                if self.stats is not None:
+                    self.stats.record_cache_tier_error()
+                continue
+            with self._lock:
+                pairs = self._claim_slots(batch)
+                if not pairs:
+                    continue
+                slots = np.full(
+                    self.promote_batch, self.capacity, np.int32
+                )
+                rows_of = np.zeros(self.promote_batch, np.int64)
+                for i, (e, slot) in enumerate(pairs):
+                    slots[i] = slot
+                    rows_of[i] = e
+                for key, host in self._host.items():
+                    self._dev[key] = _scatter_rows(
+                        self._dev[key],
+                        jnp.asarray(slots),
+                        jnp.asarray(host[rows_of]),
+                    )
+                self.generation += 1
+            total += len(pairs)
+        if total and self.stats is not None:
+            self.stats.record_promotions(total)
+        return total
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Block until the pending queue is drained (worker mode) or
+        drain it inline (worker=False) — the determinism barrier."""
+        if self._thread is None:
+            self.promote_pending()
+            return
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        self._wake.set()
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return
+            self._wake.set()
+            _time.sleep(0.002)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.promote_pending()
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                obs.emit_event(
+                    "serving.cache_tier_worker_error",
+                    cat="serving",
+                    re_key=self.re_key,
+                    error=repr(e),
+                )
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- readout -----------------------------------------------------------
+
+    def resident(self) -> int:
+        with self._lock:
+            return int(np.count_nonzero(self.entity_of >= 0))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "entities": self.num_entities,
+                "resident": int(np.count_nonzero(self.entity_of >= 0)),
+                "pending": len(self._pending),
+                "worker": self._thread is not None,
+            }

@@ -185,6 +185,7 @@ class ScoringEngine:
         stats: Optional[ServingStats] = None,
         baseline=None,
         drift=None,
+        hbm_cache_entities: Optional[int] = None,
     ):
         install_compile_listener()
         self.dtype = jnp.empty((), dtype).dtype  # canonicalized (x64 seam)
@@ -210,33 +211,26 @@ class ScoringEngine:
         else:
             self.drift = None
         self._coord_order = sorted(params)
-
-        def put(x):
-            a = jnp.asarray(x)
-            return jax.device_put(a, device) if device is not None else a
-
-        # pre-compact every (E, d) table once, then pin all leaves device-
-        # resident at the serving dtype (int32 columns stay int32)
-        self._params: Dict[str, object] = {}
-        for name, p in precompact_model(params).items():
-            if isinstance(p, CompactReTable):
-                self._params[name] = CompactReTable(
-                    columns=put(np.asarray(p.columns, np.int32)),
-                    values=put(np.asarray(p.values, self.dtype)),
-                )
-            elif hasattr(p, "gamma"):  # FactoredParams
-                self._params[name] = type(p)(
-                    gamma=put(np.asarray(p.gamma, self.dtype)),
-                    projection=put(np.asarray(p.projection, self.dtype)),
-                )
-            else:
-                self._params[name] = put(np.asarray(p, self.dtype))
-        jax.block_until_ready(
-            [leaf for leaf in jax.tree_util.tree_leaves(self._params)]
-        )
+        self._device = device
         self._used_shards = sorted(
             {self.shards[name] for name in self._coord_order}
         )
+        # feature dims observable from the raw params (dense tables,
+        # fixed vectors, factored projections) — the warmup fallback
+        # when a shard has no vocabulary and its params arrive already
+        # compacted (compact tables do not carry d)
+        self._shard_dim_hints: Dict[str, int] = {}
+        for name, p in params.items():
+            shard = self.shards[name]
+            if hasattr(p, "projection"):
+                self._shard_dim_hints[shard] = int(
+                    np.shape(p.projection)[0]
+                )
+            elif isinstance(p, (np.ndarray, jax.Array)) or (
+                not hasattr(p, "columns") and np.ndim(p) in (1, 2)
+            ):
+                dims = np.shape(p)
+                self._shard_dim_hints[shard] = int(dims[-1])
         self._re_keys = sorted(
             {rk for rk in self.random_effects.values() if rk is not None}
         )
@@ -247,8 +241,20 @@ class ScoringEngine:
             for name in self._coord_order
             if self.random_effects.get(name) is None
         ]
-        self._scorer = jax.jit(self._score_padded)
-        self._scorer_fixed = jax.jit(self._score_padded_fixed)
+        compact = self._precompact(params)
+        # tiered HBM/host entity cache (serving/cache.py): the hot Zipf
+        # head of each entity-keyed table lives in the HBM tier passed to
+        # every executable; the cold tail stays in host RAM and promotes
+        # asynchronously OFF the scoring path. One cache per RE key so
+        # every coordinate sharing that key agrees on slot ids.
+        self._caches: Dict[str, object] = {}
+        if hbm_cache_entities:
+            compact = self._install_caches(compact, int(hbm_cache_entities))
+        self._params = self._pin_params(compact)
+        jax.block_until_ready(
+            [leaf for leaf in jax.tree_util.tree_leaves(self._params)]
+        )
+        self._make_scorers()
         self._compiled: Dict[object, object] = {}
         self._lock = threading.Lock()
         self.compile_count = 0
@@ -262,6 +268,200 @@ class ScoringEngine:
             self._sparse_kernel = kernel_mode()
         except Exception:
             self._sparse_kernel = "unknown"
+
+    # -- construction hooks (overridden by the entity-sharded engine) ------
+
+    def _precompact(self, params: Dict[str, object]) -> Dict[str, object]:
+        """Params -> compact serving form (every (E, d) table becomes a
+        :class:`CompactReTable`)."""
+        return precompact_model(params)
+
+    def _pin_params(self, compact: Dict[str, object]) -> Dict[str, object]:
+        """Pin the compact params device-resident at the serving dtype
+        (int32 columns stay int32) and publish the resident-footprint
+        gauge. The sharded engine overrides this with the mesh-
+        partitioned placement."""
+
+        def put(x):
+            a = jnp.asarray(x)
+            return (
+                jax.device_put(a, self._device)
+                if self._device is not None
+                else a
+            )
+
+        out: Dict[str, object] = {}
+        re_bytes = 0
+        for name, p in compact.items():
+            re_key = self.random_effects.get(name)
+            if isinstance(p, CompactReTable):
+                out[name] = CompactReTable(
+                    columns=put(np.asarray(p.columns, np.int32)),
+                    values=put(np.asarray(p.values, self.dtype)),
+                )
+                re_bytes += (
+                    out[name].columns.nbytes + out[name].values.nbytes
+                )
+            elif hasattr(p, "gamma"):  # FactoredParams
+                out[name] = type(p)(
+                    gamma=put(np.asarray(p.gamma, self.dtype)),
+                    projection=put(np.asarray(p.projection, self.dtype)),
+                )
+                if re_key is not None:
+                    re_bytes += out[name].gamma.nbytes
+            else:
+                out[name] = put(np.asarray(p, self.dtype))
+        # per-process resident entity-table footprint: what ONE process
+        # keeps pinned for random effects. The sharded engine's override
+        # reports one shard's slice (the ~P x drop the mesh buys); the
+        # tiered cache reports its HBM tier, not the host-RAM tail.
+        self.stats.registry.set_gauge(
+            "serving.shard.resident_re_bytes_per_process", re_bytes
+        )
+        return out
+
+    def _make_scorers(self) -> None:
+        self._scorer = jax.jit(self._score_padded)
+        self._scorer_fixed = jax.jit(self._score_padded_fixed)
+
+    def _install_caches(
+        self, compact: Dict[str, object], capacity: int
+    ) -> Dict[str, object]:
+        """Stand up one :class:`~photon_ml_tpu.serving.cache.
+        TieredEntityCache` per RE key over every entity-keyed table and
+        return params whose entity tables are the HBM-tier arrays."""
+        from photon_ml_tpu.serving.cache import TieredEntityCache
+
+        sizes: Dict[str, int] = {}
+        for name in self._coord_order:
+            re_key = self.random_effects.get(name)
+            p = compact[name]
+            if re_key is None:
+                continue
+            rows = int(
+                np.shape(p.gamma if hasattr(p, "gamma") else p.columns)[0]
+            )
+            if sizes.setdefault(re_key, rows) != rows:
+                raise ValueError(
+                    f"coordinate {name!r}: {rows} entity rows, other "
+                    f"coordinates keyed {re_key!r} have {sizes[re_key]}"
+                )
+        for re_key, rows in sizes.items():
+            self._caches[re_key] = TieredEntityCache(
+                re_key,
+                num_entities=rows,
+                capacity=capacity,
+                dtype=self.dtype,
+                stats=self.stats,
+            )
+        out = dict(compact)
+        for name in self._coord_order:
+            re_key = self.random_effects.get(name)
+            if re_key is None:
+                continue
+            cache = self._caches[re_key]
+            p = compact[name]
+            if isinstance(p, CompactReTable):
+                cache.add_table(
+                    name, "columns", np.asarray(p.columns, np.int32)
+                )
+                cache.add_table(
+                    name, "values", np.asarray(p.values, self.dtype)
+                )
+            elif hasattr(p, "gamma"):
+                cache.add_table(
+                    name, "gamma", np.asarray(p.gamma, self.dtype)
+                )
+            else:  # pragma: no cover — precompact leaves only these kinds
+                raise ValueError(
+                    f"coordinate {name!r}: cannot cache {type(p).__name__}"
+                )
+        for cache in self._caches.values():
+            cache.seal()
+        return self._cache_view(out)
+
+    def _cache_view(
+        self,
+        compact: Dict[str, object],
+        tier_tables: Optional[Dict[str, dict]] = None,
+    ) -> Dict[str, object]:
+        """Params with every cached coordinate's arrays replaced by the
+        HBM-tier device arrays (fixed shapes: promotion swaps contents,
+        never shapes, so the bucket executables survive). Pass
+        ``tier_tables`` (re_key -> tables) to build the view from
+        snapshots taken WITH the batch's slot resolution."""
+        out = dict(compact)
+        for re_key, cache in self._caches.items():
+            if tier_tables is not None:
+                tiers = tier_tables[re_key]
+            else:
+                tiers = cache.device_tables()
+            for name in self._coord_order:
+                if self.random_effects.get(name) != re_key:
+                    continue
+                p = out[name]
+                if isinstance(p, CompactReTable) or (
+                    isinstance(p, tuple) and hasattr(p, "columns")
+                ):
+                    out[name] = CompactReTable(
+                        columns=tiers[(name, "columns")],
+                        values=tiers[(name, "values")],
+                    )
+                elif hasattr(p, "gamma"):
+                    out[name] = type(p)(
+                        gamma=tiers[(name, "gamma")],
+                        projection=p.projection,
+                    )
+        return out
+
+    def _translate_entities(self, entity_ids: Dict[str, np.ndarray]):
+        """Global entity indices -> (ids the executables gather with,
+        params for THIS call). Without a cache: the identity and the
+        pinned params. With one, each RE key's ids map to HBM-tier
+        slots — a miss maps to -1 (fixed-effect-only for that row, ==
+        cold-start semantics) and enqueues an async promotion; a miss
+        costs fidelity on that request, never a stall of the batch.
+        Slot resolution and the tier tables are captured under ONE lock
+        per cache (and the params view memoized on the generation
+        counters), so a promotion racing the batch can never point a
+        resolved slot at another entity's rows."""
+        if not self._caches:
+            return entity_ids, self._params
+        out = dict(entity_ids)
+        tiers: Dict[str, dict] = {}
+        gens = []
+        for re_key in sorted(self._caches):
+            cache = self._caches[re_key]
+            col = entity_ids.get(re_key)
+            if col is None:
+                gen, tables = cache.tables_snapshot()
+            else:
+                slots, (gen, tables) = cache.translate(
+                    np.asarray(col, np.int32), with_tables=True
+                )
+                out[re_key] = slots
+            tiers[re_key] = tables
+            gens.append(gen)
+        gens = tuple(gens)
+        memo = getattr(self, "_live_memo", None)
+        if memo is not None and memo[0] == gens:
+            return out, memo[1]
+        view = self._cache_view(self._params, tiers)
+        self._live_memo = (gens, view)
+        return out, view
+
+    def cache_snapshot(self) -> Optional[dict]:
+        """Hit/miss/promotion/demotion counters per RE key (None when no
+        tiered cache is installed)."""
+        if not self._caches:
+            return None
+        return {rk: c.snapshot() for rk, c in sorted(self._caches.items())}
+
+    def close(self) -> None:
+        """Release background resources (cache promotion workers). The
+        registry calls this when a version retires; idempotent."""
+        for cache in self._caches.values():
+            cache.close()
 
     # -- construction ------------------------------------------------------
 
@@ -341,24 +541,10 @@ class ScoringEngine:
         if hit is not None:
             self.stats.record_bucket(bucket, hit=True)
             return hit
-        feats_s = {
-            s: jax.ShapeDtypeStruct(
-                (bucket, dims[s] if dims else self._shard_dim(s)), self.dtype
-            )
-            for s in self._used_shards
-        }
-        if fixed_only:
-            compiled = self._scorer_fixed.lower(
-                self._params, feats_s
-            ).compile()
-        else:
-            ents_s = {
-                rk: jax.ShapeDtypeStruct((bucket,), jnp.int32)
-                for rk in self._re_keys
-            }
-            compiled = self._scorer.lower(
-                self._params, feats_s, ents_s
-            ).compile()
+        scorer = self._scorer_fixed if fixed_only else self._scorer
+        compiled = scorer.lower(
+            self._params, *self._abstract_inputs(bucket, dims, fixed_only)
+        ).compile()
         with self._lock:
             prior = self._compiled.setdefault(cache_key, compiled)
         if prior is compiled:
@@ -376,10 +562,32 @@ class ScoringEngine:
         self.stats.record_bucket(bucket, hit=False)
         return prior
 
+    def _abstract_inputs(self, bucket, dims, fixed_only):
+        """Abstract (ShapeDtypeStruct) non-param arguments of one padded
+        bucket's executable — the shape contract `_ensure_compiled`
+        lowers against. Overridden by the sharded engine (whose routed
+        inputs carry a leading shard axis and a fixed-effect mask)."""
+        feats_s = {
+            s: jax.ShapeDtypeStruct(
+                (bucket, dims[s] if dims else self._shard_dim(s)),
+                self.dtype,
+            )
+            for s in self._used_shards
+        }
+        if fixed_only:
+            return (feats_s,)
+        ents_s = {
+            rk: jax.ShapeDtypeStruct((bucket,), jnp.int32)
+            for rk in self._re_keys
+        }
+        return (feats_s, ents_s)
+
     def _shard_dim(self, shard: str) -> int:
         """Feature dimension of a shard, from its vocab or its params."""
         if shard in self.shard_vocabs:
             return len(self.shard_vocabs[shard])
+        if shard in self._shard_dim_hints:
+            return self._shard_dim_hints[shard]
         for name in self._coord_order:
             if self.shards[name] != shard:
                 continue
@@ -510,14 +718,17 @@ class ScoringEngine:
             for s in self._used_shards
         }
         ents_p = {}
-        for rk in self._re_keys:
-            col = entity_ids.get(rk)
-            col = (
-                np.full(n, -1, np.int32)
-                if col is None
-                else np.asarray(col, np.int32)
-            )
-            ents_p[rk] = _pad_rows(col, bucket, fill=-1)
+        params = self._params
+        if not fixed_only:
+            translated, params = self._translate_entities(entity_ids)
+            for rk in self._re_keys:
+                col = translated.get(rk)
+                col = (
+                    np.full(n, -1, np.int32)
+                    if col is None
+                    else np.asarray(col, np.int32)
+                )
+                ents_p[rk] = _pad_rows(col, bucket, fill=-1)
         compiled = self._ensure_compiled(
             bucket,
             {s: feats_p[s].shape[1] for s in self._used_shards},
@@ -533,10 +744,10 @@ class ScoringEngine:
         ) as sp:
             t0 = time.perf_counter()
             if fixed_only:
-                out = np.asarray(compiled(self._params, feats_p))[:n]
+                out = np.asarray(compiled(params, feats_p))[:n]
             else:
                 out = np.asarray(
-                    compiled(self._params, feats_p, ents_p)
+                    compiled(params, feats_p, ents_p)
                 )[:n]
             if action.corrupt:
                 out = np.full_like(out, np.nan)

@@ -211,10 +211,16 @@ class ModelRegistry:
         breaker_threshold: int = 3,
         breaker_backoff_s: float = 30.0,
         breaker_max_backoff_s: float = 600.0,
+        serving_shards: int = 1,
         logger=None,
         **engine_kwargs,
     ):
         self.stats = stats if stats is not None else ServingStats()
+        # entity-sharded serving (serving/sharding.py): >1 builds every
+        # version as a ShardedScoringEngine over a P-shard entity mesh.
+        # A hot-reload swaps the WHOLE engine — shard set, routing
+        # assignments, and cache state move atomically with the version.
+        self.serving_shards = int(serving_shards)
         self._verify = verify
         self._warmup_max_batch = warmup_max_batch
         self._warmup_degraded = warmup_degraded
@@ -237,6 +243,15 @@ class ModelRegistry:
         )
 
     def _default_factory(self, root: str) -> ScoringEngine:
+        if self.serving_shards > 1:
+            from photon_ml_tpu.serving.sharding import ShardedScoringEngine
+
+            return ShardedScoringEngine.from_model_dir(
+                root,
+                stats=self.stats,
+                num_shards=self.serving_shards,
+                **self._engine_kwargs,
+            )
         return ScoringEngine.from_model_dir(
             root, stats=self.stats, **self._engine_kwargs
         )
@@ -332,6 +347,11 @@ class ModelRegistry:
                     break
                 self._cond.wait(remaining)
             version.retired = True
+            if version.engine is not None:
+                # release background resources (cache promotion workers)
+                # WITH the device tables — a retired version must not
+                # keep promoting rows into tiers nobody scores against
+                version.engine.close()
             version.engine = None
             self.retired_versions.append(version.version_id)
 
@@ -407,6 +427,11 @@ class ModelRegistry:
                         else None
                     ),
                 }
+        cache = None
+        if v is not None and v.engine is not None:
+            snap = getattr(v.engine, "cache_snapshot", lambda: None)()
+            if snap is not None:
+                cache = snap
         return {
             "version": v.version_id if v is not None else None,
             "inflight": v.inflight if v is not None else 0,
@@ -415,6 +440,8 @@ class ModelRegistry:
             "retired_versions": list(self.retired_versions),
             "breaker": self.breaker.snapshot(),
             "drift": drift,
+            "serving_shards": self.serving_shards,
+            "cache": cache,
         }
 
     # -- watch mode --------------------------------------------------------

@@ -190,6 +190,75 @@ class ServingStats:
         with self._lock:
             self._inc("degraded_batches")
 
+    # -- tiered entity cache (serving/cache.py) ----------------------------
+
+    def record_cache(self, hits: int, misses: int) -> None:
+        """One translate() call's hit/miss split — a miss scored
+        fixed-effect-only (cold-start semantics) and enqueued an async
+        promotion; it never stalled the batch."""
+        with self._lock:
+            if hits:
+                self._inc("cache.hits", hits)
+            if misses:
+                self._inc("cache.misses", misses)
+
+    def record_promotions(self, n: int) -> None:
+        with self._lock:
+            self._inc("cache.promotions", n)
+
+    def record_demotions(self, n: int) -> None:
+        with self._lock:
+            self._inc("cache.demotions", n)
+
+    def record_cache_tier_error(self) -> None:
+        """A failed host->HBM promotion batch (e.g. an armed
+        ``serving.cache_tier`` fault): the entities stay cold and serve
+        fixed-effect-only until the next miss re-enqueues them."""
+        with self._lock:
+            self._inc("cache.tier_errors")
+
+    def cache_hit_frac(self) -> float:
+        with self._lock:
+            hits = self.registry.counter("serving.cache.hits").value
+            misses = self.registry.counter("serving.cache.misses").value
+        total = hits + misses
+        return hits / total if total else 0.0
+
+    # -- entity-sharded serving (serving/sharding.py) ----------------------
+
+    def record_shard_batch(self, counts, device_s: float) -> None:
+        """Per-shard occupancy gauges + per-shard device latency
+        histograms for one routed batch. The dispatch is ONE fused
+        program across shards, so the wall attributes to every shard
+        that had placements in it — which padded sub-batch sizes each
+        shard actually sees, and whether one shard's leg is hot."""
+        with self._lock:
+            for p, rows in enumerate(counts):
+                rows = int(rows)
+                self.registry.set_gauge(
+                    f"serving.shard.occupancy.{p}", rows
+                )
+                if rows:
+                    self.registry.observe(
+                        f"serving.shard.device_ms.{p}", device_s * 1e3
+                    )
+
+    def record_shard_degraded(self, shards, rows: int) -> None:
+        """A routing fault took shard(s) down for one batch: their
+        entities scored fixed-effect-only; every request still
+        completed."""
+        with self._lock:
+            self._inc("shard.degraded_batches")
+            self._inc("shard.degraded_rows", rows)
+        from photon_ml_tpu import obs
+
+        obs.emit_event(
+            "serving.shard_degraded",
+            cat="serving",
+            shards=list(shards),
+            rows=rows,
+        )
+
     def record_error(self) -> None:
         with self._lock:
             self._inc("errors")
@@ -257,7 +326,52 @@ class ServingStats:
                     v: h.summary()
                     for v, h in sorted(self.score_hists.items())
                 },
+                "cache": self._cache_snapshot(),
+                "shards": self._shard_snapshot(),
+                "resident_re_bytes_per_process": int(
+                    self.registry.gauge(
+                        "serving.shard.resident_re_bytes_per_process"
+                    ).value
+                ),
             }
+
+    def _cache_snapshot(self) -> dict:
+        """Tiered-cache counters (all zero when no cache is installed —
+        the key is additive, existing schema untouched). Caller holds
+        ``self._lock``; registry access takes its own lock."""
+        hits = self.registry.counter("serving.cache.hits").value
+        misses = self.registry.counter("serving.cache.misses").value
+        total = hits + misses
+        return {
+            "hits": int(hits),
+            "misses": int(misses),
+            "promotions": int(
+                self.registry.counter("serving.cache.promotions").value
+            ),
+            "demotions": int(
+                self.registry.counter("serving.cache.demotions").value
+            ),
+            "tier_errors": int(
+                self.registry.counter("serving.cache.tier_errors").value
+            ),
+            "hit_frac": round(hits / total, 6) if total else 0.0,
+        }
+
+    def _shard_snapshot(self) -> dict:
+        """Per-shard occupancy gauges + device-latency histograms of the
+        entity-sharded engine (empty when serving unsharded)."""
+        occ_prefix = "serving.shard.occupancy."
+        lat_prefix = "serving.shard.device_ms."
+        out: Dict[str, dict] = {}
+        for name in self.registry.names(occ_prefix):
+            out.setdefault(name[len(occ_prefix):], {})["occupancy"] = int(
+                self.registry.gauge(name).value
+            )
+        for name in self.registry.names(lat_prefix):
+            out.setdefault(name[len(lat_prefix):], {})["device_ms"] = (
+                self.registry.histogram(name).snapshot()
+            )
+        return out
 
     def _bucket_latency_snapshot(self) -> Dict[str, dict]:
         """``{bucket: histogram snapshot}`` for every bucket that has

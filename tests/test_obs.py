@@ -389,6 +389,11 @@ class TestServingStatsCompat:
         # drift"): per-model-version score-distribution histograms —
         # additive key; everything above keeps its shape
         "score_distribution",
+        # entity-sharded serving + tiered entity cache (docs/SERVING.md):
+        # cache hit/miss/promotion counters, per-shard occupancy/latency,
+        # and the per-process resident RE footprint gauge — additive
+        # keys; everything above keeps its shape
+        "cache", "shards", "resident_re_bytes_per_process",
     }
 
     def test_snapshot_schema_unchanged(self):
