@@ -2253,6 +2253,125 @@ def bench_quality():
     }
 
 
+def bench_lifecycle():
+    """Self-healing retrain loop (docs/LIFECYCLE.md). Sentinel-tracked:
+    ``retrain_cycle_s`` (lower — alarm-to-reload wall for one full
+    orchestrator cycle: plan → entity-keyed warm-started refit →
+    manifest-gated export → reload) and ``post_retrain_auc`` /
+    ``auc_recovery`` (higher — ranking quality on the drifted holdout
+    after the cycle vs the stale model's degraded score). The hard
+    invariants (zero dropped requests, breaker quarantine, fault-site
+    degraded outcomes) are asserted by the ``lifecycle`` chaos drill,
+    not just recorded here."""
+    import tempfile
+
+    import numpy as _np
+
+    from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+    from photon_ml_tpu.lifecycle.orchestrator import (
+        RetrainOrchestrator,
+        export_retrained_model,
+        load_warm_start,
+        next_version_dir,
+    )
+    from photon_ml_tpu.obs.quality import exact_auc
+
+    rng = _np.random.default_rng(20260806)
+    d = 16
+    rows = 8192
+
+    def draw(w, mu):
+        X = rng.normal(size=(rows, d)) + mu
+        y = (
+            rng.uniform(size=rows) < 1.0 / (1.0 + _np.exp(-(X @ w)))
+        ).astype(float)
+        return X, y
+
+    def fit(X, y, warm, steps=60):
+        w = _np.array(warm, dtype=float)
+        for _ in range(steps):
+            p = 1.0 / (1.0 + _np.exp(-(X @ w)))
+            w -= 0.5 * (X.T @ (p - y)) / len(X)
+        return w
+
+    # phase 0: train + export on the original concept
+    w0 = rng.normal(size=d)
+    X0, y0 = draw(w0, 0.0)
+    g0 = fit(X0, y0, _np.zeros(d))
+    # concept drift: the label-generating weights rotate, so the stale
+    # model's RANKING degrades (covariate-only shift would leave AUC
+    # untouched — that axis is bench_quality's subject)
+    w1 = -0.5 * w0 + rng.normal(size=d)
+    Xh, yh = draw(w1, 0.5)  # drifted holdout, fixed for both models
+    Xr, yr = draw(w1, 0.5)  # drifted retrain set
+
+    with tempfile.TemporaryDirectory() as tmp:
+        watch = os.path.join(tmp, "watch")
+        vocab = FeatureVocabulary(
+            [feature_key(f"f{j}", "") for j in range(d)]
+        )
+        users = {f"u{i}": i for i in range(8)}
+        export_retrained_model(
+            os.path.join(watch, "v0001"),
+            params={
+                "global": g0,
+                "per-user": rng.normal(size=(len(users), d)),
+            },
+            shards={"global": "s", "per-user": "s"},
+            vocabs={"global": vocab, "per-user": vocab},
+            entity_vocabs={"per-user": users},
+            random_effects={"global": None, "per-user": "userId"},
+        )
+        degraded_auc = exact_auc(yh, Xh @ g0)
+
+        def retrain(plan):
+            params, shards, res, shard_vocabs, re_vocabs = (
+                load_warm_start(plan.warm_start_dir)
+            )
+            g = fit(Xr, yr, _np.asarray(params["global"]))
+            old_vocab = re_vocabs["userId"]
+            old_table = _np.asarray(params["per-user"])
+            new_vocab = {
+                k: i for i, k in enumerate(sorted(old_vocab))
+            }
+            table = _np.zeros((len(new_vocab), d))
+            for k, i in new_vocab.items():  # carried BY KEY
+                table[i] = old_table[old_vocab[k]]
+            return export_retrained_model(
+                next_version_dir(watch),
+                params={"global": g, "per-user": table},
+                shards=shards,
+                vocabs={n: shard_vocabs[shards[n]] for n in shards},
+                entity_vocabs={"per-user": new_vocab},
+                random_effects=res,
+            )
+
+        reloaded = []
+        orch = RetrainOrchestrator(
+            trigger=lambda: {"source": "bench"},
+            retrain_fn=retrain,
+            reload_fn=lambda exp: reloaded.append(exp) or "v0002",
+            watch_root=watch,
+        )
+        result = orch.run_cycle()
+        assert result.ok, f"bench lifecycle cycle failed: {result}"
+        assert reloaded, "reload stage never ran"
+
+        g1 = _np.asarray(load_warm_start(reloaded[0])[0]["global"])
+        post_auc = exact_auc(yh, Xh @ g1)
+
+    log(
+        f"lifecycle: retrain cycle {result.cycle_s:.3f}s, holdout AUC "
+        f"{degraded_auc:.3f} (stale) -> {post_auc:.3f} (retrained)"
+    )
+    return {
+        "retrain_cycle_s": round(float(result.cycle_s), 4),
+        "degraded_holdout": round(float(degraded_auc), 4),
+        "post_retrain_auc": round(float(post_auc), 4),
+        "auc_recovery": round(float(post_auc - degraded_auc), 4),
+    }
+
+
 def bench_lint():
     """photon-lint over the full package (docs/ANALYSIS.md). Sentinel-
     tracked: ``lint_wall_s`` (lower — the gate must stay cheap enough
@@ -2395,6 +2514,7 @@ def main():
         "multihost_resilience", bench_multihost_resilience
     )
     quality = _phase("quality", bench_quality)
+    lifecycle = _phase("lifecycle", bench_lifecycle)
     lint = _phase("lint", bench_lint)
 
     extra = {
@@ -2546,6 +2666,11 @@ def main():
         # covariate-shift alarm latency (sentinel: per_s higher,
         # overhead_ratio + drift_alarm_latency_* lower)
         extra["quality"] = quality
+    if lifecycle:
+        # self-healing retrain loop (docs/LIFECYCLE.md): alarm-to-reload
+        # cycle wall + post-retrain ranking recovery on the drifted
+        # holdout (sentinel: retrain_cycle_s lower, auc higher)
+        extra["lifecycle"] = lifecycle
     if lint:
         # photon-lint self-hosting gate (docs/ANALYSIS.md): analyzer
         # wall (sentinel: the generic _s lower-is-better rule) and

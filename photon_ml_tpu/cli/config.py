@@ -396,6 +396,14 @@ class GameDriverParams:
     graceful_shutdown: bool = True
     # warm-start: root of a previously saved GAME model (best/ or all/<i>)
     initial_model_dir: Optional[str] = None
+    # lifecycle retrain (docs/LIFECYCLE.md): coordinates to EXCLUDE from
+    # updates — they carry their warm-started params bit-identical and
+    # still score. The retrain orchestrator sets this from convergence
+    # health so only unhealthy coordinates pay for a refit. Forces the
+    # per-update dispatch loop (same mechanics as guard-frozen
+    # coordinates); requires initial_model_dir (freezing a cold-started
+    # coordinate would serve zeros).
+    freeze_coordinates: List[str] = dataclasses.field(default_factory=list)
     # merge coordinates sharing (effect type, shard) by coefficient
     # addition at save (``ModelProcessingUtils.collapseGameModel``)
     collapse_output: bool = False
@@ -487,6 +495,18 @@ class GameDriverParams:
             raise ValueError("train_input is required")
         if not self.updating_sequence:
             raise ValueError("updating_sequence is required")
+        if self.freeze_coordinates:
+            unknown = set(self.freeze_coordinates) - set(self.coordinates)
+            if unknown:
+                raise ValueError(
+                    f"freeze_coordinates names unknown coordinates: "
+                    f"{sorted(unknown)}"
+                )
+            if not self.initial_model_dir:
+                raise ValueError(
+                    "freeze_coordinates requires initial_model_dir "
+                    "(a frozen cold start would serve zeros)"
+                )
         if self.collective_mode is not None and self.collective_mode not in (
             "fused",
             "overlap",

@@ -1120,6 +1120,9 @@ def _run_game_training(
                 sharded_checkpoints=params.sharded_ckpt,
                 entity_keys=ckpt_entity_keys,
                 heartbeat=_current_heartbeat(),
+                # lifecycle retrain: convergence-healthy coordinates
+                # carry their warm start bit-identical (never updated)
+                freeze=params.freeze_coordinates or None,
             )
             frozen_events = [
                 h for h in history if getattr(h, "event", None) == "frozen"
@@ -1437,6 +1440,13 @@ def main(argv=None) -> None:
         "reduce-scatter/all-gather pipeline; 'fused' = the single "
         "trailing all-reduce equivalence oracle",
     )
+    p.add_argument(
+        "--warm-from-watch-root", default=None, metavar="DIR",
+        help="lifecycle warm start: resolve initial_model_dir to the "
+        "newest manifest-bearing export under this serving watch root "
+        "(entity-keyed warm start from whatever is live — "
+        "docs/LIFECYCLE.md; photon-retrain drives this automatically)",
+    )
     args = p.parse_args(argv)
     # after parse_args: --help / bad flags must not initialize
     # the accelerator backend or touch the cache directory.
@@ -1492,6 +1502,18 @@ def main(argv=None) -> None:
         base["entity_shards"] = args.entity_shards
     if args.collective_mode is not None:
         base["collective_mode"] = args.collective_mode
+    if args.warm_from_watch_root is not None:
+        from photon_ml_tpu.lifecycle.orchestrator import (
+            latest_version_dir,
+        )
+
+        warm = latest_version_dir(args.warm_from_watch_root)
+        if warm is None:
+            p.error(
+                "--warm-from-watch-root: no manifest-bearing export "
+                f"under {args.warm_from_watch_root}"
+            )
+        base["initial_model_dir"] = warm
     try:
         run_game_training(base)
     except BaseException as e:

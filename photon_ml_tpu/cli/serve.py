@@ -385,6 +385,13 @@ def main(argv=None) -> None:
         "miss scores fixed-effect-only (cold-start semantics) while "
         "the promotion is in flight",
     )
+    p.add_argument(
+        "--admission-log", default=None,
+        help="persist a bounded repeat-miss admission log here (entity "
+        "key, miss count, last seen; atomic-swap writes) — the retrain "
+        "orchestrator (photon-retrain) promotes repeat-missed entities "
+        "into the next training set (docs/LIFECYCLE.md)",
+    )
     p.add_argument("--stats-json", help="dump a stats snapshot here on exit")
     args = p.parse_args(argv)
     if args.serving_shards > 1 and args.hbm_cache_entities:
@@ -415,6 +422,11 @@ def main(argv=None) -> None:
         **(
             {"hbm_cache_entities": args.hbm_cache_entities}
             if args.hbm_cache_entities
+            else {}
+        ),
+        **(
+            {"admission_log_path": args.admission_log}
+            if args.admission_log
             else {}
         ),
     )

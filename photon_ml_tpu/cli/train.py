@@ -782,6 +782,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "row block's compute; 'fused' pins the single trailing "
         "all-reduce — the equivalence oracle",
     )
+    p.add_argument(
+        "--warm-from-watch-root", default=None, metavar="DIR",
+        help="lifecycle warm start: resolve initial_model_dir to the "
+        "newest manifest-bearing export under this serving watch root "
+        "(the descending-lambda path then warm-starts from whatever "
+        "is live — docs/LIFECYCLE.md; photon-retrain drives this "
+        "automatically)",
+    )
     return p
 
 
@@ -791,9 +799,22 @@ def params_from_args(args, cls) -> dict:
         with open(args.config) as f:
             base = json.load(f)
     for key, value in vars(args).items():
-        if key == "config" or value is None:
+        if key in ("config", "warm_from_watch_root") or value is None:
             continue
         base[key] = value
+    warm_root = getattr(args, "warm_from_watch_root", None)
+    if warm_root is not None:
+        from photon_ml_tpu.lifecycle.orchestrator import (
+            latest_version_dir,
+        )
+
+        warm = latest_version_dir(warm_root)
+        if warm is None:
+            raise ValueError(
+                "--warm-from-watch-root: no manifest-bearing export "
+                f"under {warm_root}"
+            )
+        base["initial_model_dir"] = warm
     return base
 
 

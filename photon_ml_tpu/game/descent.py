@@ -629,6 +629,7 @@ class CoordinateDescent:
         sharded_checkpoints=False,
         entity_keys=None,
         heartbeat=None,
+        freeze=None,
     ):
         """Returns (model, history). Objective is logged after every
         coordinate update like ``CoordinateDescent.scala:160-170``;
@@ -705,8 +706,28 @@ class CoordinateDescent:
         :class:`~photon_ml_tpu.resilience.hostloss.HostLossDetected` —
         the drivers map it to the distinct host-loss exit code so a
         restart (same or smaller world size) resumes from the shard
-        set."""
+        set.
+
+        ``freeze``: coordinate names to EXCLUDE from updates for the
+        whole run — they keep their (warm-started) params and still
+        contribute their score. This seeds the same frozen set the
+        divergence guard grows, so it rides checkpoints identically (a
+        resumed run unions the checkpoint's casualties with the seed)
+        and forces the per-update loop the same way a guard-frozen
+        coordinate does. The lifecycle orchestrator uses it to retrain
+        only convergence-unhealthy coordinates while healthy ones carry
+        over bit-identical from the previous export."""
         names = list(self.coordinates)
+        seed_frozen = set(freeze or ())
+        unknown_frozen = seed_frozen - set(names)
+        if unknown_frozen:
+            raise ValueError(
+                f"freeze names unknown coordinates: "
+                f"{sorted(unknown_frozen)}"
+            )
+        if seed_frozen >= set(names):
+            raise ValueError("freeze covers every coordinate — nothing "
+                             "would train")
         model = (
             initial_model.copy()
             if initial_model is not None
@@ -743,7 +764,9 @@ class CoordinateDescent:
             )
             key = _globalize(key)
         start_it = 0
-        frozen: set = set()  # divergence-guard casualties (skip updates)
+        # divergence-guard casualties + caller-frozen coordinates (both
+        # skip updates; both ride checkpoints)
+        frozen: set = set(seed_frozen)
         if checkpoint_dir is not None and resume:
             from photon_ml_tpu.io.checkpoint import latest_checkpoint
 
@@ -786,7 +809,7 @@ class CoordinateDescent:
                 history = [
                     CoordinateUpdateRecord(**h) for h in ckpt.history
                 ]
-                frozen = set(ckpt.frozen) & set(names)
+                frozen = (set(ckpt.frozen) & set(names)) | seed_frozen
 
         scores = {
             n: self.coordinates[n].score(model.params[n]) for n in names
@@ -1731,11 +1754,60 @@ class CoordinateDescent:
 _GRID_STACK_WARN_BYTES = 1 << 20
 
 
+def _warm_start_params(coords, names, initial_model):
+    """Per-coordinate starting params: the warm start's table where one
+    is given (a GameModel or a plain name->params mapping), the
+    coordinate's cold ``initial_params()`` otherwise. Warm leaves must
+    match the cold-start structure and shapes EXACTLY — callers hand us
+    entity-keyed, already-remapped tables (load_game_model /
+    reindex_entity_params); a shape mismatch here means a positional or
+    stale warm start and is refused, never silently cold-started."""
+    init = (
+        getattr(initial_model, "params", initial_model)
+        if initial_model is not None
+        else None
+    )
+    out = {}
+    for n in names:
+        want = coords[n].initial_params()
+        if init is None or n not in init:
+            out[n] = want
+            continue
+        try:
+            got = jax.tree_util.tree_map(
+                lambda g, w: jnp.asarray(g, jnp.asarray(w).dtype),
+                init[n],
+                want,
+            )
+            bad = any(
+                jnp.shape(g) != jnp.shape(w)
+                for g, w in zip(
+                    jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want),
+                )
+            )
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"warm start for coordinate {n!r} does not match its "
+                f"parameter structure ({e})"
+            ) from e
+        if bad:
+            raise ValueError(
+                f"warm start for coordinate {n!r} has mismatched "
+                "shapes — warm starts re-key by entity id "
+                "(reindex_entity_params / load_game_model), never by "
+                "position"
+            )
+        out[n] = got
+    return out
+
+
 def run_grid(
     cd: CoordinateDescent,
     combos: Sequence[Mapping[str, float]],
     num_iterations: int,
     seed: int = 0,
+    initial_model=None,
 ):
     """Train EVERY reg-weight combo simultaneously by vmapping the
     per-coordinate chunked dispatch over a combo axis (SURVEY §2.5.6,
@@ -1753,6 +1825,14 @@ def run_grid(
     ``cd.run(num_iterations, seed=seed)`` with that combo's reg weights
     (same PRNG stream: every lane shares the split sequence, like the
     sequential runs each starting from the same seed).
+
+    ``initial_model`` (a :class:`GameModel` or name->params mapping)
+    warm-starts EVERY lane from the same tables — the lifecycle
+    retrain's cheap in-cycle model selection: the previous export seeds
+    all combos at once and each lane's result still matches
+    ``cd.run(..., initial_model=...)`` with that combo. Warm tables
+    must already be entity-keyed into THIS run's vocabulary; shape
+    mismatches are refused (the positional warm-start bug class).
 
     Returns ``(models, history)``: ``models[c]`` is combo c's
     :class:`GameModel`; ``history[c]`` the combo's
@@ -1841,12 +1921,10 @@ def run_grid(
             p,
         )
 
-    params = broadcast({n: coords[n].initial_params() for n in names})
+    starts = _warm_start_params(coords, names, initial_model)
+    params = broadcast(starts)
     scores = broadcast(
-        {
-            n: coords[n].score(coords[n].initial_params())
-            for n in names
-        }
+        {n: coords[n].score(starts[n]) for n in names}
     )
     key = jax.random.PRNGKey(seed)
     records = []  # (iteration, name, objective (C,), trackers, seconds)
@@ -1891,4 +1969,171 @@ def run_grid(
                     seconds,
                 )
             )
+    return models, history
+
+
+def _lambda_segment_fn(cd: CoordinateDescent, length: int):
+    """ONE device dispatch for a whole lambda-path segment: ``length``
+    coordinate-descent passes ride a ``lax.scan`` through the same
+    fused update surface as :func:`run_grid` (reg weights enter as jit
+    ARGUMENTS via ``fused_state_for_reg``, so every combo on the path
+    reuses this one executable — zero recompiles per lambda). Cached on
+    the descent object per pass count."""
+    cache = getattr(cd, "_lambda_segment_fns", None)
+    if cache is None:
+        cache = cd._lambda_segment_fns = {}
+    fn = cache.get(length)
+    if fn is not None:
+        return fn
+    names = list(cd.coordinates)
+    coords = cd.coordinates
+    loss_fn = _loss_fn_for_task(cd.task)
+
+    def segment(states, labels, base_offsets, weights, params, scores,
+                key):
+        live = {
+            n: coords[n].with_fused_state(states[n]) for n in names
+        }
+
+        def body(carry, _):
+            params, scores, key = carry
+            objs = []
+            trackers = {}
+            for name in names:
+                key, sub = jax.random.split(key)
+                total = sum(scores.values())
+                partial = total - scores[name]
+                p, tr, s = live[name].update_step(
+                    params[name], partial, sub
+                )
+                params = {**params, name: p}
+                scores = {**scores, name: s}
+                reg = sum(
+                    _coordinate_reg_term(live[n], params[n])
+                    for n in names
+                )
+                tot = sum(scores[n] for n in names)
+                objs.append(
+                    loss_fn(labels, base_offsets + tot, weights) + reg
+                )
+                trackers[name] = tr
+            return (params, scores, key), (jnp.stack(objs), trackers)
+
+        (params, scores, key), ys = jax.lax.scan(
+            body, (params, scores, key), None, length=length
+        )
+        return params, scores, ys
+
+    fn = cache[length] = jax.jit(segment)
+    return fn
+
+
+def run_lambda_path(
+    cd: CoordinateDescent,
+    combos: Sequence[Mapping[str, float]],
+    num_iterations: int,
+    seed: int = 0,
+    initial_model=None,
+    scan: bool = True,
+):
+    """Warm-started lambda PATH over reg-weight combos — the sequential
+    semantics :func:`run_grid` explicitly does not cover: combo c+1
+    warm-starts from combo c's solution (order combos strongest-lambda
+    first, the GLM driver's descending-path convention), so late combos
+    converge from an already-good start. With ``initial_model`` the
+    FIRST combo warm-starts too (the previous export, entity-keyed) —
+    model selection cheap enough to run inside a lifecycle retrain
+    cycle.
+
+    Each segment rides the PR-8 scan path: ``scan=True`` runs all
+    ``num_iterations`` passes of a combo as ONE device dispatch
+    (``lax.scan`` over passes; :func:`_lambda_segment_fn`), compiled
+    once for the whole path because reg weights are jit arguments.
+    ``scan=False`` runs the identical math through the per-update
+    chunked loop (one dispatch per coordinate update) — the lifecycle
+    drill asserts scan==loop equivalence.
+
+    Every combo restarts the PRNG stream from ``seed`` (matching a
+    sequential ``cd.run(seed=seed)`` per combo and :func:`run_grid`'s
+    lanes); only the warm start carries forward. Returns ``(models,
+    history)`` shaped like :func:`run_grid` — one entry per combo, in
+    path order."""
+    names = list(cd.coordinates)
+    coords = cd.coordinates
+    combos = list(combos)
+    if not combos:
+        raise ValueError("run_lambda_path needs >= 1 combo")
+    for c in coords.values():
+        if not hasattr(c, "fused_state_for_reg"):
+            raise ValueError(
+                f"{type(c).__name__} does not support the lambda path "
+                "(no fused_state_for_reg); run combos sequentially"
+            )
+    params = _warm_start_params(coords, names, initial_model)
+    scores = {n: coords[n].score(params[n]) for n in names}
+    fns, _ = cd._coordinate_step_fns()
+    models: List[GameModel] = []
+    raw: List[tuple] = []  # (combo idx, objs, trackers) device refs
+    for cb in combos:
+        states = {
+            n: coords[n].fused_state_for_reg(cb[n]) for n in names
+        }
+        key = jax.random.PRNGKey(seed)
+        if scan:
+            t0 = time.perf_counter()
+            params, scores, (objs, trackers) = _lambda_segment_fn(
+                cd, num_iterations
+            )(
+                states, cd.labels, cd.base_offsets, cd.weights,
+                params, scores, key,
+            )
+            raw.append((objs, trackers, time.perf_counter() - t0))
+        else:
+            objs_acc = []
+            trackers_acc = {n: [] for n in names}
+            t0 = time.perf_counter()
+            for _ in range(num_iterations):
+                it_objs = []
+                for name in names:
+                    key, sub = jax.random.split(key)
+                    p, tr, s, obj = fns[name](
+                        states, cd.labels, cd.base_offsets, cd.weights,
+                        params, scores, sub,
+                    )
+                    params = {**params, name: p}
+                    scores = {**scores, name: s}
+                    it_objs.append(obj)
+                    trackers_acc[name].append(tr)
+                objs_acc.append(jnp.stack(it_objs))
+            objs = jnp.stack(objs_acc)  # (T, N) like the scan output
+            trackers = {
+                n: jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *trackers_acc[n]
+                )
+                for n in names
+            }
+            raw.append((objs, trackers, time.perf_counter() - t0))
+        models.append(GameModel(dict(params)))
+    # ONE batched host drain for the whole path (docs/PERF.md r5)
+    host = jax.device_get([(o, t) for o, t, _ in raw])
+    history: List[List[CoordinateUpdateRecord]] = []
+    for (objs, trackers), (_, _, seconds) in zip(host, raw):
+        records: List[CoordinateUpdateRecord] = []
+        for it in range(num_iterations):
+            for i, name in enumerate(names):
+                tr_it = jax.tree_util.tree_map(
+                    lambda a: a[it], trackers[name]
+                )
+                summary = coords[name].wrap_tracker(tr_it)
+                records.append(
+                    _history_record(
+                        it,
+                        name,
+                        np.asarray(objs)[it, i],
+                        summary.reason,
+                        summary.iterations,
+                        seconds if it == 0 and i == 0 else None,
+                    )
+                )
+        history.append(records)
     return models, history

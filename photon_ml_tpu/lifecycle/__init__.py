@@ -1,0 +1,45 @@
+"""Self-healing model lifecycle: drift-triggered continual retrain.
+
+PR 13 built the trigger (DriftMonitor PSI/JS alarms, train-time baseline
+fingerprints, ``photon-obs drift`` exit codes) and PR 10/11 built the
+safety nets (reload breaker, chaos drills, sharded checkpoints). This
+package closes the loop: a retrain orchestrator that consumes the drift
+signal, runs an incremental warm-started retrain, re-exports through the
+manifest gate, and hot-reloads under live traffic with the breaker as
+the last line of defense. docs/LIFECYCLE.md is the walkthrough;
+``photon-retrain`` (cli/retrain.py) is the operational surface.
+"""
+
+from photon_ml_tpu.lifecycle.orchestrator import (
+    CycleResult,
+    LifecycleError,
+    RetrainOrchestrator,
+    RetrainPlan,
+    StageResult,
+    WarmStartError,
+    export_retrained_model,
+    fingerprint_drift_trigger,
+    latest_version_dir,
+    load_admission_candidates,
+    load_warm_start,
+    next_version_dir,
+    registry_drift_trigger,
+    select_retrain_targets,
+)
+
+__all__ = [
+    "CycleResult",
+    "LifecycleError",
+    "RetrainOrchestrator",
+    "RetrainPlan",
+    "StageResult",
+    "WarmStartError",
+    "export_retrained_model",
+    "fingerprint_drift_trigger",
+    "latest_version_dir",
+    "load_admission_candidates",
+    "load_warm_start",
+    "next_version_dir",
+    "registry_drift_trigger",
+    "select_retrain_targets",
+]

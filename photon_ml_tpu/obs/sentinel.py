@@ -127,6 +127,11 @@ _DIRECTION_RULES = (
     # companion sketch_rows_per_s gates through the generic per_s rule.
     (re.compile(r"overhead_ratio$"), LOWER_IS_BETTER),
     (re.compile(r"drift_alarm_latency"), LOWER_IS_BETTER),
+    # self-healing loop (docs/LIFECYCLE.md): alarm-to-reload wall for a
+    # full retrain cycle — the mean-time-to-recover of the serving
+    # fleet after a confirmed drift; auc_recovered gates through the
+    # generic auc rule below
+    (re.compile(r"retrain_cycle_s$"), LOWER_IS_BETTER),
     # photon-lint self-hosting gate (docs/ANALYSIS.md): total findings
     # over the tree — NEW findings already fail the lint itself, so
     # what this tracks is ratchet debt (baselined + suppressed) creep;

@@ -161,6 +161,13 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         "model-quality layer: online AUC/calibration gauges from the "
         "feedback loop, baseline-fingerprint health counters",
     ),
+    (
+        "lifecycle",
+        r"lifecycle\.[a-z_]+(\..+)?",
+        "self-healing retrain orchestrator: cycle spans/counters, "
+        "per-stage retry events, retrain_cycle_s gauge, admission "
+        "promotions (lifecycle/orchestrator.py, docs/LIFECYCLE.md)",
+    ),
 )
 
 _COMPILED = tuple(

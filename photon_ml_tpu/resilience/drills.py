@@ -1421,6 +1421,364 @@ def drill_shard_fault(smoke: bool = True) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# drill: the self-healing lifecycle loop, end to end (docs/LIFECYCLE.md)
+# ---------------------------------------------------------------------------
+
+
+def drill_lifecycle(smoke: bool = True) -> dict:
+    """The survival drill for the whole lifecycle loop: train -> export
+    -> serve -> inject +3 sigma covariate shift -> drift alarm -> warm-
+    started ENTITY-KEYED retrain (admitted repeat-miss entities join the
+    training set) -> manifest-gated re-export -> hot-reload under live
+    traffic with ZERO dropped requests -> post-retrain drift quiet. Then
+    the failure-injected variants, each proving its defined degraded
+    outcome (old model keeps serving, alarm stays latched, backoff
+    gates the next cycle):
+
+    - ``retrain.warm_start`` corrupt: the finiteness gate refuses the
+      poisoned prior export; the cycle fails at the retrain stage.
+    - ``retrain.export`` raise: the export dies mid-write — no manifest
+      lands, so the partial directory is invisible to registry polls.
+    - ``retrain.export`` corrupt: a torn-but-manifest-sealed export
+      fails the orchestrator's export gate; the leftover bad directory
+      is breaker-quarantined by serving polls, and a SUBSEQUENT good
+      retrain export still loads (the quarantine is scoped to the bad
+      directory, never the watch root)."""
+    import threading
+
+    from photon_ml_tpu.io.models import load_game_model_auto
+    from photon_ml_tpu.lifecycle.orchestrator import (
+        RetrainOrchestrator,
+        export_retrained_model,
+        latest_version_dir,
+        load_warm_start,
+        next_version_dir,
+        registry_drift_trigger,
+    )
+    from photon_ml_tpu.obs.quality import (
+        BaselineFingerprint,
+        DriftMonitor,
+        compare_fingerprints,
+        try_load_fingerprint,
+    )
+    from photon_ml_tpu.serving.engine import ScoreRequest
+    from photon_ml_tpu.serving.registry import ModelRegistry
+
+    rng = np.random.default_rng(23)
+    d = 4
+    shift = 3.0
+    fit_rows = 512 if smoke else 4096
+
+    def fresh_data(n, mu):
+        X = rng.normal(size=(n, d)) + mu
+        return X
+
+    def fit_global(X, warm):
+        """A few full-batch logistic steps from the warm start — a
+        genuinely warm-started (if tiny) refit."""
+        w = np.array(warm, dtype=float)
+        y = (X @ np.ones(d) > mu_sum(X)).astype(float)
+        for _ in range(10):
+            p = 1.0 / (1.0 + np.exp(-(X @ w)))
+            w -= 0.5 * (X.T @ (p - y)) / len(X)
+        return w
+
+    def mu_sum(X):
+        return float(np.mean(X @ np.ones(d)))
+
+    def make_retrain(watch, data_mu):
+        def retrain(plan):
+            assert plan.warm_start_dir, "plan lost the warm-start source"
+            params, shards, res, shard_vocabs, re_vocabs = (
+                load_warm_start(plan.warm_start_dir)
+            )
+            old_vocab = re_vocabs["userId"]  # {raw key: row}
+            admitted = plan.admitted.get("userId", [])
+            # DIFFERENT key ordering than the prior export on purpose:
+            # a positional carry would misalign every row
+            new_keys = sorted(set(old_vocab) | set(admitted))
+            new_vocab = {k: i for i, k in enumerate(new_keys)}
+            old_table = np.asarray(params["per-user"])
+            new_table = np.zeros((len(new_keys), d))
+            for k, i in new_vocab.items():
+                if k in old_vocab:  # carried BY KEY; admitted rows cold
+                    new_table[i] = old_table[old_vocab[k]]
+            X = fresh_data(fit_rows, data_mu)
+            g = fit_global(X, np.asarray(params["global"]))
+            fp = BaselineFingerprint(max_features=8)
+            fp.observe_rows("s", X)
+            # no margin sketch: the drill's live traffic mixes cold and
+            # per-entity scores, so a fixed-effect margin baseline would
+            # read as score drift — the post-retrain quiet property here
+            # is the FEATURE distribution (margin fidelity is
+            # drill_drift_alarm's subject)
+            return export_retrained_model(
+                next_version_dir(watch),
+                params={"global": g, "per-user": new_table},
+                shards=shards,
+                vocabs={n: shard_vocabs[shards[n]] for n in shards},
+                entity_vocabs={"per-user": new_vocab},
+                random_effects=res,
+                fingerprint=fp,
+            )
+
+        return retrain
+
+    out: Dict[str, object] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        watch = os.path.join(tmp, "watch")
+        adm_path = os.path.join(tmp, "admission.json")
+        traffic_fp_dir = os.path.join(tmp, "traffic-fp")
+        os.makedirs(traffic_fp_dir)
+
+        # -- train + export v0001 on the UNSHIFTED distribution ---------
+        v1 = os.path.join(watch, "v0001")
+        X0 = fresh_data(fit_rows, 0.0)
+        g0 = fit_global(X0, np.zeros(d))
+        fp0 = BaselineFingerprint(max_features=8)
+        fp0.observe_rows("s", X0)
+        fp0.observe_margins(X0 @ g0)
+        from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+
+        vocab = FeatureVocabulary(
+            [feature_key(f"f{j}", "") for j in range(d)]
+        )
+        export_retrained_model(
+            v1,
+            params={
+                "global": g0,
+                "per-user": rng.normal(size=(5, d)),
+            },
+            shards={"global": "s", "per-user": "s"},
+            vocabs={"global": vocab, "per-user": vocab},
+            entity_vocabs={"per-user": {f"u{i}": i for i in range(5)}},
+            random_effects={"global": None, "per-user": "userId"},
+            fingerprint=fp0,
+        )
+
+        # -- serve it, with the admission log riding the engine ---------
+        reg = ModelRegistry(
+            warmup_max_batch=8,
+            breaker_threshold=2,
+            breaker_backoff_s=0.2,
+            breaker_max_backoff_s=1.6,
+            admission_log_path=adm_path,
+        )
+        reg.load(v1, version_id="v0001")
+
+        stop = threading.Event()
+        client_errors: List[str] = []
+        client_scores = [0]
+
+        def client():
+            # live traffic drawn from the CURRENT (shifted) distribution
+            # — these rows land in the drift window too, like real
+            # serving traffic would (own generator: rng isn't shared
+            # across threads)
+            crng = np.random.default_rng(29)
+            while not stop.is_set():
+                try:
+                    reg.score([
+                        ScoreRequest(
+                            features={
+                                f"f{j}": float(crng.normal() + shift)
+                                for j in range(d)
+                            },
+                            entities={"userId": "u1"},
+                        )
+                    ])
+                    client_scores[0] += 1
+                except Exception as e:  # noqa: BLE001 — drill evidence
+                    client_errors.append(repr(e))
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        try:
+            # -- online admission: repeat-missed unknown entities -------
+            for _ in range(2):
+                reg.score([
+                    ScoreRequest(features={"f0": 0.5},
+                                 entities={"userId": k})
+                    for k in ("newuser1", "newuser2")
+                ])
+            v = reg.acquire()
+            try:
+                assert v.engine.admission_log is not None
+                assert v.engine.admission_log.flush(), (
+                    "admission log failed to persist"
+                )
+            finally:
+                reg.release(v)
+
+            # -- inject +3 sigma drift until the live monitor alarms ----
+            eng = reg.current.engine
+            eng.drift = DriftMonitor(
+                try_load_fingerprint(v1),
+                registry=eng.stats.registry,
+                psi_alarm=0.25,
+                check_every_rows=256,
+                min_rows=128,
+                sample_every=1,
+            )
+            shifted_rows = 0
+            while eng.drift.alarms == 0:
+                assert shifted_rows < 2048, "no drift alarm within bound"
+                eng.score_arrays({"s": fresh_data(64, shift)})
+                shifted_rows += 64
+            # the live-traffic fingerprint photon-obs drift would use
+            fp_live = BaselineFingerprint(max_features=8)
+            fp_live.observe_rows("s", fresh_data(fit_rows, shift))
+            fp_live.save(traffic_fp_dir)
+
+            # -- the orchestrator: trigger -> retrain -> reload ---------
+            forced = {"on": False}
+
+            def trigger():
+                if forced["on"]:
+                    return {"source": "drill-forced"}
+                return registry_drift_trigger(reg)()
+
+            def verify():
+                base = try_load_fingerprint(latest_version_dir(watch))
+                cur = try_load_fingerprint(traffic_fp_dir)
+                if base is None or cur is None:
+                    return None
+                return compare_fingerprints(base, cur)
+
+            orch = RetrainOrchestrator(
+                trigger,
+                make_retrain(watch, shift),
+                lambda d_: reg.poll(watch),
+                verify_fn=verify,
+                watch_root=watch,
+                admission_log_path=adm_path,
+                admission_min_misses=2,
+                max_stage_attempts=2,
+                stage_backoff_s=0.01,
+                cycle_backoff_s=0.2,
+                max_cycle_backoff_s=2.0,
+            )
+            result = orch.run_cycle()
+            assert result.ok and result.triggered, (
+                f"happy-path cycle failed: {result}"
+            )
+            assert reg.version() == "v0002", "hot-reload did not land"
+            assert not orch.alarm_latched, "clean cycle must clear latch"
+            retrain_cycle_s = result.cycle_s
+            admitted = result.plan.admitted.get("userId", [])
+            assert set(admitted) >= {"newuser1", "newuser2"}, (
+                f"repeat-missed entities not promoted: {admitted}"
+            )
+
+            # entity-keyed carry: same KEY -> same row, despite the new
+            # export's reordered entity vocabulary
+            old = load_game_model_auto(v1)
+            new = load_game_model_auto(os.path.join(watch, "v0002"))
+            ov, nv = old[4]["userId"], new[4]["userId"]
+            assert set(nv) > set(ov), "admitted entities missing"
+            for k in ov:
+                np.testing.assert_allclose(
+                    np.asarray(new[0]["per-user"])[nv[k]],
+                    np.asarray(old[0]["per-user"])[ov[k]],
+                    atol=1e-12,
+                    err_msg=f"entity {k!r} row not carried by key",
+                )
+            # admitted entities now score through the RE path
+            assert "newuser1" in reg.current.engine.re_vocabs["userId"]
+
+            # -- post-retrain drift: quiet on the SAME shifted traffic --
+            eng2 = reg.current.engine
+            eng2.drift = DriftMonitor(
+                try_load_fingerprint(os.path.join(watch, "v0002")),
+                registry=eng2.stats.registry,
+                psi_alarm=0.25,
+                check_every_rows=256,
+                min_rows=128,
+                sample_every=1,
+            )
+            for _ in range(8):
+                eng2.score_arrays({"s": fresh_data(64, shift)})
+            assert eng2.drift.checks >= 1 and eng2.drift.alarms == 0, (
+                "post-retrain drift still alarming: "
+                f"{eng2.drift.last_report}"
+            )
+            psi_after = eng2.drift.last_report["psi_max"]
+            assert psi_after < 0.25
+
+            # -- variant 1: corrupt warm start -> finiteness gate -------
+            forced["on"] = True
+            with inject(
+                FaultSpec("retrain.warm_start", "corrupt", nth=1, count=4)
+            ):
+                r1 = orch.run_cycle()
+            assert not r1.ok and r1.stage == "retrain", r1
+            assert reg.version() == "v0002", "old model must keep serving"
+            assert orch.alarm_latched, "failed cycle must latch the alarm"
+
+            # backoff gates the next cycle until force overrides
+            r_skip = orch.run_cycle()
+            assert r_skip.skipped and r_skip.next_retry_s > 0, r_skip
+
+            # -- variant 2: export dies mid-write -> no manifest --------
+            with inject(
+                FaultSpec("retrain.export", "raise", nth=1, count=4)
+            ):
+                r2 = orch.run_cycle(force=True)
+            assert not r2.ok and r2.stage == "retrain", r2
+            assert latest_version_dir(watch).endswith("v0002"), (
+                "a manifest-less partial export must stay invisible"
+            )
+            assert reg.version() == "v0002"
+
+            # -- variant 3: torn-but-sealed export -> gate + breaker ----
+            with inject(
+                FaultSpec("retrain.export", "corrupt", nth=1)
+            ):
+                r3 = orch.run_cycle(force=True)
+            assert not r3.ok and r3.stage == "export_gate", r3
+            # the torn export is sealed (manifest-bearing) and newest
+            bad = latest_version_dir(watch)
+            assert not bad.endswith("v0002"), "torn export not on disk"
+            # serving polls hit the torn export until its breaker opens
+            for _ in range(4):
+                assert reg.poll(watch) is None
+                if reg.breaker.state(bad) in ("open", "half_open"):
+                    break
+            assert reg.breaker.state(bad) == "open", (
+                reg.breaker.snapshot()
+            )
+            assert reg.version() == "v0002"
+
+            # -- recovery: a good retrain is NOT blocked by the
+            # quarantined bad directory (quarantine is per-dir)
+            r4 = orch.run_cycle(force=True)
+            assert r4.ok, f"recovery cycle failed: {r4}"
+            good = os.path.basename(r4.export_dir.rstrip(os.sep))
+            assert reg.version() == good, (
+                "good export must load past the quarantined one"
+            )
+            assert not orch.alarm_latched
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+        assert not client_errors, (
+            f"dropped requests during lifecycle: {client_errors[:3]}"
+        )
+        assert client_scores[0] > 0, "traffic thread never scored"
+        out = {
+            "retrain_cycle_s": round(retrain_cycle_s, 4),
+            "alarm_latency_rows": shifted_rows,
+            "admitted_entities": len(admitted),
+            "psi_after_retrain": psi_after,
+            "client_scores": client_scores[0],
+            "client_errors": 0,
+            "failed_cycles": 3,
+        }
+    return out
+
+
 DRILLS: Dict[str, Callable[[bool], dict]] = {
     "site_registry": drill_site_registry,
     "serving_score": drill_serving_score,
@@ -1449,6 +1807,11 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     # with zero lost requests and an honest p99 ledger; a failed cache
     # promotion leaves entities cold, never corrupt
     "shard_fault": drill_shard_fault,
+    # the self-healing lifecycle loop (docs/LIFECYCLE.md): drift alarm
+    # -> entity-keyed warm-started retrain with admitted entities ->
+    # manifest-gated export -> breaker-guarded hot-reload, zero dropped
+    # requests; failure variants leave the old model serving
+    "lifecycle": drill_lifecycle,
 }
 
 # the subset `photon-chaos drill --multihost-smoke` runs: every drill of

@@ -42,6 +42,20 @@ Sites in-tree today::
                             key; raise = failed host->HBM copy — the
                             entities stay cold and serve fixed-effect-
                             only; delay = a slow tier)
+    cache.admission_log     per admission-log flush (key = log path;
+                            raise = failed atomic-swap write — entries
+                            stay in memory and the next flush retries;
+                            scoring is never touched)
+    retrain.warm_start      per lifecycle warm-start load (key = export
+                            dir; raise/corrupt = unreadable or torn
+                            prior export — the cycle fails, the old
+                            model keeps serving, the alarm stays
+                            latched)
+    retrain.export          per lifecycle re-export (key = output dir;
+                            raise = export died mid-write — no manifest
+                            lands, so the registry never loads the
+                            partial dir; corrupt = torn payload the
+                            manifest gate / reload breaker must catch)
 
 Arming a site OUTSIDE this list raises at arm time: a typo'd drill that
 silently probes nothing would "pass" by testing nothing. Libraries that
@@ -99,6 +113,9 @@ KNOWN_SITES = (
     "partition.shard_skew",
     "serving.shard_route",
     "serving.cache_tier",
+    "cache.admission_log",
+    "retrain.warm_start",
+    "retrain.export",
 )
 
 MODES = ("raise", "corrupt", "delay")

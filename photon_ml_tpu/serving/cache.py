@@ -38,8 +38,11 @@ gathers them with ONE entity column). Chaos drills arm the
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +52,200 @@ from photon_ml_tpu import obs
 from photon_ml_tpu.resilience import faults as _faults
 
 DEFAULT_PROMOTE_BATCH = 64
+
+ADMISSION_LOG_VERSION = 1
+DEFAULT_ADMISSION_CAPACITY = 4096
+DEFAULT_ADMISSION_FLUSH_EVERY = 64
+
+
+class AdmissionLog:
+    """Bounded repeat-miss admission log: the serving->training feedback
+    channel of the lifecycle loop (docs/LIFECYCLE.md).
+
+    Every cache miss (a known-but-cold entity) and every unknown entity
+    id the engine featurizes records ``(entity key, miss count, last
+    seen)`` here; the retrain orchestrator promotes repeat-missed keys
+    (count >= its threshold) into the next training set. Properties:
+
+    - **Bounded.** At most ``capacity`` entries across all RE keys;
+      over capacity the lowest-(misses, last_seen) entry is evicted, so
+      a scan of one-off ids can never grow the log without limit.
+    - **Atomic-swap persistence.** Flushes write ``<path>.tmp`` then
+      ``os.replace`` — a reader (the orchestrator, possibly another
+      process) never sees a torn log. The ``cache.admission_log`` fault
+      site is probed per flush; a failed write keeps the entries in
+      memory and the next flush retries. Scoring is never touched.
+    - **Crash-tolerant load.** An unreadable/garbage file starts the
+      log empty (counted in ``serving.cache.admission_logged`` from
+      zero) rather than failing engine construction.
+
+    Writes happen OFF the scoring path: ``note()`` is O(keys) dict
+    updates; the file write runs from the cache promotion worker (or an
+    explicit :meth:`flush`)."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        capacity: int = DEFAULT_ADMISSION_CAPACITY,
+        flush_every: int = DEFAULT_ADMISSION_FLUSH_EVERY,
+        stats=None,
+    ):
+        self.path = path
+        self.capacity = int(capacity)
+        self.flush_every = int(flush_every)
+        self.stats = stats
+        self._lock = threading.Lock()
+        # re_key -> {entity key -> [miss_count, last_seen_unix]}
+        self._entries: Dict[str, Dict[str, List[float]]] = {}
+        self._pending_notes = 0
+        self._dirty = False
+        for rk, ents in self.load(path).items():
+            self._entries[rk] = {
+                k: [int(v["misses"]), float(v["last_seen"])]
+                for k, v in ents.items()
+            }
+
+    @staticmethod
+    def load(path: str) -> Dict[str, Dict[str, dict]]:
+        """Read a persisted log -> ``{re_key: {key: {misses, last_seen}}}``.
+        Missing or torn files read as empty (the degraded outcome of a
+        ``cache.admission_log`` corrupt fault: admissions are lost, the
+        loop just re-learns them; nothing raises)."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            entries = doc.get("entries", {})
+            out: Dict[str, Dict[str, dict]] = {}
+            for rk, ents in entries.items():
+                out[str(rk)] = {
+                    str(k): {
+                        "misses": int(v["misses"]),
+                        "last_seen": float(v["last_seen"]),
+                    }
+                    for k, v in ents.items()
+                }
+            return out
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            return {}
+
+    def note(self, re_key: str, keys, now: Optional[float] = None) -> int:
+        """Record one miss per key (a cache miss or an unknown entity
+        id). Returns the number of NEW log entries created — that count
+        feeds ``serving.cache.admission_logged``."""
+        if now is None:
+            now = time.time()
+        created = 0
+        with self._lock:
+            ents = self._entries.setdefault(re_key, {})
+            for key in keys:
+                key = str(key)
+                entry = ents.get(key)
+                if entry is None:
+                    ents[key] = [1, now]
+                    created += 1
+                else:
+                    entry[0] += 1
+                    entry[1] = now
+            self._pending_notes += len(keys)
+            if keys:
+                self._dirty = True
+            self._evict_locked()
+        if created and self.stats is not None:
+            self.stats.record_admission_logged(created)
+        return created
+
+    def _evict_locked(self) -> None:
+        total = sum(len(e) for e in self._entries.values())
+        while total > self.capacity:
+            victim = min(
+                (
+                    (entry[0], entry[1], rk, key)
+                    for rk, ents in self._entries.items()
+                    for key, entry in ents.items()
+                ),
+            )
+            del self._entries[victim[2]][victim[3]]
+            total -= 1
+
+    def promotable(self, min_misses: int = 2) -> Dict[str, List[str]]:
+        """Repeat-missed keys per RE key (miss count >= ``min_misses``)
+        — the orchestrator's admission set, most-missed first."""
+        with self._lock:
+            out: Dict[str, List[str]] = {}
+            for rk, ents in self._entries.items():
+                keys = [
+                    k for k, v in ents.items() if v[0] >= int(min_misses)
+                ]
+                keys.sort(key=lambda k: (-ents[k][0], k))
+                if keys:
+                    out[rk] = keys
+            return out
+
+    def maybe_flush(self) -> bool:
+        """Flush when enough notes accumulated since the last write —
+        the promotion worker's cheap call."""
+        with self._lock:
+            due = self._dirty and self._pending_notes >= self.flush_every
+        return self.flush() if due else False
+
+    def flush(self) -> bool:
+        """Atomic-swap write of the current entries. Returns True when a
+        write landed; False on a (possibly injected) failure, in which
+        case everything stays in memory and the next flush retries."""
+        with self._lock:
+            if not self._dirty:
+                return False
+            doc = {
+                "version": ADMISSION_LOG_VERSION,
+                "capacity": self.capacity,
+                "entries": {
+                    rk: {
+                        k: {"misses": v[0], "last_seen": v[1]}
+                        for k, v in ents.items()
+                    }
+                    for rk, ents in self._entries.items()
+                },
+            }
+        tmp = self.path + ".tmp"
+        try:
+            # chaos seam: the admission-log write. raise = failed
+            # atomic swap (entries stay in memory, next flush retries);
+            # corrupt = torn log the tolerant loader must survive.
+            action = _faults.fire("cache.admission_log", key=self.path)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+            if action is not None and action.corrupt:
+                _faults.corrupt_file(self.path)
+        except OSError as e:
+            obs.emit_event(
+                "serving.admission_log_write_failed",
+                cat="serving",
+                path=self.path,
+                error=repr(e),
+            )
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except FileNotFoundError:
+                pass  # the swap landed (or the write never started)
+        with self._lock:
+            self._pending_notes = 0
+            self._dirty = False
+        return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "path": self.path,
+                "capacity": self.capacity,
+                "entries": int(
+                    sum(len(e) for e in self._entries.values())
+                ),
+                "dirty": bool(self._dirty),
+            }
 
 
 @jax.jit
@@ -74,10 +271,19 @@ class TieredEntityCache:
         worker: bool = True,
         promote_batch: int = DEFAULT_PROMOTE_BATCH,
         preload_head: bool = True,
+        admission_log: Optional[AdmissionLog] = None,
+        entity_key_of: Optional[Callable[[int], str]] = None,
     ):
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.re_key = re_key
+        # repeat-miss admission log (shared across this engine's caches):
+        # every translate() miss is noted BY ENTITY KEY (entity_key_of
+        # maps a global row index back to the raw vocab key) so the
+        # retrain orchestrator can admit the repeat-missed tail into the
+        # next training set. Noting happens outside the slot lock.
+        self.admission_log = admission_log
+        self._entity_key_of = entity_key_of or str
         self.num_entities = int(num_entities)
         self.capacity = int(min(capacity, max(num_entities, 1)))
         self.dtype = dtype
@@ -182,6 +388,11 @@ class TieredEntityCache:
         misses = int(np.count_nonzero(known) - hits)
         if self.stats is not None:
             self.stats.record_cache(hits, misses)
+        if self.admission_log is not None and missed.size:
+            self.admission_log.note(
+                self.re_key,
+                [self._entity_key_of(e) for e in missed.tolist()],
+            )
         if misses and self._thread is not None:
             self._wake.set()
         if with_tables:
@@ -305,6 +516,11 @@ class TieredEntityCache:
                 return
             try:
                 self.promote_pending()
+                if self.admission_log is not None:
+                    # persistence rides the worker, never the scoring
+                    # path: a slow/failed write costs nothing but log
+                    # freshness
+                    self.admission_log.maybe_flush()
             except Exception as e:  # noqa: BLE001 — worker must survive
                 obs.emit_event(
                     "serving.cache_tier_worker_error",
@@ -319,6 +535,8 @@ class TieredEntityCache:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self.admission_log is not None:
+            self.admission_log.flush()
 
     # -- readout -----------------------------------------------------------
 

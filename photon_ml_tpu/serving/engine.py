@@ -186,6 +186,7 @@ class ScoringEngine:
         baseline=None,
         drift=None,
         hbm_cache_entities: Optional[int] = None,
+        admission_log_path: Optional[str] = None,
     ):
         install_compile_listener()
         self.dtype = jnp.empty((), dtype).dtype  # canonicalized (x64 seam)
@@ -242,6 +243,19 @@ class ScoringEngine:
             if self.random_effects.get(name) is None
         ]
         compact = self._precompact(params)
+        # repeat-miss admission log (serving/cache.py): the persisted
+        # serving->training feedback channel. Both miss streams feed it
+        # — tiered-cache misses (known-but-cold entities, noted by the
+        # caches below) and unknown entity ids (featurize maps them to
+        # -1 and notes the raw key here) — so the retrain orchestrator
+        # can admit the repeat-missed tail into the next training set.
+        self._admission = None
+        if admission_log_path:
+            from photon_ml_tpu.serving.cache import AdmissionLog
+
+            self._admission = AdmissionLog(
+                admission_log_path, stats=self.stats
+            )
         # tiered HBM/host entity cache (serving/cache.py): the hot Zipf
         # head of each entity-keyed table lives in the HBM tier passed to
         # every executable; the cold tail stays in host RAM and promotes
@@ -347,12 +361,25 @@ class ScoringEngine:
                     f"coordinates keyed {re_key!r} have {sizes[re_key]}"
                 )
         for re_key, rows in sizes.items():
+            # admission-log key resolver: global row index -> raw vocab
+            # key, so the log speaks entity KEYS (what a training set
+            # admits), never positions (the PR-4 bug class)
+            reverse = {
+                idx: raw
+                for raw, idx in (self.re_vocabs.get(re_key) or {}).items()
+            }
             self._caches[re_key] = TieredEntityCache(
                 re_key,
                 num_entities=rows,
                 capacity=capacity,
                 dtype=self.dtype,
                 stats=self.stats,
+                admission_log=self._admission,
+                entity_key_of=(
+                    (lambda e, _r=reverse: str(_r.get(e, e)))
+                    if reverse
+                    else None
+                ),
             )
         out = dict(compact)
         for name in self._coord_order:
@@ -457,11 +484,25 @@ class ScoringEngine:
             return None
         return {rk: c.snapshot() for rk, c in sorted(self._caches.items())}
 
+    def admission_snapshot(self) -> Optional[dict]:
+        """Repeat-miss admission-log state (None when no log is
+        configured) — surfaced through registry ``health()``."""
+        if self._admission is None:
+            return None
+        return self._admission.snapshot()
+
+    @property
+    def admission_log(self):
+        return self._admission
+
     def close(self) -> None:
-        """Release background resources (cache promotion workers). The
-        registry calls this when a version retires; idempotent."""
+        """Release background resources (cache promotion workers, the
+        admission log's final flush). The registry calls this when a
+        version retires; idempotent."""
         for cache in self._caches.values():
             cache.close()
+        if self._admission is not None:
+            self._admission.flush()
 
     # -- construction ------------------------------------------------------
 
@@ -674,6 +715,7 @@ class ScoringEngine:
         for rk in self._re_keys:
             vocab = self.re_vocabs.get(rk, {})
             col = ents[rk]
+            unknown = []
             for i, r in enumerate(requests):
                 raw = r.entities.get(rk)
                 if raw is None:
@@ -683,6 +725,13 @@ class ScoringEngine:
                     e = vocab.get(_maybe_int(raw))
                 if e is not None:
                     col[i] = e
+                else:
+                    unknown.append(str(raw))
+            if unknown and self._admission is not None:
+                # entities the model has never seen: the other half of
+                # the admission stream (cache misses cover the known-
+                # but-cold half)
+                self._admission.note(rk, unknown)
         offsets = np.asarray([r.offset for r in requests], np.float64)
         return feats, ents, offsets
 

@@ -428,10 +428,14 @@ class ModelRegistry:
                     ),
                 }
         cache = None
+        admission = None
         if v is not None and v.engine is not None:
             snap = getattr(v.engine, "cache_snapshot", lambda: None)()
             if snap is not None:
                 cache = snap
+            admission = getattr(
+                v.engine, "admission_snapshot", lambda: None
+            )()
         return {
             "version": v.version_id if v is not None else None,
             "inflight": v.inflight if v is not None else 0,
@@ -442,6 +446,7 @@ class ModelRegistry:
             "drift": drift,
             "serving_shards": self.serving_shards,
             "cache": cache,
+            "admission_log": admission,
         }
 
     # -- watch mode --------------------------------------------------------

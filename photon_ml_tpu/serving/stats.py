@@ -217,6 +217,19 @@ class ServingStats:
         with self._lock:
             self._inc("cache.tier_errors")
 
+    def record_admission_logged(self, n: int) -> None:
+        """Entity keys recorded into the repeat-miss admission log —
+        the lifecycle orchestrator's input for admitting new/cold
+        entities into the next training set."""
+        with self._lock:
+            self._inc("cache.admission_logged", n)
+
+    def record_admission_promoted(self, n: int) -> None:
+        """Admission-log entries the lifecycle orchestrator promoted
+        into a retrain's entity set (repeat-miss threshold met)."""
+        with self._lock:
+            self._inc("cache.admission_promoted", n)
+
     def cache_hit_frac(self) -> float:
         with self._lock:
             hits = self.registry.counter("serving.cache.hits").value
@@ -355,6 +368,18 @@ class ServingStats:
                 self.registry.counter("serving.cache.tier_errors").value
             ),
             "hit_frac": round(hits / total, 6) if total else 0.0,
+            # additive keys (schema above is golden-tested): the
+            # repeat-miss admission log feeding the retrain loop
+            "admission_logged": int(
+                self.registry.counter(
+                    "serving.cache.admission_logged"
+                ).value
+            ),
+            "admission_promoted": int(
+                self.registry.counter(
+                    "serving.cache.admission_promoted"
+                ).value
+            ),
         }
 
     def _shard_snapshot(self) -> dict:

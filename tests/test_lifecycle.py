@@ -1,0 +1,582 @@
+"""Self-healing lifecycle loop (docs/LIFECYCLE.md).
+
+Contracts: version-dir selection is manifest-gated (partials invisible,
+torn-but-sealed exports skipped by the verified warm-start resolver);
+the admission log is bounded, atomic-swap persisted, and torn-tolerant;
+the retrain orchestrator's failure semantics are the defined degraded
+outcome (old model serves, alarm stays latched, exponential backoff);
+a breaker-quarantined bad export never blocks a SUBSEQUENT good one;
+checkpoint reindexing and warm-started retrains carry entity rows BY
+KEY, never by position; and the warm-started lambda path's scan and
+loop modes are the same algorithm. The live end-to-end proof (zero
+dropped requests under drift + retrain + hot reload) is the
+``lifecycle`` chaos drill in resilience/drills.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from test_game import build_game, make_mixed_effects_data
+
+from photon_ml_tpu.io.checkpoint import (
+    TrainingCheckpoint,
+    reindex_entity_params,
+)
+from photon_ml_tpu.io.vocab import FeatureVocabulary, feature_key
+from photon_ml_tpu.lifecycle import (
+    RetrainOrchestrator,
+    export_retrained_model,
+    latest_version_dir,
+    load_admission_candidates,
+    load_warm_start,
+    next_version_dir,
+)
+from photon_ml_tpu.resilience.faults import FaultSpec, corrupt_file, inject
+from photon_ml_tpu.serving.cache import AdmissionLog
+
+pytestmark = pytest.mark.lifecycle
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def _export(root, rng, d=3, users=("u0", "u1", "u2"), scale=1.0):
+    """A sealed (manifest-bearing) GAME export with a per-user table."""
+    vocab = FeatureVocabulary([feature_key(f"f{j}", "") for j in range(d)])
+    return export_retrained_model(
+        root,
+        params={
+            "global": scale * np.arange(1.0, d + 1),
+            "per-user": scale * rng.normal(size=(len(users), d)),
+        },
+        shards={"global": "s", "per-user": "s"},
+        vocabs={"global": vocab, "per-user": vocab},
+        entity_vocabs={"per-user": {u: i for i, u in enumerate(users)}},
+        random_effects={"global": None, "per-user": "userId"},
+    )
+
+
+def _tear(export_dir):
+    """Corrupt one manifest-covered payload file AFTER sealing — the
+    torn-export shape the gates must reject."""
+    from photon_ml_tpu.io.models import MODEL_MANIFEST
+
+    for base, _, files in sorted(os.walk(export_dir)):
+        for f in sorted(files):
+            if f != MODEL_MANIFEST:
+                corrupt_file(os.path.join(base, f))
+                return
+    raise AssertionError("no payload file to corrupt")
+
+
+# ---------------------------------------------------------------------------
+# version-dir selection
+# ---------------------------------------------------------------------------
+
+
+class TestVersionDirs:
+    def test_partials_burn_numbers_but_stay_invisible(self, rng, tmp_path):
+        """A manifest-less partial dir (a retrain that died mid-export)
+        consumes a version number — next_version_dir never reuses it —
+        but is invisible to latest_version_dir and registry polls."""
+        watch = str(tmp_path / "watch")
+        _export(os.path.join(watch, "v0001"), rng)
+        os.makedirs(os.path.join(watch, "v0002"))  # partial: no manifest
+        assert next_version_dir(watch).endswith("v0003")
+        assert latest_version_dir(watch).endswith("v0001")
+
+    def test_verified_resolver_skips_torn_export(self, rng, tmp_path):
+        """A torn-but-SEALED export is the newest manifest-bearing dir,
+        but must never become a warm-start source: verified=True walks
+        back to the newest export that passes content verification."""
+        watch = str(tmp_path / "watch")
+        v1 = _export(os.path.join(watch, "v0001"), rng)
+        v2 = _export(os.path.join(watch, "v0002"), rng)
+        _tear(v2)
+        assert latest_version_dir(watch) == v2
+        assert latest_version_dir(watch, verified=True) == v1
+
+    def test_empty_watch_root(self, tmp_path):
+        watch = str(tmp_path / "nothing")
+        assert latest_version_dir(watch) is None
+        assert latest_version_dir(watch, verified=True) is None
+        assert next_version_dir(watch).endswith("v0001")
+
+
+# ---------------------------------------------------------------------------
+# admission log (serving -> training feedback channel)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionLog:
+    def test_roundtrip_and_promotion_threshold(self, tmp_path):
+        path = str(tmp_path / "adm.json")
+        log = AdmissionLog(path, capacity=64)
+        log.note("userId", ["a", "b"])
+        log.note("userId", ["a"])
+        log.note("itemId", ["x"])
+        assert log.flush()
+        # repeat-missed only, most-missed first
+        assert log.promotable(min_misses=2) == {"userId": ["a"]}
+        reloaded = AdmissionLog(path, capacity=64)
+        assert reloaded.promotable(min_misses=1) == {
+            "userId": ["a", "b"],
+            "itemId": ["x"],
+        }
+        cands = load_admission_candidates(path, min_misses=2)
+        assert cands == {"userId": ["a"]}
+
+    def test_bounded_eviction_prefers_repeat_missers(self, tmp_path):
+        """A scan of one-off ids can never evict a repeat-missed entity
+        or grow the log past capacity."""
+        log = AdmissionLog(str(tmp_path / "adm.json"), capacity=8)
+        log.note("userId", ["hot"], now=1.0)
+        log.note("userId", ["hot"], now=2.0)
+        for i in range(64):
+            log.note("userId", [f"scan{i:03d}"], now=3.0 + i)
+        snap = log.promotable(min_misses=1)
+        assert len(snap["userId"]) <= 8
+        assert "hot" in snap["userId"]
+
+    def test_torn_log_reads_empty(self, tmp_path):
+        path = str(tmp_path / "adm.json")
+        with open(path, "w") as f:
+            f.write('{"version": 1, "entries": {"userId"')  # torn JSON
+        assert AdmissionLog.load(path) == {}
+        assert AdmissionLog(path).promotable(min_misses=1) == {}
+        assert load_admission_candidates(path) == {}
+
+    def test_flush_fault_keeps_entries_and_retries(self, tmp_path):
+        """An injected write failure is the degraded outcome: nothing
+        raises, entries stay in memory, the NEXT flush lands."""
+        path = str(tmp_path / "adm.json")
+        log = AdmissionLog(path, capacity=8)
+        log.note("userId", ["a", "a"])
+        with inject(FaultSpec("cache.admission_log", "raise", nth=1)):
+            assert not log.flush()
+        assert not os.path.exists(path)
+        assert log.flush()
+        assert json.load(open(path))["entries"]["userId"]["a"]["misses"] == 2
+
+    def test_missing_path_is_no_candidates(self, tmp_path):
+        assert load_admission_candidates(None) == {}
+        assert load_admission_candidates(str(tmp_path / "absent.json")) == {}
+
+
+# ---------------------------------------------------------------------------
+# retrain orchestrator: stage semantics + degraded outcomes
+# ---------------------------------------------------------------------------
+
+
+def _orchestrator(watch, retrain_fn, reload_fn, trigger=None, **kw):
+    return RetrainOrchestrator(
+        trigger=trigger or (lambda: {"source": "test"}),
+        retrain_fn=retrain_fn,
+        reload_fn=reload_fn,
+        watch_root=watch,
+        stage_backoff_s=0.0,
+        cycle_backoff_s=0.05,
+        max_cycle_backoff_s=0.4,
+        **kw,
+    )
+
+
+class TestOrchestrator:
+    def test_untriggered_cycle_is_a_noop(self, tmp_path):
+        calls = []
+        orch = _orchestrator(
+            str(tmp_path / "watch"),
+            retrain_fn=lambda plan: calls.append(plan),
+            reload_fn=lambda d: calls.append(d),
+            trigger=lambda: None,
+        )
+        result = orch.run_cycle()
+        assert result.ok and not result.triggered
+        assert not calls and not orch.alarm_latched
+
+    def test_happy_cycle_warm_starts_from_verified_export(
+        self, rng, tmp_path
+    ):
+        """Full stage chain; the plan's warm-start source is the newest
+        VERIFIED export (the torn v0002 is skipped), and success clears
+        the latch."""
+        watch = str(tmp_path / "watch")
+        v1 = _export(os.path.join(watch, "v0001"), rng)
+        _tear(_export(os.path.join(watch, "v0002"), rng))
+        seen = {}
+
+        def retrain(plan):
+            seen["plan"] = plan
+            return _export(next_version_dir(watch), rng, scale=2.0)
+
+        orch = _orchestrator(
+            watch, retrain, lambda d: os.path.basename(d)
+        )
+        result = orch.run_cycle()
+        assert result.ok and result.triggered
+        assert [s.name for s in result.stages] == [
+            "trigger", "plan", "retrain", "export_gate", "reload",
+            "verify",
+        ]
+        assert seen["plan"].warm_start_dir == v1
+        assert result.version == "v0003"
+        assert not orch.alarm_latched
+        assert orch.consecutive_failures == 0
+
+    def test_failed_retrain_latches_backs_off_then_recovers(
+        self, rng, tmp_path
+    ):
+        """The tentpole's defined degraded outcome: a failed retrain
+        keeps the old model serving, latches the alarm, retries within
+        the cycle (max_stage_attempts), then backs off; a later forced
+        cycle recovers and clears everything."""
+        watch = str(tmp_path / "watch")
+        _export(os.path.join(watch, "v0001"), rng)
+        healthy = {"on": False}
+
+        def retrain(plan):
+            if not healthy["on"]:
+                raise OSError("training cluster unreachable")
+            return _export(next_version_dir(watch), rng)
+
+        orch = _orchestrator(
+            watch, retrain, lambda d: os.path.basename(d),
+            max_stage_attempts=2,
+        )
+        r1 = orch.run_cycle()
+        assert not r1.ok and r1.stage == "retrain"
+        assert r1.stages[-1].attempts == 2  # in-cycle retry happened
+        assert orch.alarm_latched and r1.next_retry_s > 0
+        # inside the backoff window: the cycle is a no-op skip
+        r2 = orch.run_cycle()
+        assert r2.skipped and not r2.ok and r2.next_retry_s > 0
+        # forced recovery once the fault clears
+        healthy["on"] = True
+        r3 = orch.run_cycle(force=True)
+        assert r3.ok and r3.version == "v0002"
+        assert not orch.alarm_latched and orch.consecutive_failures == 0
+
+    def test_export_gate_rejects_torn_export_before_reload(
+        self, rng, tmp_path
+    ):
+        """Defense in depth: a torn-but-sealed export dies at the
+        orchestrator's own gate — the registry never sees it and no
+        breaker probe is burned."""
+        watch = str(tmp_path / "watch")
+        _export(os.path.join(watch, "v0001"), rng)
+        reloads = []
+
+        def retrain(plan):
+            out = _export(next_version_dir(watch), rng)
+            _tear(out)
+            return out
+
+        orch = _orchestrator(watch, retrain, reloads.append)
+        result = orch.run_cycle()
+        assert not result.ok and result.stage == "export_gate"
+        assert not reloads and orch.alarm_latched
+
+    def test_post_reload_verify_failure_keeps_latch(self, rng, tmp_path):
+        """A retrain that ships but does NOT fix the drift fails the
+        verify stage: the alarm stays latched so the next cycle tries
+        again rather than declaring victory."""
+        watch = str(tmp_path / "watch")
+        _export(os.path.join(watch, "v0001"), rng)
+        orch = _orchestrator(
+            watch,
+            lambda plan: _export(next_version_dir(watch), rng),
+            lambda d: os.path.basename(d),
+            verify_fn=lambda: {"alarm": True, "psi_max": 9.9},
+        )
+        result = orch.run_cycle()
+        assert not result.ok and result.stage == "verify"
+        assert orch.alarm_latched
+
+    def test_warm_start_fault_site_fails_retrain_stage(
+        self, rng, tmp_path
+    ):
+        """The retrain.warm_start chaos seam: a corrupted warm-start
+        read poisons the load, the finiteness gate catches it, and the
+        cycle fails at the retrain stage with the export tree
+        untouched."""
+        watch = str(tmp_path / "watch")
+        _export(os.path.join(watch, "v0001"), rng)
+
+        def retrain(plan):
+            load_warm_start(plan.warm_start_dir)
+            raise AssertionError("warm start should have failed")
+
+        orch = _orchestrator(
+            watch, retrain, lambda d: d, max_stage_attempts=1
+        )
+        with inject(
+            FaultSpec("retrain.warm_start", "corrupt", nth=1, count=-1)
+        ):
+            result = orch.run_cycle()
+        assert not result.ok and result.stage == "retrain"
+        assert latest_version_dir(watch).endswith("v0001")
+
+
+# ---------------------------------------------------------------------------
+# breaker scope: a quarantined bad export never blocks the next good one
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerScope:
+    def test_quarantined_export_does_not_block_subsequent_good_one(
+        self, rng, tmp_path
+    ):
+        """Satellite regression: the reload breaker quarantines the BAD
+        DIRECTORY, never the watch root. With the bad dir's backoff
+        still far from expiring, a subsequent good export must load on
+        the very next poll."""
+        from photon_ml_tpu.serving.registry import ModelRegistry
+
+        watch = str(tmp_path / "watch")
+        v1 = _export(os.path.join(watch, "v0001"), rng)
+        reg = ModelRegistry(
+            warmup_max_batch=8,
+            breaker_threshold=2,
+            breaker_backoff_s=300.0,  # success below can't be a probe
+        )
+        reg.load(v1, version_id="v0001")
+        v2 = _export(os.path.join(watch, "v0002"), rng)
+        _tear(v2)
+        for _ in range(2):
+            assert reg.poll(watch) is None
+        assert reg.breaker.state(v2) == "open", reg.breaker.snapshot()
+        assert reg.version() == "v0001"
+
+        v3 = _export(os.path.join(watch, "v0003"), rng, scale=2.0)
+        assert reg.poll(watch) == "v0003"
+        assert reg.version() == "v0003"
+        assert reg.breaker.state(v2) == "open"  # quarantine persists
+
+
+# ---------------------------------------------------------------------------
+# entity-keyed carry: reindex + warm-started retrain shapes
+# ---------------------------------------------------------------------------
+
+
+def _ckpt(params, entity_keys):
+    return TrainingCheckpoint(
+        step=1,
+        params=params,
+        rng_key=np.zeros(2, np.uint32),
+        history=[],
+        entity_keys=entity_keys,
+    )
+
+
+class TestReindexRetrainShapes:
+    def test_added_removed_reordered_entities_carry_by_key(self, rng):
+        """The retrain shape: admissions add rows, churned entities
+        leave, and the new vocab's ORDER differs — every surviving row
+        must land under its key, new rows start cold."""
+        table = np.arange(12.0).reshape(3, 4)
+        ckpt = _ckpt(
+            {"per-user": table}, {"per-user": ["a", "b", "c"]}
+        )
+        out = reindex_entity_params(
+            ckpt, {"per-user": ["c", "new", "a"]}
+        )
+        np.testing.assert_array_equal(out["per-user"][0], table[2])  # c
+        np.testing.assert_array_equal(out["per-user"][1], 0.0)  # cold
+        np.testing.assert_array_equal(out["per-user"][2], table[0])  # a
+
+    def test_identical_order_is_bit_for_bit(self, rng):
+        table = rng.normal(size=(4, 3))
+        ckpt = _ckpt(
+            {"per-user": table}, {"per-user": ["a", "b", "c", "d"]}
+        )
+        out = reindex_entity_params(
+            ckpt, {"per-user": ["a", "b", "c", "d"]}
+        )
+        assert out["per-user"] is table
+
+    def test_factored_params_reindex_gamma_only(self, rng):
+        """Factored RE tables re-key the per-entity gamma rows; the
+        shared projection is replicated and must pass through."""
+        from photon_ml_tpu.game.factored import FactoredParams
+
+        gamma = np.arange(6.0).reshape(3, 2)
+        proj = rng.normal(size=(4, 2))
+        ckpt = _ckpt(
+            {"fact": FactoredParams(gamma=gamma, projection=proj)},
+            {"fact": ["a", "b", "c"]},
+        )
+        out = reindex_entity_params(ckpt, {"fact": ["b", "a"]})
+        np.testing.assert_array_equal(
+            np.asarray(out["fact"].gamma), gamma[[1, 0]]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["fact"].projection), proj
+        )
+
+    def test_tables_without_keys_pass_through(self, rng):
+        fixed = rng.normal(size=5)
+        ckpt = _ckpt(
+            {"fixed": fixed, "per-user": rng.normal(size=(2, 3))},
+            {"per-user": ["a", "b"]},
+        )
+        out = reindex_entity_params(ckpt, {"per-user": ["a", "b"]})
+        assert out["fixed"] is fixed
+
+
+class TestWarmStartedRetrainFreeze:
+    def test_frozen_coordinates_carry_bit_for_bit(self, rng):
+        """The orchestrator's plan can pin converged coordinates: a
+        warm-started retrain with freeze= must carry them bit-for-bit
+        and never emit update records for them."""
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=8, rows_per_user=10
+        )
+        m1, _ = build_game(data, n_users).run(num_iterations=2, seed=3)
+        m2, hist = build_game(data, n_users).run(
+            num_iterations=2, seed=5, initial_model=m1,
+            freeze=["fixed"],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m2.params["fixed"]), np.asarray(m1.params["fixed"])
+        )
+        assert hist and all(h.coordinate == "per-user" for h in hist)
+        # the unfrozen coordinate actually moved
+        assert not np.array_equal(
+            np.asarray(m2.params["per-user"]),
+            np.asarray(m1.params["per-user"]),
+        )
+
+    def test_freeze_validation(self, rng):
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=4, rows_per_user=6
+        )
+        with pytest.raises(ValueError, match="unknown coordinates"):
+            build_game(data, n_users).run(
+                num_iterations=1, freeze=["nope"]
+            )
+        with pytest.raises(ValueError, match="every coordinate"):
+            build_game(data, n_users).run(
+                num_iterations=1, freeze=["fixed", "per-user"]
+            )
+
+
+# ---------------------------------------------------------------------------
+# warm-started lambda path (rides the PR-8 scan path)
+# ---------------------------------------------------------------------------
+
+
+class TestLambdaPath:
+    def test_scan_equals_loop(self, rng):
+        """scan=True (one dispatch per combo segment) and scan=False
+        (per-update dispatches) are the same algorithm: identical
+        params, objectives, and history along the whole path."""
+        from photon_ml_tpu.game.descent import run_lambda_path
+
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=8, rows_per_user=10
+        )
+        combos = [
+            {"fixed": 2.0, "per-user": 4.0},
+            {"fixed": 0.5, "per-user": 1.0},
+        ]
+        m_scan, h_scan = run_lambda_path(
+            build_game(data, n_users), combos, num_iterations=2,
+            seed=3, scan=True,
+        )
+        m_loop, h_loop = run_lambda_path(
+            build_game(data, n_users), combos, num_iterations=2,
+            seed=3, scan=False,
+        )
+        assert len(m_scan) == len(m_loop) == 2
+        for ms, ml in zip(m_scan, m_loop):
+            for k in ms.params:
+                np.testing.assert_allclose(
+                    np.asarray(ms.params[k]), np.asarray(ml.params[k]),
+                    atol=1e-10,
+                )
+        for hs, hl in zip(h_scan, h_loop):
+            assert [r.coordinate for r in hs] == [
+                r.coordinate for r in hl
+            ]
+            np.testing.assert_allclose(
+                [r.objective for r in hs],
+                [r.objective for r in hl],
+                rtol=1e-10,
+            )
+
+    def test_path_warm_starts_each_segment(self, rng):
+        """Combo c+1 starts from combo c's solution: rerunning the LAST
+        combo alone from the path's second-to-last model reproduces the
+        path's final model exactly."""
+        from photon_ml_tpu.game.descent import run_lambda_path
+
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=8, rows_per_user=10
+        )
+        combos = [
+            {"fixed": 2.0, "per-user": 4.0},
+            {"fixed": 0.5, "per-user": 1.0},
+        ]
+        models, _ = run_lambda_path(
+            build_game(data, n_users), combos, num_iterations=2, seed=3
+        )
+        resumed, _ = run_lambda_path(
+            build_game(data, n_users), combos[1:], num_iterations=2,
+            seed=3, initial_model=models[0],
+        )
+        for k in models[-1].params:
+            np.testing.assert_allclose(
+                np.asarray(models[-1].params[k]),
+                np.asarray(resumed[0].params[k]),
+                atol=1e-12,
+            )
+
+    def test_initial_model_rejects_positional_shape_mismatch(self, rng):
+        """The PR-4 lesson, enforced at the API edge: a warm start whose
+        entity table shape disagrees must raise (re-key by entity id
+        first), never silently align by position."""
+        from photon_ml_tpu.game.descent import GameModel, run_lambda_path
+
+        data, _, n_users = make_mixed_effects_data(
+            rng, n_users=8, rows_per_user=10
+        )
+        cd = build_game(data, n_users)
+        bad = GameModel({
+            "fixed": np.zeros(5),
+            "per-user": np.zeros((n_users + 3, 3)),
+        })
+        with pytest.raises(ValueError, match="re-key by entity id"):
+            run_lambda_path(
+                cd,
+                [{"fixed": 1.0, "per-user": 1.0}],
+                num_iterations=1,
+                initial_model=bad,
+            )
+
+
+# ---------------------------------------------------------------------------
+# export <-> warm start roundtrip
+# ---------------------------------------------------------------------------
+
+
+class TestWarmStartRoundtrip:
+    def test_export_then_load_preserves_entity_keys(self, rng, tmp_path):
+        root = _export(
+            str(tmp_path / "v0001"), rng, users=("zeta", "alpha", "mid")
+        )
+        params, shards, res, shard_vocabs, re_vocabs = load_warm_start(
+            root
+        )
+        assert set(re_vocabs["userId"]) == {"zeta", "alpha", "mid"}
+        assert res["per-user"] == "userId"
+        assert np.asarray(params["per-user"]).shape[0] == 3
+
+    def test_lifecycle_drill_is_registered(self):
+        from photon_ml_tpu.resilience.drills import DRILLS
+
+        assert "lifecycle" in DRILLS

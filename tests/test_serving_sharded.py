@@ -713,7 +713,7 @@ class TestObservabilityWiring:
         assert snap["resident_re_bytes_per_process"] > 0
         assert set(snap["cache"]) == {
             "hits", "misses", "promotions", "demotions", "tier_errors",
-            "hit_frac",
+            "hit_frac", "admission_logged", "admission_promoted",
         }
         assert snap["shards"], "per-shard occupancy must be recorded"
         for info in snap["shards"].values():
