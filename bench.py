@@ -2052,6 +2052,51 @@ def _serving_sharded_cpu():
     return _cpu_subprocess("--serving-sharded", "serving sharded")
 
 
+def bench_frontend():
+    """Production serving fabric (docs/FRONTEND.md): T tenants x R
+    replicas behind the async multiplexed front end, driven closed-loop
+    over real sockets, vs the single-connection old-protocol baseline
+    on the SAME hardware. Sentinel-tracked: ``frontend_qps`` (higher —
+    the multiplexing + shared-queue win must hold),
+    ``tenant_p99_ms.<t>`` (lower — per-tenant tail under the shared
+    admission queue) and ``replica_failover_s`` (lower — wall from a
+    replica dying mid-batch to the next replica's answer). The hard
+    invariant — ZERO lost requests across the mid-run whole-replica
+    kill — is asserted here and by the ``replica_loss`` chaos drill."""
+    from benchmarks import serving_lab
+
+    rec = serving_lab.run([
+        "--frontend", "--clients", "8", "--requests", "2000",
+        "--baseline-requests", "200", "--tenants", "2",
+        "--frontend-replicas", "2", "--zipf-alpha", "1.1",
+    ])
+    ex = rec["extra"]
+    assert ex["lost_requests"] == 0, (
+        f"front end lost {ex['lost_requests']} requests across the "
+        "replica kill — failover must answer every accepted request"
+    )
+    out = {
+        "frontend_qps": ex["frontend_qps"],
+        "single_conn_qps": ex["single_conn_qps"],
+        "frontend_vs_single_conn": rec["vs_baseline"],
+        "frontend_p99_ms": ex["p99_ms"],
+        "tenant_p99_ms": ex["tenant_p99_ms"],
+        "replica_failover_s": ex["replica_failover_s"],
+        "lost_requests": ex["lost_requests"],
+        "steady_state_compiles": ex["steady_state_compiles"],
+        "shared_compile_hits": ex["shared_compile_hits"],
+        "shared_compiles": ex["shared_compiles"],
+    }
+    log(
+        f"frontend: {out['frontend_qps']} qps multiplexed vs "
+        f"{out['single_conn_qps']} qps single-conn "
+        f"({out['frontend_vs_single_conn']}x), failover "
+        f"{out['replica_failover_s']}s, {out['lost_requests']} lost, "
+        f"{out['shared_compile_hits']} shared-ladder hits"
+    )
+    return out
+
+
 def bench_multihost_resilience():
     """Elastic multi-host resilience (docs/MULTIHOST.md), measured on
     the single-process emulation path. Sentinel-tracked:
@@ -2510,6 +2555,7 @@ def main():
     ingest_pipe = _phase("ingest_pipeline", bench_ingest_pipeline)
     overload = _phase("serving_overload", bench_overload)
     serving_sharded = _phase("serving_sharded", _serving_sharded_cpu)
+    frontend = _phase("frontend", bench_frontend)
     multihost_res = _phase(
         "multihost_resilience", bench_multihost_resilience
     )
@@ -2655,6 +2701,13 @@ def main():
         # fraction, and the per-process resident RE footprint (sentinel:
         # _qps/hit_frac higher, resident bytes lower)
         extra["serving_sharded"] = serving_sharded
+    if frontend:
+        # production serving fabric (docs/FRONTEND.md): multiplexed
+        # front-end throughput vs the single-connection old protocol,
+        # per-tenant tails under the shared queue, and the router's
+        # whole-replica failover wall (sentinel: frontend_qps higher,
+        # tenant_p99_ms.* lower, replica_failover_s lower)
+        extra["frontend"] = frontend
     if multihost_res:
         # elastic multi-host resilience (docs/MULTIHOST.md): sharded
         # checkpoint write bandwidth + watchdogged collective recovery

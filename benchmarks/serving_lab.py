@@ -56,6 +56,7 @@ def build_synthetic_engine(
     dtype=None,
     serving_shards=1,
     hbm_cache_entities=None,
+    compile_cache=None,
 ):
     """In-memory model: 'global' fixed effect over shard 'g', 'per-user'
     random effect and 'fact' factored coordinate over shard 'u'. With
@@ -93,6 +94,11 @@ def build_synthetic_engine(
         shard_vocabs={"g": g_vocab, "u": u_vocab},
         re_vocabs={"userId": re_vocab},
         **({"dtype": dtype} if dtype is not None else {}),
+        **(
+            {"compile_cache": compile_cache}
+            if compile_cache is not None
+            else {}
+        ),
     )
     if serving_shards > 1:
         return ShardedScoringEngine(
@@ -143,6 +149,242 @@ def _window_hit_frac(before: dict, after: dict) -> float:
     return round(hits / total, 6) if total else 0.0
 
 
+def _run_frontend(args) -> dict:
+    """Closed loop against the PRODUCTION FABRIC (docs/FRONTEND.md):
+    T tenants x R replicas behind the async multiplexing front end, all
+    engines sharing one AOT compile ladder, clients speaking the wire
+    protocol over real sockets. The baseline is the SAME hardware
+    driven the pre-fabric way: one connection, one request at a time,
+    through the original cli/serve.py JSON-lines protocol — the number
+    ``vs_baseline`` is the multiplexing + shared-queue win. With R > 1,
+    tenant0's replica 0 is KILLED mid-run; every request must still
+    answer (``lost_requests`` == 0) and ``replica_failover_s`` records
+    the router's blast-radius clock."""
+    import socket as socket_mod
+    import socketserver
+
+    from photon_ml_tpu.cli.serve import serve_lines
+    from photon_ml_tpu.frontend import (
+        FrontendClient,
+        FrontendServer,
+        ReplicaRouter,
+        TenantManager,
+    )
+    from photon_ml_tpu.serving.batcher import MicroBatcher
+    from photon_ml_tpu.serving.engine import SharedCompileCache
+    from photon_ml_tpu.serving.stats import xla_compile_events
+
+    rng = np.random.default_rng(20260804)
+    d_fixed, d_user, n_users = (32, 8, 128) if args.smoke else (64, 16, 512)
+    R = args.frontend_replicas
+    cache = SharedCompileCache()
+    engines = {}  # (tenant_i, replica_i) -> engine
+    for t in range(args.tenants):
+        for r in range(R):
+            engines[(t, r)] = build_synthetic_engine(
+                rng, d_fixed, d_user, n_users, compile_cache=cache
+            )
+    compiles_before = xla_compile_events()
+    for eng in engines.values():
+        eng.warmup(max_batch=args.max_batch)
+    warmup_compiles = xla_compile_events() - compiles_before
+
+    probs = (
+        zipf_probs(n_users, args.zipf_alpha) if args.zipf_alpha else None
+    )
+    reqs = [
+        make_request(rng, d_fixed, d_user, n_users, entity_probs=probs)
+        for _ in range(max(args.requests, args.baseline_requests))
+    ]
+
+    # -- baseline: ONE connection, old protocol, one request in flight -----
+    base_batcher = MicroBatcher(
+        engines[(0, 0)].score,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=4096,
+    )
+
+    class _Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            lines = (raw.decode("utf-8") for raw in self.rfile)
+
+            class _W:
+                def write(inner, s):
+                    self.wfile.write(s.encode("utf-8"))
+
+                def flush(inner):
+                    pass
+
+            serve_lines(lines, _W(), base_batcher)
+
+    class _Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    base_srv = _Srv(("127.0.0.1", 0), _Handler)
+    threading.Thread(target=base_srv.serve_forever, daemon=True).start()
+    sock = socket_mod.create_connection(base_srv.server_address, timeout=60)
+    rw = sock.makefile("rwb")
+    t0 = time.perf_counter()
+    for r in reqs[: args.baseline_requests]:
+        rw.write(
+            (
+                json.dumps(
+                    {"features": r.features, "entities": r.entities}
+                )
+                + "\n"
+            ).encode()
+        )
+        rw.flush()
+        reply = json.loads(rw.readline())
+        assert "score" in reply, reply
+    single_conn_qps = args.baseline_requests / (time.perf_counter() - t0)
+    rw.close()
+    sock.close()
+    base_srv.shutdown()
+    base_srv.server_close()
+    base_batcher.drain()
+
+    # -- the fabric: tenants x replicas behind the front end ----------------
+    kill_r0 = threading.Event()
+
+    def replica_score(eng, is_victim):
+        def f(batch, _eng=eng, _v=is_victim):
+            if _v and kill_r0.is_set():
+                raise OSError("replica killed (serving_lab fault)")
+            return _eng.score(batch)
+
+        return f
+
+    tm = TenantManager(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=4 * args.requests,
+    )
+    routers = {}
+    for t in range(args.tenants):
+        name = f"tenant{t}"
+        if R > 1:
+            routers[name] = ReplicaRouter(
+                [
+                    (
+                        f"{name}/r{r}",
+                        replica_score(
+                            engines[(t, r)], t == 0 and r == 0
+                        ),
+                    )
+                    for r in range(R)
+                ],
+                failure_threshold=2,
+                backoff_s=30.0,  # stays down for the rest of the run
+            )
+            scorer = routers[name].score
+        else:
+            scorer = engines[(t, 0)].score
+        tm.add_tenant(name, scorer, priority=t % 3)
+    srv = FrontendServer(tm.submit, port=0, default_tenant="tenant0")
+    srv.start()
+
+    per_client = args.requests // args.clients
+    latencies = [[] for _ in range(args.clients)]
+    errors = [0] * args.clients
+    completed = [0]
+    clock = threading.Lock()
+    steady_before = xla_compile_events()
+
+    def client(ci: int) -> None:
+        tenant = f"tenant{ci % args.tenants}"
+        lo = ci * per_client
+        with FrontendClient("127.0.0.1", srv.port, timeout=120) as c:
+            for r in reqs[lo: lo + per_client]:
+                t0 = time.perf_counter()
+                reply = c.call(
+                    {
+                        "tenant": tenant,
+                        "features": r.features,
+                        "entities": r.entities,
+                    }
+                )
+                latencies[ci].append((time.perf_counter() - t0) * 1e3)
+                if "score" not in reply:
+                    errors[ci] += 1
+                with clock:
+                    completed[0] += 1
+                    # mid-run whole-replica loss: every request after
+                    # this point must fail over, none may be lost
+                    if R > 1 and completed[0] == args.requests // 2:
+                        kill_r0.set()
+
+    threads = [
+        threading.Thread(target=client, args=(ci,))
+        for ci in range(args.clients)
+    ]
+    t_start = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t_start
+    steady_compiles = xla_compile_events() - steady_before
+    srv.stop()
+    tm.drain()
+
+    lat = np.concatenate([np.asarray(c) for c in latencies])
+    qps = lat.size / wall
+    lost = int(sum(errors))
+    tenant_p99 = {}
+    for t in range(args.tenants):
+        t_lat = np.concatenate(
+            [
+                np.asarray(latencies[ci])
+                for ci in range(t, args.clients, args.tenants)
+            ]
+        )
+        tenant_p99[f"tenant{t}"] = round(
+            float(np.percentile(t_lat, 99)), 4
+        )
+    failover_s = (
+        routers["tenant0"].last_failover_s if routers else None
+    )
+    record = {
+        "metric": "frontend_qps",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / single_conn_qps, 3)
+        if single_conn_qps > 0
+        else None,
+        "extra": {
+            "clients": args.clients,
+            "tenants": args.tenants,
+            "replicas": R,
+            "requests": int(lat.size),
+            "frontend_qps": round(qps, 1),
+            "single_conn_qps": round(single_conn_qps, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)), 4),
+            "p99_ms": round(float(np.percentile(lat, 99)), 4),
+            "tenant_p99_ms": tenant_p99,
+            "tenant_slo": tm.slo_snapshot(),
+            "lost_requests": lost,
+            "replica_failover_s": (
+                round(failover_s, 6) if failover_s is not None else None
+            ),
+            "replica_health": (
+                routers["tenant0"].health() if routers else None
+            ),
+            "warmup_compiles": warmup_compiles,
+            "steady_state_compiles": steady_compiles,
+            "shared_compile_hits": cache.hits,
+            "shared_compiles": cache.compiles,
+            "smoke": bool(args.smoke),
+        },
+    }
+    for eng in engines.values():
+        eng.close()
+    print(json.dumps(record))
+    return record
+
+
 def run(argv=None) -> dict:
     p = argparse.ArgumentParser(prog="benchmarks/serving_lab.py")
     p.add_argument("--clients", type=int, default=16)
@@ -164,6 +406,15 @@ def run(argv=None) -> dict:
     p.add_argument("--hbm-cache-entities", type=int, default=None,
                    help="serve through the tiered HBM/host entity cache "
                    "with this hot-head capacity")
+    p.add_argument("--frontend", action="store_true",
+                   help="drive the production fabric (async front end, "
+                   "multi-tenant engine, replicated shard groups) over "
+                   "real sockets vs a single-connection old-protocol "
+                   "baseline (docs/FRONTEND.md)")
+    p.add_argument("--frontend-replicas", type=int, default=2,
+                   help="engine replicas per tenant in --frontend mode; "
+                   "with > 1 a replica is killed mid-run to clock "
+                   "failover")
     p.add_argument("--smoke", action="store_true",
                    help="tiny CPU-safe configuration")
     args = p.parse_args(argv)
@@ -173,6 +424,8 @@ def run(argv=None) -> dict:
         args.baseline_requests = min(args.baseline_requests, 50)
     if args.tenants < 1 or args.clients % args.tenants:
         p.error("--tenants must divide --clients")
+    if args.frontend:
+        return _run_frontend(args)
 
     from photon_ml_tpu.serving.batcher import MicroBatcher
     from photon_ml_tpu.serving.stats import xla_compile_events

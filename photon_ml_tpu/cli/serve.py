@@ -10,6 +10,21 @@ or as a TCP socket server (one JSON object per line per connection):
 
     python -m photon_ml_tpu.cli.serve --model-dir out/game --socket 7474
 
+or behind the production front end — async multiplexed connections,
+multi-tenant admission, optional engine replication (docs/FRONTEND.md):
+
+    python -m photon_ml_tpu.cli.serve --model-dir out/game \\
+        --frontend-port 7575 --replicas 2 \\
+        --tenant '{"name": "gold", "priority": 2, "quota": 256}' \\
+        --tenant '{"name": "free", "priority": 0, "quota": 64}'
+
+In frontend mode this protocol is the COMPAT ADMIN CHANNEL: the same
+``{"cmd": ...}`` commands answer on stdin/--socket AND as passthrough
+frames on the front end itself, plus ``{"cmd": "tenants"}`` (per-tenant
+policy/accounting/SLO) and ``{"cmd": "replicas"}`` (per-replica
+breaker/failover state); scoring lines on the compat channel ride the
+shared tenant queue under the default tenant's policy.
+
 Protocol (one JSON object per line):
 
     {"features": {"age": 0.7, "ctr\\u0001day7": 1.2},
@@ -92,6 +107,106 @@ def build_request(obj: dict) -> ScoreRequest:
     )
 
 
+def make_admin_handler(
+    batcher,
+    registry: Optional[ModelRegistry] = None,
+    stats: Optional[ServingStats] = None,
+    quality=None,
+    tenants=None,
+    replicas=None,
+):
+    """One ``{"cmd": ...} -> dict`` dispatcher shared by every channel:
+    the original JSON-lines protocol (stdin and ``--socket``) and the
+    async front end's admin passthrough — the old protocol IS the compat
+    admin channel, so an operator's runbook works against either port.
+    ``tenants`` (a :class:`~photon_ml_tpu.frontend.tenants.
+    TenantManager`) adds ``{"cmd": "tenants"}``: per-tenant policy/
+    accounting/SLO + the shared queue and compile ladder; ``replicas``
+    (``{tenant: ReplicaRouter}``) adds ``{"cmd": "replicas"}``: per-
+    replica breaker/outstanding/failover state."""
+
+    def handle(obj: dict) -> dict:
+        cmd = obj.get("cmd")
+        try:
+            if cmd == "stats":
+                return (stats or batcher.stats).snapshot()
+            if cmd == "metrics":
+                # Prometheus text exposition of the serving registry
+                # PLUS the process-default registry (solver/io/
+                # resilience counters), so one scrape sees the whole
+                # process (docs/OBSERVABILITY.md)
+                from photon_ml_tpu import obs
+
+                st = stats or batcher.stats
+                text = st.registry.to_prometheus()
+                if st.registry is not obs.registry():
+                    text += obs.registry().to_prometheus()
+                return {"prometheus": text}
+            if cmd == "slo":
+                slo = getattr(batcher, "slo", None)
+                if slo is None:
+                    return {"error": "no SLO tracker configured"}
+                return slo.snapshot()
+            if cmd == "health":
+                # breaker/shed/queue state in one reply — the
+                # orchestration probe (readiness, alerting)
+                health = dict(batcher.health())
+                if registry is not None:
+                    health.update(registry.health())
+                return health
+            if cmd == "tenants":
+                if tenants is None:
+                    return {"error": "not serving multi-tenant"}
+                return tenants.snapshot()
+            if cmd == "replicas":
+                if not replicas:
+                    return {"error": "not serving replicated"}
+                return {
+                    name: router.health()
+                    for name, router in replicas.items()
+                }
+            if cmd == "feedback":
+                # delayed-label loop (docs/OBSERVABILITY.md "Quality &
+                # drift"): the client echoes the served score once the
+                # true label arrives
+                if quality is None:
+                    return {"error": "no online-quality tracker"}
+                quality.record(
+                    float(obj["label"]),
+                    float(obj["score"]),
+                    float(obj.get("weight", 1.0)),
+                )
+                return {"ok": True, "window_n": quality.window_n}
+            if cmd == "quality":
+                if quality is None:
+                    return {"error": "no online-quality tracker"}
+                return quality.snapshot()
+            if cmd == "drift":
+                v = registry.current if registry is not None else None
+                monitor = (
+                    getattr(v.engine, "drift", None)
+                    if v is not None and v.engine is not None
+                    else None
+                )
+                if monitor is None:
+                    return {
+                        "error": "no drift monitor (export has no "
+                        "quality fingerprint)"
+                    }
+                return monitor.snapshot()
+            if cmd == "version":
+                return {"version": registry.version()}
+            if cmd == "reload":
+                # operator-explicit: bypass breaker quarantine
+                v = registry.load(obj["path"], force=True)
+                return {"reloaded": v.version_id}
+            return {"error": f"unknown cmd {cmd!r}"}
+        except Exception as e:  # noqa: BLE001 — keep serving
+            return {"error": str(e)}
+
+    return handle
+
+
 def serve_lines(
     lines,
     out,
@@ -102,6 +217,8 @@ def serve_lines(
     window: int = 128,
     default_deadline_ms: Optional[float] = None,
     quality=None,
+    tenants=None,
+    replicas=None,
 ) -> int:
     """Pump a JSON-lines stream through the batcher, writing one response
     line per request IN ORDER. A dedicated writer thread emits each
@@ -145,6 +262,11 @@ def serve_lines(
     def reply_now(obj: dict) -> None:
         outbox.put(("line", json.dumps(obj)))
 
+    handle_cmd = make_admin_handler(
+        batcher, registry, stats, quality=quality, tenants=tenants,
+        replicas=replicas,
+    )
+
     try:
         for line in lines:
             if shutdown is not None and shutdown.requested:
@@ -159,93 +281,7 @@ def serve_lines(
                 continue
             cmd = obj.get("cmd") if isinstance(obj, dict) else None
             if cmd is not None:
-                try:
-                    if cmd == "stats":
-                        reply_now((stats or batcher.stats).snapshot())
-                    elif cmd == "metrics":
-                        # Prometheus text exposition of the serving
-                        # registry PLUS the process-default registry
-                        # (solver/io/resilience counters), so one scrape
-                        # sees the whole process (docs/OBSERVABILITY.md)
-                        from photon_ml_tpu import obs
-
-                        st = stats or batcher.stats
-                        text = st.registry.to_prometheus()
-                        if st.registry is not obs.registry():
-                            text += obs.registry().to_prometheus()
-                        reply_now({"prometheus": text})
-                    elif cmd == "slo":
-                        slo = getattr(batcher, "slo", None)
-                        if slo is None:
-                            reply_now(
-                                {"error": "no SLO tracker configured"}
-                            )
-                        else:
-                            reply_now(slo.snapshot())
-                    elif cmd == "health":
-                        # breaker/shed/queue state in one reply — the
-                        # orchestration probe (readiness, alerting)
-                        health = dict(batcher.health())
-                        if registry is not None:
-                            health.update(registry.health())
-                        reply_now(health)
-                    elif cmd == "feedback":
-                        # delayed-label loop (docs/OBSERVABILITY.md
-                        # "Quality & drift"): the client echoes the
-                        # served score once the true label arrives
-                        if quality is None:
-                            reply_now(
-                                {"error": "no online-quality tracker"}
-                            )
-                        else:
-                            quality.record(
-                                float(obj["label"]),
-                                float(obj["score"]),
-                                float(obj.get("weight", 1.0)),
-                            )
-                            reply_now(
-                                {
-                                    "ok": True,
-                                    "window_n": quality.window_n,
-                                }
-                            )
-                    elif cmd == "quality":
-                        if quality is None:
-                            reply_now(
-                                {"error": "no online-quality tracker"}
-                            )
-                        else:
-                            reply_now(quality.snapshot())
-                    elif cmd == "drift":
-                        v = (
-                            registry.current
-                            if registry is not None
-                            else None
-                        )
-                        monitor = (
-                            getattr(v.engine, "drift", None)
-                            if v is not None and v.engine is not None
-                            else None
-                        )
-                        if monitor is None:
-                            reply_now(
-                                {
-                                    "error": "no drift monitor (export "
-                                    "has no quality fingerprint)"
-                                }
-                            )
-                        else:
-                            reply_now(monitor.snapshot())
-                    elif cmd == "version":
-                        reply_now({"version": registry.version()})
-                    elif cmd == "reload":
-                        # operator-explicit: bypass breaker quarantine
-                        v = registry.load(obj["path"], force=True)
-                        reply_now({"reloaded": v.version_id})
-                    else:
-                        reply_now({"error": f"unknown cmd {cmd!r}"})
-                except Exception as e:  # noqa: BLE001 — keep serving
-                    reply_now({"error": str(e)})
+                reply_now(handle_cmd(obj))
                 continue
             try:
                 deadline_ms = obj.get("deadline_ms", default_deadline_ms)
@@ -271,6 +307,37 @@ def serve_lines(
     return scored[0]
 
 
+class _CompatBatcher:
+    """Batcher-shaped adapter over a TenantManager: the old per-line
+    protocol (stdin / ``--socket``) keeps scoring in frontend mode, but
+    through the SHARED tenant queue under ``tenant``'s policy — one
+    admission control for both channels, not a side door around it."""
+
+    def __init__(self, tm, tenant: str):
+        self._tm = tm
+        self.tenant = tenant
+        self.stats = tm.stats
+        self.slo = tm.batcher.slo
+
+    def submit(self, request, *, deadline_ms=None, priority=None):
+        return self._tm.submit(
+            self.tenant, request,
+            deadline_ms=deadline_ms, priority=priority,
+        )
+
+    def health(self):
+        return self._tm.batcher.health()
+
+    def queue_depth(self):
+        return self._tm.batcher.queue_depth()
+
+    def begin_drain(self):
+        self._tm.begin_drain()
+
+    def drain(self, timeout=30.0):
+        return self._tm.drain(timeout)
+
+
 def _watch_loop(registry, watch_root, poll_s, shutdown, logger):
     while not shutdown.requested:
         try:
@@ -285,7 +352,7 @@ def _watch_loop(registry, watch_root, poll_s, shutdown, logger):
 
 def _serve_socket(
     port, batcher, registry, stats, shutdown, logger,
-    default_deadline_ms=None, quality=None,
+    default_deadline_ms=None, quality=None, tenants=None, replicas=None,
 ):
     import socketserver
 
@@ -303,7 +370,7 @@ def _serve_socket(
             serve_lines(
                 lines, _W(), batcher, registry, stats, shutdown=shutdown,
                 default_deadline_ms=default_deadline_ms,
-                quality=quality,
+                quality=quality, tenants=tenants, replicas=replicas,
             )
 
     class Server(socketserver.ThreadingTCPServer):
@@ -392,6 +459,31 @@ def main(argv=None) -> None:
         "orchestrator (photon-retrain) promotes repeat-missed entities "
         "into the next training set (docs/LIFECYCLE.md)",
     )
+    p.add_argument(
+        "--frontend-port", type=int, default=None,
+        help="start the async multiplexing front end on this port "
+        "(0 = ephemeral; the bound port is logged). The old JSON-lines "
+        "protocol stays available — stdin/--socket and {'cmd': ...} "
+        "frames on the front end itself are the compat admin channel "
+        "(docs/FRONTEND.md)",
+    )
+    p.add_argument(
+        "--replicas", type=int, default=1,
+        help="serve each tenant through N engine replicas behind a "
+        "least-outstanding-requests router with per-replica breakers "
+        "and whole-replica failover (requires --frontend-port)",
+    )
+    p.add_argument(
+        "--tenant", action="append", default=None, metavar="JSON",
+        help="register one tenant (repeatable; requires "
+        "--frontend-port): a JSON object like "
+        '\'{"name": "gold", "model_dir": "out/game", "priority": 2, '
+        '"deadline_ms": 50, "quota": 256, "p99_ms": 10}\'. '
+        "model_dir defaults to --model-dir (same-shaped tenants share "
+        "the AOT ladder via the process compile cache); the first "
+        "tenant is the default for frames that name none. Without "
+        "--tenant, one tenant 'default' serves --model-dir.",
+    )
     p.add_argument("--stats-json", help="dump a stats snapshot here on exit")
     args = p.parse_args(argv)
     if args.serving_shards > 1 and args.hbm_cache_entities:
@@ -399,6 +491,21 @@ def main(argv=None) -> None:
             "--hbm-cache-entities composes with the unsharded engine; "
             "on a sharded mesh each shard's slice is the resident set"
         )
+    if args.frontend_port is None and (args.tenant or args.replicas != 1):
+        p.error("--tenant and --replicas require --frontend-port")
+    if args.replicas < 1:
+        p.error("--replicas must be >= 1")
+    tenant_specs = []
+    for raw in args.tenant or []:
+        try:
+            spec = json.loads(raw)
+            if not isinstance(spec, dict) or "name" not in spec:
+                raise ValueError("need a JSON object with 'name'")
+        except ValueError as e:
+            p.error(f"bad --tenant {raw!r}: {e}")
+        tenant_specs.append(spec)
+    if args.frontend_port is not None and not tenant_specs:
+        tenant_specs = [{"name": "default"}]
     # after parse_args: --help / bad flags must not initialize the backend
     import jax.numpy as jnp
 
@@ -408,28 +515,43 @@ def main(argv=None) -> None:
     enable_compilation_cache()
     logger = PhotonLogger(None)
     stats = ServingStats()
-    registry = ModelRegistry(
-        verify=not args.no_verify_manifest,
-        warmup_max_batch=args.max_batch,
-        warmup_degraded=not args.no_degrade,
-        breaker_threshold=args.breaker_threshold,
-        breaker_backoff_s=args.breaker_backoff_s,
-        stats=stats,
-        logger=logger,
-        dtype={"float32": jnp.float32, "float64": jnp.float64}[args.dtype],
-        min_bucket=args.min_bucket,
-        serving_shards=args.serving_shards,
-        **(
-            {"hbm_cache_entities": args.hbm_cache_entities}
-            if args.hbm_cache_entities
-            else {}
-        ),
-        **(
-            {"admission_log_path": args.admission_log}
-            if args.admission_log
-            else {}
-        ),
-    )
+    engine_extra = {}
+    if args.frontend_port is not None:
+        # frontend mode: every engine (all tenants, all replicas) shares
+        # the process-wide AOT bucket-executable ladder — N same-shaped
+        # models pay ONE warmup (docs/FRONTEND.md)
+        from photon_ml_tpu.frontend.tenants import process_compile_cache
+
+        engine_extra["compile_cache"] = process_compile_cache()
+
+    def make_registry() -> ModelRegistry:
+        return ModelRegistry(
+            verify=not args.no_verify_manifest,
+            warmup_max_batch=args.max_batch,
+            warmup_degraded=not args.no_degrade,
+            breaker_threshold=args.breaker_threshold,
+            breaker_backoff_s=args.breaker_backoff_s,
+            stats=stats,
+            logger=logger,
+            dtype={
+                "float32": jnp.float32, "float64": jnp.float64
+            }[args.dtype],
+            min_bucket=args.min_bucket,
+            serving_shards=args.serving_shards,
+            **engine_extra,
+            **(
+                {"hbm_cache_entities": args.hbm_cache_entities}
+                if args.hbm_cache_entities
+                else {}
+            ),
+            **(
+                {"admission_log_path": args.admission_log}
+                if args.admission_log
+                else {}
+            ),
+        )
+
+    registry = make_registry()
     registry.load(args.model_dir)
     slo = SloTracker(
         target_p99_ms=args.slo_p99_ms,
@@ -442,17 +564,68 @@ def main(argv=None) -> None:
     from photon_ml_tpu.obs.quality import OnlineQuality
 
     quality = OnlineQuality(registry=stats.registry)
-    batcher = MicroBatcher(
-        registry.score,
-        max_batch=args.max_batch,
-        max_wait_ms=args.max_wait_ms,
-        queue_depth=args.queue_depth,
-        stats=stats,
-        slo=slo,
-        degraded_score_fn=(
-            None if args.no_degrade else registry.score_fixed_only
-        ),
-    )
+    tm = None
+    routers = {}
+    frontend = None
+    if args.frontend_port is not None:
+        from photon_ml_tpu.frontend import (
+            FrontendServer,
+            ReplicaRouter,
+            TenantManager,
+        )
+
+        tm = TenantManager(
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+            stats=stats,
+            slo=slo,
+        )
+        primary_used = False
+        for spec in tenant_specs:
+            name = str(spec["name"])
+            mdir = spec.get("model_dir", args.model_dir)
+            regs = []
+            for r in range(args.replicas):
+                if mdir == args.model_dir and not primary_used:
+                    reg = registry  # replica 0: the already-loaded one
+                    primary_used = True
+                else:
+                    reg = make_registry()
+                    reg.load(mdir)
+                regs.append(reg)
+            if len(regs) == 1:
+                scorer = regs[0]  # keeps the registry on TenantState
+            else:
+                router = ReplicaRouter(
+                    [(f"{name}/r{i}", rg.score) for i, rg in
+                     enumerate(regs)],
+                )
+                routers[name] = router
+                scorer = router.score
+            tm.add_tenant(
+                name, scorer,
+                deadline_ms=spec.get(
+                    "deadline_ms", args.default_deadline_ms
+                ),
+                priority=int(spec.get("priority", 0)),
+                max_outstanding=spec.get("quota"),
+                target_p99_ms=float(spec.get("p99_ms", args.slo_p99_ms)),
+            )
+        default_tenant = str(tenant_specs[0]["name"])
+        batcher = _CompatBatcher(tm, default_tenant)
+    else:
+        batcher = MicroBatcher(
+            registry.score,
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+            stats=stats,
+            slo=slo,
+            degraded_score_fn=(
+                None if args.no_degrade else registry.score_fixed_only
+            ),
+        )
     shutdown = GracefulShutdown(logger).install()
     shutdown.register_drain(batcher.begin_drain)
     if args.watch_root:
@@ -462,12 +635,33 @@ def main(argv=None) -> None:
             daemon=True,
         ).start()
     try:
+        if tm is not None:
+            frontend = FrontendServer(
+                tm.submit,
+                port=args.frontend_port,
+                admin_fn=make_admin_handler(
+                    batcher, registry, stats, quality=quality,
+                    tenants=tm, replicas=routers or None,
+                ),
+                default_tenant=default_tenant,
+            )
+            frontend.start()
+            logger.info(
+                f"frontend on 127.0.0.1:{frontend.port} "
+                f"({len(tenant_specs)} tenant(s), "
+                f"{args.replicas} replica(s))"
+            )
         if args.socket:
             _serve_socket(
                 args.socket, batcher, registry, stats, shutdown, logger,
                 default_deadline_ms=args.default_deadline_ms,
-                quality=quality,
+                quality=quality, tenants=tm, replicas=routers or None,
             )
+        elif tm is not None:
+            # frontend is the data plane; no stdin pump — park until
+            # SIGTERM/SIGINT (the compat channel is --socket or the
+            # front end's own {"cmd": ...} passthrough)
+            shutdown._event.wait()
         else:
             serve_lines(
                 sys.stdin,
@@ -481,6 +675,8 @@ def main(argv=None) -> None:
                 quality=quality,
             )
     finally:
+        if frontend is not None:
+            frontend.stop()
         drained = batcher.drain()
         if args.stats_json:
             stats.dump(args.stats_json)

@@ -190,9 +190,12 @@ def _pick_sync_id(per_shard: Sequence[Dict[str, dict]]) -> Optional[str]:
 
 def _dedupe_key(ev: dict) -> tuple:
     """Identity of one event for duplicate dropping: phase, name, track,
-    window, and (for async/flow phases) the explicit id. Re-read shards
-    and duplicated span ids collapse; distinct same-name spans at
-    different instants survive."""
+    window, (for async/flow phases) the explicit id, and the request id
+    when the span carries one in args. Re-read shards and duplicated
+    span ids collapse; distinct same-name spans at different instants
+    survive — and two replicas' ``serving.request`` spans that happen to
+    share a (pid, tid, ts, dur) window are kept apart by their
+    instance-namespaced request ids instead of being wrongly collapsed."""
     return (
         ev.get("ph"),
         ev.get("name"),
@@ -201,6 +204,7 @@ def _dedupe_key(ev: dict) -> tuple:
         round(float(ev.get("ts", 0.0)), 3),
         round(float(ev.get("dur", 0.0)), 3),
         ev.get("id"),
+        (ev.get("args") or {}).get("request_id"),
     )
 
 

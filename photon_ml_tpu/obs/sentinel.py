@@ -132,6 +132,12 @@ _DIRECTION_RULES = (
     # fleet after a confirmed drift; auc_recovered gates through the
     # generic auc rule below
     (re.compile(r"retrain_cycle_s$"), LOWER_IS_BETTER),
+    # serving fabric (docs/FRONTEND.md, bench_frontend): wall from a
+    # whole-replica loss to the router's first successful failover —
+    # the fleet's blast-radius clock; explicit (like recovery_s) so
+    # the failover contract stays gated independent of the generic
+    # _s rule
+    (re.compile(r"failover_s$"), LOWER_IS_BETTER),
     # photon-lint self-hosting gate (docs/ANALYSIS.md): total findings
     # over the tree — NEW findings already fail the lint itself, so
     # what this tracks is ratchet debt (baselined + suppressed) creep;

@@ -168,6 +168,26 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         "per-stage retry events, retrain_cycle_s gauge, admission "
         "promotions (lifecycle/orchestrator.py, docs/LIFECYCLE.md)",
     ),
+    (
+        "frontend",
+        r"frontend\.[a-z_]+(\..+)?",
+        "async front end: connection/frame/reply counters, rejected "
+        "(RESOURCE_EXHAUSTED answers), bytes in/out "
+        "(frontend/server.py, docs/FRONTEND.md)",
+    ),
+    (
+        "tenant",
+        r"tenant\.[a-z_]+(\..+)?",
+        "multi-tenant engine layer: per-tenant rejected/registered "
+        "counters keyed by tenant name (frontend/tenants.py)",
+    ),
+    (
+        "replica",
+        r"replica\.[a-z_]+(\..+)?",
+        "replica router: per-replica batch/failure counters, "
+        "replica.down events, failover_ms histogram, exhausted "
+        "counter (frontend/replicas.py)",
+    ),
 )
 
 _COMPILED = tuple(

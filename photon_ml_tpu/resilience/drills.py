@@ -1779,6 +1779,157 @@ def drill_lifecycle(smoke: bool = True) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# drill: whole-replica loss under live multi-tenant load (docs/FRONTEND.md)
+# ---------------------------------------------------------------------------
+
+
+def drill_replica_loss(smoke: bool = True) -> dict:
+    """The serving fabric's survival drill: kill one of two engine
+    replicas (``replica.route`` armed raise-mode) while live multi-
+    tenant load flows through the TenantManager's shared admission
+    queue. The router must fail every batch over to the survivor with
+    ZERO lost requests, record the failover, open the dead replica's
+    breaker (no traffic burned on a corpse), keep every tenant's SLO
+    ledger honest (every accepted request lands in a tracker), and —
+    once the fault clears and the backoff elapses — route traffic back
+    to the recovered replica."""
+    import threading
+
+    from photon_ml_tpu.frontend.replicas import ReplicaRouter
+    from photon_ml_tpu.frontend.tenants import TenantManager
+    from photon_ml_tpu.serving.engine import SharedCompileCache
+
+    rng = np.random.default_rng(17)
+    cache = SharedCompileCache()
+    engines = [
+        build_drill_engine(np.random.default_rng(17))
+        for _ in range(2)
+    ]
+    # same-shaped replicas share the AOT ladder: give both the process-
+    # style cache and count the shared hits as part of the drill
+    for e in engines:
+        e._shared_cache = cache
+    calls = {"r0": 0, "r1": 0}
+    clock = threading.Lock()
+
+    def replica_fn(name, engine):
+        def score(reqs):
+            with clock:
+                calls[name] += 1
+            return engine.score(reqs)
+        return score
+
+    router = ReplicaRouter(
+        [(n, replica_fn(n, e)) for n, e in zip(("r0", "r1"), engines)],
+        failure_threshold=2,
+        backoff_s=0.3,
+    )
+    failovers: List[tuple] = []
+    router.on_failover = lambda frm, to, err: failovers.append((frm, to))
+    tm = TenantManager(max_batch=16, max_wait_ms=0.5, queue_depth=4096)
+    tm.add_tenant("gold", router.score, priority=2, max_outstanding=512)
+    tm.add_tenant("free", router.score, priority=0, max_outstanding=512)
+    n_req = 120 if smoke else 600
+    try:
+        # (1) warm both replicas under clean load
+        warm = [
+            tm.submit(
+                "gold" if i % 2 else "free", make_drill_request(rng)
+            )
+            for i in range(32)
+        ]
+        for f in warm:
+            assert np.isfinite(f.result(timeout=30.0))
+        with clock:
+            assert calls["r0"] > 0 and calls["r1"] > 0, (
+                "least-outstanding routing must spread clean load"
+            )
+            before = dict(calls)
+
+        # (2) kill r0 mid-flight: every request still completes
+        futs = []
+        with inject(
+            FaultSpec("replica.route", "raise", nth=1, count=-1, key="r0")
+        ):
+            for i in range(n_req):
+                futs.append(
+                    tm.submit(
+                        "gold" if i % 2 else "free",
+                        make_drill_request(rng),
+                    )
+                )
+            results = [f.result(timeout=60.0) for f in futs]
+        assert all(np.isfinite(r) for r in results), (
+            "requests lost to the replica loss"
+        )
+        assert router.failovers >= 1 and failovers, (
+            "the router must record the failover"
+        )
+        assert router.last_failover_s is not None
+        health = router.health()
+        assert health["replicas"]["r0"]["state"] == "open", (
+            "the dead replica's breaker must open"
+        )
+        assert health["up"] == 1
+
+        # (3) honest per-tenant ledgers: every accepted request is in
+        # its tenant's SLO tracker, none vanished
+        slo = tm.slo_snapshot()
+        counted = sum(s["total_requests"] for s in slo.values())
+        submitted = sum(
+            st.submitted for st in tm.tenants().values()
+        )
+        assert counted == submitted == n_req + 32, (
+            f"SLO ledger counted {counted} of {submitted} accepted"
+        )
+
+        # (4) fault cleared: r0 takes traffic again once its backoff
+        # elapses. The backoff DOUBLES on every failed half-open probe,
+        # and under suite load the fault window in (2) can outlast the
+        # initial 0.3s — burning one or more probes — so a fixed sleep
+        # is a race. Poll with a generous wall-clock deadline instead:
+        # send small waves until the breaker closes and r0's call
+        # counter moves.
+        with clock:
+            r0_at_fault_end = calls["r0"]
+        n_rec = 0
+        deadline = time.monotonic() + 15.0
+        while True:
+            rec = [
+                tm.submit("gold", make_drill_request(rng))
+                for _ in range(8)
+            ]
+            n_rec += len(rec)
+            for f in rec:
+                assert np.isfinite(f.result(timeout=30.0))
+            with clock:
+                rejoined = calls["r0"] > r0_at_fault_end
+            if (
+                rejoined
+                and router.health()["replicas"]["r0"]["state"] == "closed"
+            ):
+                break
+            assert time.monotonic() < deadline, (
+                "recovered replica must rejoin the rotation"
+            )
+            time.sleep(0.1)
+        shared = cache.snapshot()
+        return {
+            "requests": n_req + 32 + n_rec,
+            "failovers": int(router.failovers),
+            "last_failover_s": float(router.last_failover_s),
+            "replica_calls": dict(calls),
+            "shared_compile_hits": int(shared["hits"]),
+            "shared_compiles": int(shared["compiles"]),
+            "tenant_p99_ms": {
+                t: s["p99_ms"] for t, s in tm.slo_snapshot().items()
+            },
+        }
+    finally:
+        tm.drain(timeout=10.0)
+
+
 DRILLS: Dict[str, Callable[[bool], dict]] = {
     "site_registry": drill_site_registry,
     "serving_score": drill_serving_score,
@@ -1807,6 +1958,11 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     # with zero lost requests and an honest p99 ledger; a failed cache
     # promotion leaves entities cold, never corrupt
     "shard_fault": drill_shard_fault,
+    # serving fabric (docs/FRONTEND.md): a whole replica dies under
+    # live multi-tenant load -> the router fails over with zero lost
+    # requests, the corpse's breaker opens, SLO ledgers stay honest,
+    # and the recovered replica rejoins after its backoff
+    "replica_loss": drill_replica_loss,
     # the self-healing lifecycle loop (docs/LIFECYCLE.md): drift alarm
     # -> entity-keyed warm-started retrain with admitted entities ->
     # manifest-gated export -> breaker-guarded hot-reload, zero dropped

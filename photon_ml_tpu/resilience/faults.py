@@ -56,6 +56,21 @@ Sites in-tree today::
                             lands, so the registry never loads the
                             partial dir; corrupt = torn payload the
                             manifest gate / reload breaker must catch)
+    frontend.accept         per accepted front-end connection (key =
+                            peer address; raise = drop the connection
+                            at accept — the listener and every other
+                            connection keep serving; delay = slow
+                            accept path)
+    tenant.quota            per tenant admission quota check (key =
+                            tenant name; raise = quota check fails
+                            CLOSED — the request is rejected, never
+                            silently admitted past quota; corrupt =
+                            force the over-quota mark)
+    replica.route           per routed batch attempt (key = replica
+                            name; raise = replica died mid-batch — the
+                            router must fail over with zero lost
+                            requests; delay = a slow replica skewing
+                            the load view)
 
 Arming a site OUTSIDE this list raises at arm time: a typo'd drill that
 silently probes nothing would "pass" by testing nothing. Libraries that
@@ -116,6 +131,9 @@ KNOWN_SITES = (
     "cache.admission_log",
     "retrain.warm_start",
     "retrain.export",
+    "frontend.accept",
+    "tenant.quota",
+    "replica.route",
 )
 
 MODES = ("raise", "corrupt", "delay")

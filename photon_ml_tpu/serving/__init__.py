@@ -40,6 +40,7 @@ from photon_ml_tpu.serving.engine import (
     DEFAULT_MIN_BUCKET,
     ScoreRequest,
     ScoringEngine,
+    SharedCompileCache,
     bucket_size,
     pad_game_data,
     warmup_buckets,

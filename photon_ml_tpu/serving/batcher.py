@@ -60,17 +60,27 @@ class DeadlineExceeded(TimeoutError):
     dropped before reaching the device (counted as ``expired``)."""
 
 
+# process-wide batcher instance ids: every MicroBatcher gets one, and
+# request ids are namespaced by it (rid = instance_id << 32 | seq).
+# Without the namespace, R replicated batchers each count 1, 2, 3, ...
+# and their `serving.request` spans collide in merged traces — the
+# merge dedup would silently drop one replica's requests as duplicates.
+_INSTANCE_IDS = itertools.count(1)
+
+
 class _Item:
-    __slots__ = ("request", "future", "enqueued", "rid", "deadline", "priority")
+    __slots__ = ("request", "future", "enqueued", "rid", "deadline",
+                 "priority", "over_quota")
 
     def __init__(self, request, rid: int = 0, deadline: Optional[float] = None,
-                 priority: int = 0):
+                 priority: int = 0, over_quota: bool = False):
         self.request = request
         self.future: Future = Future()
         self.enqueued = time.perf_counter()
         self.rid = rid
         self.deadline = deadline  # absolute perf_counter seconds, or None
         self.priority = priority
+        self.over_quota = over_quota
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -137,14 +147,52 @@ class _RequestQueue:
         """Remove and return the OLDEST entry whose priority is strictly
         below ``priority`` (oldest-first among the lowest priority
         present), or None when nothing is outranked."""
+        return self.shed_victim(priority, over_quota=False)
+
+    def shed_victim(
+        self, priority: int, over_quota: bool = False
+    ) -> Optional[_Item]:
+        """Quota-aware shed policy (docs/FRONTEND.md): pick the queued
+        entry an arriving request may evict, or None.
+
+        - A tenant at quota is shed BEFORE any under-quota tenant,
+          regardless of priority: if over-quota entries are queued and
+          the newcomer is under quota, the oldest lowest-priority
+          over-quota entry goes — quota is the outer fairness ring,
+          priority only orders work inside it.
+        - Otherwise the PR-10 rule among the newcomer's own class:
+          oldest strictly-lower-priority entry; ties never shed.
+        - An over-quota newcomer may only evict over-quota entries
+          (strictly lower priority); it can never displace an
+          under-quota tenant's work.
+        """
         with self._cond:
             if not self._items:
                 return None
-            lowest = min(it.priority for it in self._items)
+            if not over_quota:
+                over = [it for it in self._items if it.over_quota]
+                if over:
+                    lowest = min(it.priority for it in over)
+                    for i, it in enumerate(self._items):
+                        if it.over_quota and it.priority == lowest:
+                            return self._items.pop(i)
+            # newcomer's own class: over-quota newcomers only look at
+            # over-quota entries; under-quota newcomers (no over-quota
+            # queued, per above) look at everything
+            pool = (
+                [it for it in self._items if it.over_quota]
+                if over_quota
+                else self._items
+            )
+            if not pool:
+                return None
+            lowest = min(it.priority for it in pool)
             if lowest >= priority:
                 return None
             for i, it in enumerate(self._items):
-                if it.priority == lowest:
+                if it.priority == lowest and (
+                    it.over_quota or not over_quota
+                ):
                     return self._items.pop(i)
         return None
 
@@ -255,9 +303,12 @@ class MicroBatcher:
         self._q = _RequestQueue(maxsize=queue_depth)
         self.stats = stats if stats is not None else ServingStats()
         self.slo = slo
-        # request ids: monotone per batcher, stamped at submit and
-        # propagated through _flush into the engine's score span
-        # (obs.span_context) — the request-scoped trace key
+        # request ids: monotone per batcher and NAMESPACED by a process-
+        # wide instance id (rid = instance_id << 32 | seq), stamped at
+        # submit and propagated through _flush into the engine's score
+        # span (obs.span_context) — the request-scoped trace key that
+        # stays unique across replicated batchers in one merged trace
+        self.instance_id = next(_INSTANCE_IDS)
         self._rids = itertools.count(1)
         self._draining = threading.Event()
         self._stopped = threading.Event()
@@ -328,6 +379,7 @@ class MicroBatcher:
         *,
         deadline_ms: Optional[float] = None,
         priority: int = 0,
+        over_quota: bool = False,
     ) -> Future:
         """Enqueue one request; the Future resolves to its float score.
 
@@ -336,16 +388,21 @@ class MicroBatcher:
         this many milliseconds — expiry happens before batch assembly, so
         an expired request costs zero device work. ``priority``: higher
         values outrank queued lower ones when the queue is full (the shed
-        policy); ties never shed. Raises :class:`Backpressure` when
-        draining or when admission control cannot make room."""
+        policy); ties never shed. ``over_quota``: the submitting tenant
+        is past its admission quota — the request still scores when there
+        is room, but it is first in line to shed and may itself only
+        displace other over-quota work (docs/FRONTEND.md). Raises
+        :class:`Backpressure` when draining or when admission control
+        cannot make room."""
         if self._draining.is_set():
             raise Backpressure("batcher is draining; not accepting requests")
         now = time.perf_counter()
         item = _Item(
             request,
-            rid=next(self._rids),
+            rid=(self.instance_id << 32) | next(self._rids),
             deadline=(now + deadline_ms / 1e3) if deadline_ms else None,
             priority=priority,
+            over_quota=over_quota,
         )
         try:
             self._q.put_nowait(item)
@@ -356,8 +413,9 @@ class MicroBatcher:
 
     def _admit_under_pressure(self, item: _Item, now: float) -> None:
         """Queue-full admission control: (1) expire dead requests —
-        oldest first — and retry; (2) shed the oldest strictly-lower-
-        priority request; (3) reject the newcomer."""
+        oldest first — and retry; (2) shed per the quota-aware policy
+        (over-quota work first, then oldest strictly-lower-priority);
+        (3) reject the newcomer."""
         for dead in self._q.pop_expired(now):
             self._expire(dead)
         try:
@@ -365,7 +423,7 @@ class MicroBatcher:
             return
         except queue.Full:
             pass
-        victim = self._q.shed_lowest(item.priority)
+        victim = self._q.shed_victim(item.priority, item.over_quota)
         if victim is not None:
             self._shed(victim)
             try:
@@ -414,10 +472,12 @@ class MicroBatcher:
                 time.perf_counter() - item.enqueued, ok=False
             )
         if not item.future.done():
+            why = "over quota" if item.over_quota else \
+                f"priority {item.priority}"
             item.future.set_exception(
                 Backpressure(
-                    f"request {item.rid} (priority {item.priority}) shed "
-                    "for a higher-priority request"
+                    f"request {item.rid} ({why}) shed for an arriving "
+                    "request under queue pressure"
                 )
             )
 

@@ -39,7 +39,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, Mapping, Optional, Sequence
+from typing import Callable, Dict, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -138,6 +138,58 @@ def pad_game_data(data: GameData, rows: int) -> GameData:
     )
 
 
+class SharedCompileCache:
+    """Process-wide AOT bucket-executable ladder shared across engines.
+
+    Compiled bucket executables take the model params as ARGUMENTS, so
+    the program depends only on the engine's structural signature —
+    class, coordinate order, shard map, RE keys, param shapes/dtypes,
+    placement, and the per-call (bucket, dims, fixed_only) contract —
+    never on the weights. N tenants serving same-shaped models (the
+    photon-ml fleet norm: one architecture, per-market weights) share
+    ONE compile per bucket instead of paying N (docs/FRONTEND.md).
+
+    Thread-safe with build-once semantics: a per-key lock means two
+    tenants warming the same bucket concurrently compile once and both
+    get the survivor, without serializing compiles for DIFFERENT keys
+    behind one global lock.
+    """
+
+    def __init__(self):
+        self._cache: Dict[tuple, object] = {}
+        self._locks: Dict[tuple, threading.Lock] = {}
+        self._meta = threading.Lock()
+        self.hits = 0
+        self.compiles = 0
+
+    def get(self, key: tuple, build: Callable[[], object]) -> object:
+        with self._meta:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self.hits += 1
+                return hit
+            lock = self._locks.setdefault(key, threading.Lock())
+        with lock:
+            with self._meta:
+                hit = self._cache.get(key)
+                if hit is not None:
+                    self.hits += 1
+                    return hit
+            built = build()
+            with self._meta:
+                self._cache[key] = built
+                self.compiles += 1
+            return built
+
+    def snapshot(self) -> dict:
+        with self._meta:
+            return {
+                "entries": len(self._cache),
+                "hits": int(self.hits),
+                "compiles": int(self.compiles),
+            }
+
+
 @dataclasses.dataclass
 class ScoreRequest:
     """One scoring request.
@@ -187,6 +239,7 @@ class ScoringEngine:
         drift=None,
         hbm_cache_entities: Optional[int] = None,
         admission_log_path: Optional[str] = None,
+        compile_cache: Optional["SharedCompileCache"] = None,
     ):
         install_compile_listener()
         self.dtype = jnp.empty((), dtype).dtype  # canonicalized (x64 seam)
@@ -272,6 +325,14 @@ class ScoringEngine:
         self._compiled: Dict[object, object] = {}
         self._lock = threading.Lock()
         self.compile_count = 0
+        # optional process-wide executable sharing (docs/FRONTEND.md):
+        # params are ARGUMENTS of every bucket executable, so engines
+        # whose structural signature matches (same class / coordinate
+        # order / shard map / param shapes / placement) can run one
+        # compiled program with their own weights — N tenants pay one
+        # AOT bucket ladder instead of N
+        self._shared_cache = compile_cache
+        self.shared_compile_hits = 0
         # which ELL backend this engine's executables traced with
         # (PHOTON_SPARSE_KERNEL dispatch in ops.sparse) — pinned at
         # construction so score spans attribute kernel provenance even
@@ -582,13 +643,31 @@ class ScoringEngine:
         if hit is not None:
             self.stats.record_bucket(bucket, hit=True)
             return hit
-        scorer = self._scorer_fixed if fixed_only else self._scorer
-        compiled = scorer.lower(
-            self._params, *self._abstract_inputs(bucket, dims, fixed_only)
-        ).compile()
+
+        fresh = [False]
+
+        def _build():
+            scorer = self._scorer_fixed if fixed_only else self._scorer
+            fresh[0] = True
+            return scorer.lower(
+                self._params,
+                *self._abstract_inputs(bucket, dims, fixed_only),
+            ).compile()
+
+        if self._shared_cache is not None:
+            # local miss: consult the process-wide ladder keyed by the
+            # engine's structural signature — a hit means some same-
+            # shaped tenant already paid this bucket's compile
+            compiled = self._shared_cache.get(
+                self._compile_cache_key(bucket, dims, fixed_only), _build
+            )
+            if not fresh[0]:
+                self.shared_compile_hits += 1
+        else:
+            compiled = _build()
         with self._lock:
             prior = self._compiled.setdefault(cache_key, compiled)
-        if prior is compiled:
+        if prior is compiled and fresh[0]:
             self.compile_count += 1
             self.stats.record_compile()
             # cost-book the fresh executable (FLOPs, footprint,
@@ -602,6 +681,33 @@ class ScoringEngine:
             )
         self.stats.record_bucket(bucket, hit=False)
         return prior
+
+    def _compile_cache_key(self, bucket, dims, fixed_only) -> tuple:
+        """Structural signature under which this engine's executables are
+        shareable: everything the traced program depends on EXCEPT the
+        weight values. Engines producing equal keys lower byte-identical
+        programs, so one tenant's compile serves every tenant."""
+        leaves, treedef = jax.tree_util.tree_flatten(self._params)
+        return (
+            type(self).__name__,
+            self._placement_fingerprint(),
+            self._sparse_kernel,
+            tuple(self._coord_order),
+            tuple(sorted(self.shards.items())),
+            tuple(sorted(self.random_effects.items())),
+            str(self.dtype),
+            str(treedef),
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+            int(bucket),
+            tuple(sorted(dims.items())) if dims else None,
+            bool(fixed_only),
+        )
+
+    def _placement_fingerprint(self) -> str:
+        """Where executables land — part of the shared-cache key because
+        a program compiled for one device set cannot run on another. The
+        sharded engine overrides with its mesh's device ids."""
+        return repr(self._device)
 
     def _abstract_inputs(self, bucket, dims, fixed_only):
         """Abstract (ShapeDtypeStruct) non-param arguments of one padded

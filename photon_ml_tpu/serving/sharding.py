@@ -155,8 +155,20 @@ class RoutedBatch:
         """(P, bucket) per-shard partial scores -> (B,) per-request
         scores: the ONE step that crosses shards, summed host-side in
         placement order (ascending shard within each request)."""
-        out = np.zeros(self.num_rows, partials.dtype)
-        np.add.at(out, self.p_row, partials[self.p_shard, self.p_slot])
+        t0 = time.perf_counter()
+        with obs.span(
+            "serving.route.merge",
+            cat="serving",
+            rows=self.num_rows,
+            shards=self.num_shards,
+        ):
+            out = np.zeros(self.num_rows, partials.dtype)
+            np.add.at(
+                out, self.p_row, partials[self.p_shard, self.p_slot]
+            )
+        obs.registry().observe(
+            "serving.route.merge_ms", (time.perf_counter() - t0) * 1e3
+        )
         return out
 
 
@@ -174,7 +186,14 @@ def route_batch(
     fixed-effect-only, so any shard balances); additional owner shards
     get secondary placements carrying only the RE keys they own. Probes
     ``serving.shard_route`` once per involved shard; a raise/corrupt
-    fault marks the shard down (its RE gathers degrade to -1)."""
+    fault marks the shard down (its RE gathers degrade to -1).
+
+    The host-side routing cost BENCH_r08 exposed (sharded 2.4k qps vs
+    unsharded 4.7k) is decomposed into ``serving.route.{group,pad}``
+    spans + ``_ms`` histograms here (``serving.route.merge`` lives on
+    :meth:`RoutedBatch.merge`) so ROADMAP item 2's dispatch-free attack
+    has a measured per-stage baseline."""
+    t_group = time.perf_counter()
     owner: Dict[str, np.ndarray] = {}
     local: Dict[str, np.ndarray] = {}
     for rk, a in assignments.items():
@@ -229,6 +248,7 @@ def route_batch(
         sel = (owner[rk][p_row] == p_shard) & ~down_mask
         e[sel] = local[rk][p_row[sel]].astype(np.int32)
         ents[rk] = e
+    t_pad = time.perf_counter()
 
     counts = np.bincount(p_shard, minlength=num_shards)
     bucket = bucket_size(max(int(counts.max()), 1), min_bucket)
@@ -236,6 +256,28 @@ def route_batch(
     starts = np.concatenate([[0], np.cumsum(counts)])[:-1]
     slot = np.empty(p_row.shape, np.int64)
     slot[order] = np.arange(p_row.size) - starts[p_shard[order]]
+
+    t_end = time.perf_counter()
+    reg = obs.registry()
+    reg.observe("serving.route.group_ms", (t_pad - t_group) * 1e3)
+    reg.observe("serving.route.pad_ms", (t_end - t_pad) * 1e3)
+    tracer = obs.get_tracer()
+    if tracer is not None:
+        # retro-emitted stage spans (the batcher's serving.request idiom):
+        # group = ownership lookup + placements + fault probes + RE ids,
+        # pad = bucket sizing + slot assignment
+        end_us = tracer.now_us()
+        pad_us = (t_end - t_pad) * 1e6
+        group_us = (t_pad - t_group) * 1e6
+        tracer.add_span(
+            "serving.route.group", end_us - pad_us - group_us, group_us,
+            cat="serving", args={"rows": int(num_rows),
+                                 "placements": int(p_row.size)},
+        )
+        tracer.add_span(
+            "serving.route.pad", end_us - pad_us, pad_us,
+            cat="serving", args={"bucket": int(bucket)},
+        )
 
     return RoutedBatch(
         num_rows=num_rows,
@@ -308,6 +350,13 @@ class ShardedScoringEngine(ScoringEngine):
         )
 
     # -- construction hooks ------------------------------------------------
+
+    def _placement_fingerprint(self) -> str:
+        # shard_map'd executables are pinned to this mesh's device set —
+        # only engines on the SAME mesh may share them
+        return "mesh:" + ",".join(
+            str(d.id) for d in self.mesh.devices.flat
+        ) + f"/{self.num_shards}"
 
     def _precompact(self, params):
         pre = {

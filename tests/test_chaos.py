@@ -200,6 +200,75 @@ class TestDeadlinesAndAdmission:
         assert int(b.stats.shed) == 1
         assert int(b.stats.rejected) == 1
 
+    def test_over_quota_shed_first_regardless_of_priority(self):
+        """Quota is the OUTER fairness ring (docs/FRONTEND.md): a
+        tenant past its quota is first in line to shed even when its
+        request outranks everyone — an under-quota priority-0 newcomer
+        displaces an over-quota priority-9 entry."""
+        b, gate, started = _blocked_batcher(queue_depth=2)
+        try:
+            b.submit(object())  # wedge
+            started.wait(5.0)
+            f_oq_hi = b.submit(object(), priority=9, over_quota=True)
+            f_uq_lo = b.submit(object(), priority=0)
+            f_new = b.submit(object(), priority=0)  # under quota
+            with pytest.raises(Backpressure):
+                f_oq_hi.result(timeout=5.0)
+            gate.set()
+            assert isinstance(f_uq_lo.result(timeout=5.0), float)
+            assert isinstance(f_new.result(timeout=5.0), float)
+        finally:
+            gate.set()
+            b.drain(timeout=5.0)
+        assert int(b.stats.shed) == 1
+
+    def test_over_quota_newcomer_cannot_displace_under_quota(self):
+        """Priority orders work INSIDE the quota ring, never across it:
+        an over-quota priority-9 newcomer is rejected rather than
+        displacing under-quota priority-0 work."""
+        b, gate, started = _blocked_batcher(queue_depth=2)
+        try:
+            b.submit(object())  # wedge
+            started.wait(5.0)
+            f_a = b.submit(object(), priority=0)
+            f_b = b.submit(object(), priority=0)
+            with pytest.raises(Backpressure):
+                b.submit(object(), priority=9, over_quota=True)
+            gate.set()
+            for f in (f_a, f_b):
+                assert isinstance(f.result(timeout=5.0), float)
+        finally:
+            gate.set()
+            b.drain(timeout=5.0)
+        assert int(b.stats.shed) == 0
+        assert int(b.stats.rejected) == 1
+
+    def test_over_quota_newcomer_displaces_lower_over_quota_only(self):
+        """Inside the over-quota pool the normal priority rule holds:
+        strictly-lower sheds, ties never shed."""
+        b, gate, started = _blocked_batcher(queue_depth=2)
+        try:
+            b.submit(object())  # wedge
+            started.wait(5.0)
+            f_oq_lo = b.submit(object(), priority=1, over_quota=True)
+            f_uq = b.submit(object(), priority=0)
+            # tie inside the over-quota pool: rejected, never shed
+            with pytest.raises(Backpressure):
+                b.submit(object(), priority=1, over_quota=True)
+            # strictly higher over-quota newcomer sheds the lower
+            # over-quota entry — the under-quota p0 is untouchable
+            f_oq_hi = b.submit(object(), priority=2, over_quota=True)
+            with pytest.raises(Backpressure):
+                f_oq_lo.result(timeout=5.0)
+            gate.set()
+            assert isinstance(f_uq.result(timeout=5.0), float)
+            assert isinstance(f_oq_hi.result(timeout=5.0), float)
+        finally:
+            gate.set()
+            b.drain(timeout=5.0)
+        assert int(b.stats.shed) == 1
+        assert int(b.stats.rejected) == 1
+
     def test_degrade_controller_hysteresis(self):
         c = _DegradeController(
             high_water=0.8, low_water=0.25,

@@ -1,0 +1,481 @@
+"""Production serving fabric (docs/FRONTEND.md): the async multiplexed
+front end, the multi-tenant engine layer, and the replica router.
+
+CPU-only, tier-1-safe. Most tests score through a deterministic fake
+scorer (score == the request's ``offset``) so the wire protocol, tenant
+policy, and failover logic are exercised without JAX compiles; one test
+proves the shared AOT ladder on real engines.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.frontend import (
+    AllReplicasDown,
+    FrontendClient,
+    FrontendServer,
+    Replica,
+    ReplicaRouter,
+    TenantManager,
+    UnknownTenant,
+)
+from photon_ml_tpu.resilience.faults import FaultSpec, inject
+from photon_ml_tpu.serving.batcher import Backpressure, DeadlineExceeded
+from photon_ml_tpu.serving.engine import SharedCompileCache
+
+pytestmark = pytest.mark.frontend
+
+
+def echo_score(batch):
+    """score == request.offset — deterministic, JAX-free (the tenant
+    layer hands scorers the UNWRAPPED inner requests)."""
+    return np.asarray([r.offset for r in batch])
+
+
+def offset_times(k):
+    def f(batch):
+        return np.asarray([k * r.offset for r in batch])
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# replica router
+# ---------------------------------------------------------------------------
+
+
+class _Req:
+    def __init__(self, offset=1.0):
+        self.offset = offset
+
+
+class TestReplicaRouter:
+    def test_serialized_submits_spread_over_ties(self):
+        calls = {"a": 0, "b": 0}
+
+        def mk(name):
+            def f(batch):
+                calls[name] += 1
+                return np.ones(len(batch))
+
+            return f
+
+        router = ReplicaRouter([("a", mk("a")), ("b", mk("b"))])
+        for _ in range(10):
+            router.score([_Req()])
+        # outstanding is 0 at every placement (serialized); the round-
+        # robin tie rotation must still spread the load
+        assert calls["a"] == 5 and calls["b"] == 5
+
+    def test_failover_answers_every_batch(self):
+        def dead(batch):
+            raise OSError("replica died")
+
+        router = ReplicaRouter(
+            [("r0", dead), ("r1", offset_times(1.0))],
+            failure_threshold=2, backoff_s=60.0,
+        )
+        for _ in range(6):
+            out = router.score([_Req(3.0)])
+            assert out[0] == 3.0
+        h = router.health()
+        assert h["failovers"] >= 1
+        assert h["replicas"]["r0"]["state"] == "open"
+        assert h["up"] == 1
+        assert router.last_failover_s is not None
+
+    def test_all_replicas_down_raises(self):
+        def dead(batch):
+            raise OSError("dead")
+
+        router = ReplicaRouter([("r0", dead), ("r1", dead)])
+        with pytest.raises(AllReplicasDown):
+            router.score([_Req()])
+
+    def test_breaker_recovers_after_backoff(self):
+        alive = threading.Event()
+
+        def flaky(batch):
+            if not alive.is_set():
+                raise OSError("down")
+            return np.zeros(len(batch))
+
+        router = ReplicaRouter(
+            [("r0", flaky), ("r1", offset_times(1.0))],
+            failure_threshold=1, backoff_s=0.05,
+        )
+        router.score([_Req()])  # r0 fails -> breaker opens -> r1 answers
+        assert router.health()["replicas"]["r0"]["state"] == "open"
+        alive.set()
+        time.sleep(0.06)
+        # probe batches re-admit r0 (half-open -> closed)
+        for _ in range(4):
+            router.score([_Req()])
+        assert router.health()["replicas"]["r0"]["state"] == "closed"
+
+    def test_on_failover_hook(self):
+        seen = []
+
+        def dead(batch):
+            raise OSError("died")
+
+        router = ReplicaRouter(
+            [("r0", dead), ("r1", offset_times(1.0))],
+            on_failover=lambda f, t, e: seen.append((f, t, type(e))),
+        )
+        router.score([_Req()])
+        assert seen == [("r0", "r1", OSError)]
+
+    def test_unique_names_required(self):
+        with pytest.raises(ValueError, match="unique"):
+            ReplicaRouter([("r0", echo_score), ("r0", echo_score)])
+
+    def test_accepts_replica_instances(self):
+        rep = Replica("solo", offset_times(2.0))
+        router = ReplicaRouter([rep])
+        assert router.score([_Req(2.0)])[0] == 4.0
+        assert router.replicas[0] is rep
+
+    def test_route_fault_site_drives_failover(self):
+        router = ReplicaRouter(
+            [("r0", offset_times(1.0)), ("r1", offset_times(1.0))],
+            failure_threshold=1, backoff_s=60.0,
+        )
+        with inject(FaultSpec(site="replica.route", mode="raise",
+                              nth=1, count=-1, key="r0")):
+            for _ in range(5):
+                assert router.score([_Req(1.5)])[0] == 1.5
+        assert router.health()["replicas"]["r0"]["state"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# tenant manager
+# ---------------------------------------------------------------------------
+
+
+class TestTenantManager:
+    def test_routes_each_tenant_to_its_own_scorer(self):
+        tm = TenantManager(max_batch=16, max_wait_ms=20.0,
+                           auto_start=False)
+        tm.add_tenant("x2", offset_times(2.0))
+        tm.add_tenant("x3", offset_times(3.0))
+        try:
+            # interleaved submits flush as ONE mixed batch: grouping by
+            # tenant + order restoration is what's under test
+            futs = [
+                tm.submit("x2", _Req(1.0)),
+                tm.submit("x3", _Req(1.0)),
+                tm.submit("x2", _Req(5.0)),
+                tm.submit("x3", _Req(5.0)),
+            ]
+            tm.batcher.start()
+            got = [f.result(timeout=10) for f in futs]
+            assert got == [2.0, 3.0, 10.0, 15.0]
+        finally:
+            tm.drain(timeout=10)
+
+    def test_unknown_tenant(self):
+        tm = TenantManager(auto_start=False)
+        with pytest.raises(UnknownTenant):
+            tm.submit("nobody", _Req())
+        with pytest.raises(ValueError, match="already registered"):
+            tm.add_tenant("a", echo_score)
+            tm.add_tenant("a", echo_score)
+
+    def test_quota_marks_over_quota_submissions(self):
+        gate = threading.Event()
+
+        def slow(batch):
+            gate.wait(10)
+            return np.zeros(len(batch))
+
+        tm = TenantManager(max_batch=4, max_wait_ms=0.1)
+        st = tm.add_tenant("q", slow, max_outstanding=1)
+        try:
+            f1 = tm.submit("q", _Req())
+            # first request is outstanding -> the second is over quota
+            deadline = time.time() + 5
+            while st.outstanding < 1 and time.time() < deadline:
+                time.sleep(0.005)
+            f2 = tm.submit("q", _Req())
+            assert st.over_quota_submits == 1
+            gate.set()
+            f1.result(timeout=10)
+            f2.result(timeout=10)
+            snap = st.snapshot()
+            assert snap["completed"] == 2 and snap["outstanding"] == 0
+        finally:
+            gate.set()
+            tm.drain(timeout=10)
+
+    def test_per_request_deadline_override(self):
+        tm = TenantManager(max_batch=4, max_wait_ms=0.1,
+                           auto_start=False)
+        tm.add_tenant("t", echo_score)  # no tenant deadline
+        fut = tm.submit("t", _Req(), deadline_ms=0.01)
+        time.sleep(0.05)
+        tm.batcher.start()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        tm.drain(timeout=10)
+
+    def test_quota_fault_fails_closed(self):
+        tm = TenantManager(auto_start=False)
+        st = tm.add_tenant("t", echo_score)
+        with inject(FaultSpec(site="tenant.quota", mode="raise",
+                              nth=1, count=-1, key="t")):
+            with pytest.raises(Backpressure, match="failed closed"):
+                tm.submit("t", _Req())
+        assert st.rejected == 1
+
+    def test_slo_and_snapshot_shape(self):
+        tm = TenantManager(max_batch=4, max_wait_ms=0.1)
+        tm.add_tenant("gold", echo_score, priority=2, deadline_ms=500,
+                      max_outstanding=32, target_p99_ms=5.0)
+        try:
+            tm.submit("gold", _Req(4.0)).result(timeout=10)
+        finally:
+            tm.drain(timeout=10)
+        snap = tm.snapshot()
+        g = snap["tenants"]["gold"]
+        assert g["priority"] == 2 and g["max_outstanding"] == 32
+        assert g["completed"] == 1
+        assert g["slo"]["total_requests"] == 1
+        assert snap["compile_cache"] == {
+            "entries": 0, "hits": 0, "compiles": 0,
+        }
+        assert "queue" in snap
+        assert tm.slo_snapshot()["gold"]["total_requests"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the front end (sockets, framing, multiplexing)
+# ---------------------------------------------------------------------------
+
+
+def _fabric(**tenant_kw):
+    """A running TenantManager(echo) + FrontendServer on an ephemeral
+    port; caller must srv.stop() + tm.drain()."""
+    tm = TenantManager(max_batch=8, max_wait_ms=0.5)
+    tm.add_tenant("a", offset_times(1.0), **tenant_kw)
+    tm.add_tenant("b", offset_times(10.0))
+    srv = FrontendServer(tm.submit, default_tenant="a")
+    srv.start()
+    return tm, srv
+
+
+class TestFrontendServer:
+    def test_single_and_batch_json_lines(self):
+        tm, srv = _fabric()
+        try:
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                r = c.call({"tenant": "a", "offset": 2.5})
+                assert r["score"] == 2.5
+                r = c.call({"tenant": "b", "batch": [
+                    {"offset": 1.0}, {"offset": 2.0},
+                ]})
+                assert r["scores"] == [10.0, 20.0]
+                # no tenant named -> default tenant "a"
+                assert c.call({"offset": 7.0})["score"] == 7.0
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_binary_framing(self):
+        tm, srv = _fabric()
+        try:
+            with FrontendClient("127.0.0.1", srv.port,
+                                binary=True) as c:
+                assert c.call({"offset": 3.0})["score"] == 3.0
+                r = c.call({"tenant": "b",
+                            "batch": [{"offset": 0.5}]})
+                assert r["scores"] == [5.0]
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_streaming_batch(self):
+        tm, srv = _fabric()
+        try:
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                rid = c.submit({"tenant": "a", "stream": True,
+                                "batch": [{"offset": float(i)}
+                                          for i in range(4)]})
+                rows, done = {}, None
+                while done is None:
+                    msg = c.recv()
+                    assert msg["id"] == rid
+                    if "done" in msg:
+                        done = msg["done"]
+                    else:
+                        rows[msg["seq"]] = msg["score"]
+                assert done == 4
+                assert rows == {0: 0.0, 1: 1.0, 2: 2.0, 3: 3.0}
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_multiplexed_replies_matched_by_id(self):
+        tm, srv = _fabric()
+        try:
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                ids = [c.submit({"offset": float(i)}) for i in range(8)]
+                got = {}
+                for _ in ids:
+                    msg = c.recv()  # completion order, not send order
+                    got[msg["id"]] = msg["score"]
+                assert got == {rid: float(i)
+                               for i, rid in enumerate(ids)}
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_unknown_tenant_is_invalid_argument(self):
+        tm, srv = _fabric()
+        try:
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                r = c.call({"tenant": "ghost", "offset": 1.0})
+                assert r["code"] == "INVALID_ARGUMENT"
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_backpressure_is_resource_exhausted_not_a_drop(self):
+        def refuse(tenant, request, **kw):
+            raise Backpressure("queue full")
+
+        srv = FrontendServer(refuse)
+        srv.start()
+        try:
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                r = c.call({"offset": 1.0})
+                assert r["code"] == "RESOURCE_EXHAUSTED"
+                # the connection survives the rejection
+                r = c.call({"offset": 2.0})
+                assert r["code"] == "RESOURCE_EXHAUSTED"
+        finally:
+            srv.stop()
+
+    def test_admin_passthrough(self):
+        tm, srv = _fabric()
+        srv.admin_fn = lambda obj: {"pong": obj["cmd"]}
+        try:
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                r = c.call({"cmd": "anything"})
+                assert r["pong"] == "anything"
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_bad_frame_answered_not_dropped(self):
+        tm, srv = _fabric()
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=10)
+            f = s.makefile("rwb")
+            f.write(b"{not json}\n")
+            f.flush()
+            assert json.loads(f.readline())["code"] == "INVALID_ARGUMENT"
+            # same connection still serves real requests
+            f.write(json.dumps({"id": 1, "offset": 9.0}).encode() + b"\n")
+            f.flush()
+            assert json.loads(f.readline())["score"] == 9.0
+            s.close()
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_oversized_binary_frame_refused(self):
+        tm, srv = _fabric()
+        srv.max_frame_bytes = 1024
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=10)
+            s.sendall((1 << 30).to_bytes(4, "big"))
+            f = s.makefile("rb")
+            head = f.read(4)
+            n = int.from_bytes(head, "big")
+            assert json.loads(f.read(n))["code"] == "INVALID_ARGUMENT"
+            s.close()
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+    def test_accept_fault_drops_one_connection_listener_survives(self):
+        tm, srv = _fabric()
+        try:
+            with inject(FaultSpec(site="frontend.accept", mode="raise",
+                                  nth=1, count=1)):
+                dropped = socket.create_connection(
+                    ("127.0.0.1", srv.port), timeout=10
+                )
+                # server closes the faulted connection
+                dropped.settimeout(5)
+                assert dropped.recv(1) == b""
+                dropped.close()
+            with FrontendClient("127.0.0.1", srv.port) as c:
+                assert c.call({"offset": 1.0})["score"] == 1.0
+        finally:
+            srv.stop()
+            tm.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# shared compile ladder on real engines
+# ---------------------------------------------------------------------------
+
+
+class TestSharedCompileCache:
+    def test_build_once_under_contention(self):
+        cache = SharedCompileCache()
+        builds = [0]
+        gate = threading.Event()
+
+        def build():
+            gate.wait(10)
+            builds[0] += 1
+            return object()
+
+        got = []
+
+        def worker():
+            got.append(cache.get(("k",), build))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join()
+        assert builds[0] == 1 and len(set(map(id, got))) == 1
+        snap = cache.snapshot()
+        assert snap["compiles"] == 1 and snap["hits"] == 7
+
+    def test_same_shaped_engines_share_executables(self):
+        from photon_ml_tpu.resilience.drills import (
+            build_drill_engine,
+            make_drill_request,
+        )
+
+        cache = SharedCompileCache()
+        e1 = build_drill_engine(np.random.default_rng(1))
+        e2 = build_drill_engine(np.random.default_rng(2))
+        e1._shared_cache = cache
+        e2._shared_cache = cache
+        rng = np.random.default_rng(3)
+        req = make_drill_request(rng)
+        s1 = e1.score([req])[0]
+        assert e2.compile_count == 0
+        s2 = e2.score([req])[0]
+        # same structural key: e2 reuses e1's executable...
+        assert e2.compile_count == 0 and e2.shared_compile_hits >= 1
+        assert cache.hits >= 1
+        # ...but scores with ITS OWN weights (params are arguments)
+        assert s1 != pytest.approx(s2)

@@ -281,6 +281,39 @@ class TestMergeTraceShards:
             [e for e in doc0["traceEvents"] if e["ph"] != "M"]
         )
 
+    def test_identical_spans_with_distinct_request_ids_both_survive(
+        self, tmp_path
+    ):
+        """PR-17 regression drill: two replicas' batchers can emit
+        serving spans with IDENTICAL (name, pid, tid, ts, dur) — the
+        replication symmetry — but distinct namespaced request ids.
+        The merge dedup key includes args.request_id, so these are two
+        real requests, not one duplicated event."""
+        d0 = _make_shard(tmp_path, 0)
+        doc0, _ = obs_dist.load_trace_shard(d0)
+        twin = {
+            "ph": "X", "name": "serving.request", "cat": "serving",
+            "pid": 7, "tid": 1, "ts": 100.0, "dur": 5.0,
+        }
+        doc = dict(doc0)
+        doc["traceEvents"] = list(doc0["traceEvents"]) + [
+            # replica 1's batcher: instance_id 1 -> rid (1 << 32) | 1
+            dict(twin, args={"request_id": (1 << 32) | 1}),
+            # replica 2's batcher: same seq, different namespace
+            dict(twin, args={"request_id": (2 << 32) | 1}),
+            # a TRUE duplicate of the first (same request seen twice)
+            dict(twin, args={"request_id": (1 << 32) | 1}),
+        ]
+        merged, info = obs_dist.merge_trace_shards([(doc, d0)])
+        _assert_perfetto_parseable(merged)
+        assert info["duplicates_dropped"] == 1
+        rids = [
+            e["args"]["request_id"]
+            for e in merged["traceEvents"]
+            if e.get("name") == "serving.request"
+        ]
+        assert sorted(rids) == [(1 << 32) | 1, (2 << 32) | 1]
+
     def test_no_sync_falls_back_to_epoch(self, tmp_path):
         dirs = [
             _make_shard(tmp_path, i, sync_id=None) for i in range(2)
@@ -797,7 +830,12 @@ class TestServingRequestTraces:
         ]
         assert len(reqs) == 6
         rids = {e["args"]["request_id"] for e in reqs}
-        assert rids == set(range(1, 7))
+        # rids are namespaced (instance_id << 32) | seq so two batcher
+        # instances (replicas) can never collide; one batcher = one
+        # namespace with seqs 1..6
+        assert {r & 0xFFFFFFFF for r in rids} == set(range(1, 7))
+        assert len({r >> 32 for r in rids}) == 1
+        assert all(r >> 32 >= 1 for r in rids)
         for e in reqs:
             a = e["args"]
             for key in (
@@ -808,6 +846,36 @@ class TestServingRequestTraces:
             assert a["queue_wait_ms"] >= 0 and a["device_ms"] >= 0
             total = e["dur"] / 1e3
             assert a["device_ms"] <= total + 1e-3
+
+    def test_two_batcher_instances_never_collide_rids(self, tmp_path):
+        """Replicated serving runs R batchers in one process; their
+        request ids must be globally unique or the merged trace dedup
+        would collapse distinct requests (the PR-17 bug)."""
+        from photon_ml_tpu.serving.batcher import MicroBatcher
+
+        def fn(reqs):
+            return np.zeros(len(reqs))
+
+        b1 = MicroBatcher(fn, max_batch=4, max_wait_ms=0.5)
+        b2 = MicroBatcher(fn, max_batch=4, max_wait_ms=0.5)
+        assert b1.instance_id != b2.instance_id
+        tdir = str(tmp_path / "t")
+        with obs.observe(trace_dir=tdir):
+            futs = [b.submit(i) for i in range(4) for b in (b1, b2)]
+            for f in futs:
+                f.result(10)
+            b1.drain()
+            b2.drain()
+        doc = json.load(open(os.path.join(tdir, "trace.json")))
+        rids = [
+            e["args"]["request_id"] for e in doc["traceEvents"]
+            if e["name"] == "serving.request"
+        ]
+        assert len(rids) == 8
+        assert len(set(rids)) == 8  # no collisions across instances
+        assert {r >> 32 for r in rids} == {
+            b1.instance_id, b2.instance_id
+        }
 
     def test_batch_context_propagates_to_score_fn(self, tmp_path):
         """The ambient span context carries the batch identity across
