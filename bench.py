@@ -2737,10 +2737,16 @@ def main():
     # recompiles when the compile listener was installed) + the XLA
     # cost book every MFU/HBM/collective number above came from
     from photon_ml_tpu import obs
+    from photon_ml_tpu.obs.sentinel import host_fingerprint
 
     extra["phase_s"] = dict(_PHASE_S)
     extra["metrics"] = obs.registry().snapshot()
     extra["cost_book"] = obs.cost_book().snapshot()
+    # environment fingerprint: the sentinel (obs/sentinel.py) treats
+    # host.* as identity, never a tracked metric — but uses it to
+    # annotate regressions that coincide with an environment change
+    # (new jax, different core count) vs the history being compared
+    extra["host"] = host_fingerprint()
     record = {
         "metric": "logreg_1Mx256_tron_wallclock",
         "value": round(glm["tpu_s"], 4),

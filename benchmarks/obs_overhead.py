@@ -18,8 +18,16 @@ excludes compile). The HBM sampler is a no-op on hosts whose devices
 report no memory stats — which includes this gate's CPU environment —
 so its enabled-mode price here is one probe per envelope.
 
+A fourth leg gates the serving fabric's request-causality surface
+(docs/OBSERVABILITY.md "Request tracing"): a MicroBatcher burst with
+per-request trace ids + an installed exemplar tail-sampling store,
+measured against the same burst with causality off — both under the
+full envelope, so the ratio isolates what tracing + tail sampling add
+on the serving path. Same <5% budget.
+
 Also reports the raw disabled-mode ``span()`` call cost (the
-unconditional-call contract: one global read + a shared no-op singleton).
+unconditional-call contract: one global read + a shared no-op singleton)
+and the per-reply exemplar record cost.
 
 Run in the tier-1 environment::
 
@@ -148,6 +156,54 @@ def quality_work(arrays) -> None:
         )
 
 
+def serving_run(n_requests: int, causality: bool) -> float:
+    """One timed serving burst: ``n_requests`` through a MicroBatcher
+    over a trivial scorer, BOTH legs under the full obs envelope (span
+    tracer + JSONL export — the per-request ``serving.request``
+    retro-span is a pre-PR-19 price the envelope legs above already
+    gate). What this leg isolates is the REQUEST-CAUSALITY surface:
+    with ``causality`` every submit carries a client trace id (the
+    ensure/validate + span-args path) and an installed
+    :class:`~photon_ml_tpu.obs.exemplars.ExemplarStore` classifies and
+    tail-samples every completion. The ratio is the marginal price of
+    tracing + tail sampling on the serving path, and it must fit the
+    same <5% budget."""
+    from photon_ml_tpu import obs
+    from photon_ml_tpu.obs import exemplars as _exemplars
+    from photon_ml_tpu.serving.batcher import MicroBatcher
+
+    def score_fn(reqs):
+        return np.arange(len(reqs), dtype=np.float32)
+
+    batcher = MicroBatcher(
+        score_fn, max_batch=64, max_wait_ms=0.2, queue_depth=n_requests
+    )
+    try:
+        prev = _exemplars.store()
+        _exemplars.set_store(
+            _exemplars.ExemplarStore(fast_fraction=0.01)
+            if causality
+            else None
+        )
+        try:
+            tmp = tempfile.mkdtemp(prefix="obs_overhead_serving_")
+            t0 = time.perf_counter()
+            with obs.observe(trace_dir=tmp):
+                futs = [
+                    batcher.submit(
+                        i, trace=(f"ov-{i}" if causality else None)
+                    )
+                    for i in range(n_requests)
+                ]
+                for f in futs:
+                    f.result(timeout=30.0)
+            return time.perf_counter() - t0
+        finally:
+            _exemplars.set_store(prev)
+    finally:
+        batcher.drain()
+
+
 def one_run(
     cd, iters, trace: bool, convergence: bool = False, quality=None
 ) -> float:
@@ -220,6 +276,19 @@ def collective_record_ns(n=50_000):
     return (time.perf_counter_ns() - t0) / n
 
 
+def exemplar_record_ns(n=100_000):
+    """Cost of one exemplar-store record (classify + ring append +
+    amortized slow-tail quantile refresh), nanoseconds — the per-reply
+    price the frontend pays with tail sampling installed."""
+    from photon_ml_tpu.obs.exemplars import ExemplarStore
+
+    st = ExemplarStore(fast_fraction=0.01)
+    t0 = time.perf_counter_ns()
+    for i in range(n):
+        st.record(f"bench-{i}", 1.0 + (i % 97) * 0.1)
+    return (time.perf_counter_ns() - t0) / n
+
+
 def flight_note_ns(n=200_000):
     """Cost of one flight-recorder ring append, nanoseconds — what every
     span/event/counter record pays while a recorder is installed (the
@@ -249,6 +318,10 @@ def main():
     # envelope setup/export — is what the ratio measures (a real run
     # amortizes the envelope over minutes; a 50 ms run would not)
     p.add_argument("--iters", type=int, default=12)
+    p.add_argument(
+        "--serving-requests", type=int, default=3000,
+        help="burst size for the serving request-causality leg",
+    )
     args = p.parse_args()
 
     shape = (
@@ -282,6 +355,7 @@ def main():
     # then samples the same quiet moments, and drift cancels.
     def measure():
         d_walls, e_walls, t_walls, q_walls = [], [], [], []
+        s_off, s_on = [], []
         for _ in range(args.repeats):
             d_walls.append(one_run(cd, args.iters, trace=False))
             e_walls.append(one_run(cd, args.iters, trace=True))
@@ -294,6 +368,10 @@ def main():
             q_walls.append(
                 one_run(cd, args.iters, trace=True, quality=quality_arrays)
             )
+            # serving leg: request-causality (trace ids + exemplar tail
+            # sampling) on vs off over the same traced batcher burst
+            s_off.append(serving_run(args.serving_requests, False))
+            s_on.append(serving_run(args.serving_requests, True))
             d_walls.append(one_run(cd, args.iters, trace=False))
         disabled = float(np.min(d_walls))
         return (
@@ -305,6 +383,9 @@ def main():
             float(np.max(d_walls)),
             float(np.min(q_walls)) / disabled,
             float(np.min(q_walls)),
+            float(np.min(s_on)) / float(np.min(s_off)),
+            float(np.min(s_off)),
+            float(np.min(s_on)),
         )
 
     # Best-of-3 reruns on failure: even interleaved repeats can't cancel
@@ -315,7 +396,8 @@ def main():
     # is real fails all three.
     attempts = 0
     best = None
-    ratio = ratio_tapes = ratio_quality = float("inf")
+    ratio = ratio_tapes = ratio_quality = ratio_serving = float("inf")
+    serving_off = serving_on = float("inf")
     while attempts < 3:
         attempts += 1
         m = measure()
@@ -326,24 +408,31 @@ def main():
         ratio = min(ratio, m[0])
         ratio_tapes = min(ratio_tapes, m[1])
         ratio_quality = min(ratio_quality, m[6])
+        if m[8] < ratio_serving:
+            ratio_serving, serving_off, serving_on = m[8], m[9], m[10]
         if (
             ratio <= args.threshold
             and ratio_tapes <= args.threshold
             and ratio_quality <= args.threshold
+            and ratio_serving <= args.threshold
         ):
             break
         print(
             f"attempt {attempts}: ratio {m[0]:.3f}x tapes {m[1]:.3f}x "
-            f"quality {m[6]:.3f}x "
+            f"quality {m[6]:.3f}x serving {m[8]:.3f}x "
             f"(best so far {ratio:.3f}x / {ratio_tapes:.3f}x / "
-            f"{ratio_quality:.3f}x, budget {args.threshold:.2f}x) — "
+            f"{ratio_quality:.3f}x / {ratio_serving:.3f}x, "
+            f"budget {args.threshold:.2f}x) — "
             + ("rerunning" if attempts < 3 else "giving up"),
             file=sys.stderr,
         )
-    _, _, disabled, enabled, enabled_tapes, d_max, _, enabled_quality = best
+    _, _, disabled, enabled, enabled_tapes, d_max, _, enabled_quality = (
+        best[:8]
+    )
     span_ns = disabled_span_ns()
     coll_ns = collective_record_ns()
     flight_ns = flight_note_ns()
+    exemplar_ns = exemplar_record_ns()
 
     from photon_ml_tpu.obs.flight import DEFAULT_CAPACITY
 
@@ -360,6 +449,11 @@ def main():
             "ratio_tapes": round(ratio_tapes, 4),
             "enabled_quality_s": round(enabled_quality, 4),
             "quality_overhead_ratio": round(ratio_quality, 4),
+            "serving_off_s": round(serving_off, 4),
+            "serving_on_s": round(serving_on, 4),
+            "serving_overhead_ratio": round(ratio_serving, 4),
+            "serving_requests": args.serving_requests,
+            "exemplar_record_ns": round(exemplar_ns, 1),
             "iters": args.iters,
             "repeats": args.repeats,
             "attempts": attempts,
@@ -397,12 +491,23 @@ def main():
             file=sys.stderr,
         )
         return 1
+    if ratio_serving > args.threshold:
+        print(
+            f"FAIL: serving request-causality overhead "
+            f"{ratio_serving:.3f}x (trace ids + exemplar tail sampling) "
+            f"exceeds {args.threshold:.2f}x budget (causality-off "
+            f"{serving_off:.3f}s, causality-on {serving_on:.3f}s)",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"ok: overhead {ratio:.3f}x, tapes-on {ratio_tapes:.3f}x, "
-        f"quality-on {ratio_quality:.3f}x "
+        f"quality-on {ratio_quality:.3f}x, serving causality "
+        f"{ratio_serving:.3f}x "
         f"(budget {args.threshold:.2f}x); "
         f"disabled span() {span_ns:.0f} ns, flight note {flight_ns:.0f} ns, "
-        f"collective record {coll_ns:.0f} ns",
+        f"collective record {coll_ns:.0f} ns, exemplar record "
+        f"{exemplar_ns:.0f} ns",
         file=sys.stderr,
     )
     return 0

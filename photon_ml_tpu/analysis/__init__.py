@@ -21,6 +21,8 @@ PL004  trace-unsafe-host-op         host ops inside jit/shard_map/scan/
 PL005  unmanaged-native-handle      PR 9 handle census, static form
 PL006  obs-taxonomy                 dashboard-orphaning metric name typos
 PL007  swallowed-retryable          broad swallows hiding the retry seam
+PL008  span-context-drop            PR 19 trace seam: a hand-off that drops
+                                    the trace id orphans the request timeline
 ====== ============================ =========================================
 
 ``photon-lint check`` (cli/lint.py) runs the registry over a tree,
@@ -75,6 +77,7 @@ def default_rules() -> List[Rule]:
     from photon_ml_tpu.analysis.rules_faults import UnknownFaultSiteRule
     from photon_ml_tpu.analysis.rules_handles import UnmanagedNativeHandle
     from photon_ml_tpu.analysis.rules_obs import ObsTaxonomyRule
+    from photon_ml_tpu.analysis.rules_reqtrace import SpanContextDrop
     from photon_ml_tpu.analysis.rules_spmd import SpmdCollectiveDivergence
     from photon_ml_tpu.analysis.rules_trace import TraceUnsafeHostOp
 
@@ -86,6 +89,7 @@ def default_rules() -> List[Rule]:
         UnmanagedNativeHandle(),
         ObsTaxonomyRule(),
         SwallowedRetryable(),
+        SpanContextDrop(),
     ]
 
 

@@ -21,11 +21,12 @@ there; fix it) un-grandfathers it. Duplicate texts in one file are
 matched as a multiset: adding a second identical violation to a file
 that had one baselined is still a new finding.
 
-PL001/PL002/PL003 ship with ZERO baseline entries by policy: those
-classes (collective divergence, by-name exception matching, unknown
-fault sites) each caused a real hang or masked-bug in this repo's
-history and are cheap to fix on contact; docs/ANALYSIS.md documents
-the policy and ``tests/test_analysis.py`` enforces it.
+PL001/PL002/PL003/PL008 ship with ZERO baseline entries by policy:
+those classes (collective divergence, by-name exception matching,
+unknown fault sites, dropped span contexts) each caused a real hang or
+masked-bug in this repo's history and are cheap to fix on contact;
+docs/ANALYSIS.md documents the policy and ``tests/test_analysis.py``
+enforces it.
 """
 
 from __future__ import annotations
@@ -45,8 +46,11 @@ __all__ = [
 ]
 
 # rules whose baseline must stay empty (enforced by tests and by
-# `photon-lint baseline`, which refuses to grandfather them)
-EMPTY_BASELINE_RULES = ("PL001", "PL002", "PL003")
+# `photon-lint baseline`, which refuses to grandfather them). PL008
+# joins the policy from birth: the trace seam it guards shipped clean
+# in the same PR, so there is nothing to grandfather — and a dropped
+# span context is always cheap to fix on contact (forward one value).
+EMPTY_BASELINE_RULES = ("PL001", "PL002", "PL003", "PL008")
 
 VERSION = 1
 

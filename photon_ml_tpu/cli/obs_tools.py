@@ -14,6 +14,12 @@ own monotonic clock. This CLI folds them into pod-level artifacts:
     # compare two quality fingerprints; exit 1 on drift alarm (cron)
     python -m photon_ml_tpu.cli.obs_tools drift out/run1 out/run2
 
+    # rebuild one request's causal timeline from event logs
+    python -m photon_ml_tpu.cli.obs_tools request <trace-id> out/trace ...
+
+    # live fleet console over every replica's admin channel
+    python -m photon_ml_tpu.cli.obs_tools top --endpoint host:port ...
+
 ``convergence`` reads the ``convergence.solve`` / ``convergence.fleet``
 events the obs.convergence layer emits (train CLIs under ``--trace-dir``
 and/or ``--convergence-report``) and renders per-solve value/grad-norm
@@ -36,6 +42,27 @@ writes:
 - ``<out>/quality-fingerprint.json`` — per-host quality fingerprints
   folded EXACTLY (sketch merge; pod-merged == single-pass) when shard
   dirs carry them (docs/OBSERVABILITY.md "Quality & drift").
+
+``request`` is the request-causality surface (docs/OBSERVABILITY.md
+"Request tracing"): given a trace id (echoed in every frontend reply, or
+pulled from the ``{"cmd": "exemplars"}`` rings) and one or more trace
+directories / ``events.jsonl`` paths, it merges the per-process event
+shards and renders the request's reconstructed timeline — wire read,
+queue wait, batch assembly, replica hop(s) incl. breaker failovers,
+per-shard device time, cache misses, reply write — with failover/
+degraded/truncation flags (``obs.reqtrace.reconstruct_timeline``). Exit
+2 when the id appears in no readable shard.
+
+``top`` polls every ``--endpoint``'s admin channel (the front end's
+``{"cmd": ...}`` passthrough) and folds per-replica health/stats/
+tenants/replicas/SLO/drift answers into ONE schema-stable fleet
+snapshot: per-tenant qps/p99/SLO/shed, per-replica breaker + outstanding
+state, per-shard cache hit-frac + resident bytes, drift gauges, and the
+lifecycle alarm latch. ``--once --json`` prints the snapshot and exits
+(the machine surface tests gate); ``--out`` also writes a
+``fleet-snapshot.json`` artifact; without ``--once`` it refreshes every
+``--interval`` seconds as a terminal console. Exit 2 when no endpoint
+answered.
 
 Missing / truncated / torn shards are skipped with a warning — merges
 run during post-mortems and must work with whatever survived. Exit 0 on
@@ -442,6 +469,400 @@ def drift_command(args) -> int:
     return 1 if report["alarm"] else 0
 
 
+# -- photon-obs request ------------------------------------------------------
+
+
+def _load_event_shards(paths):
+    """CLI operands (trace dirs or events.jsonl paths) -> merged,
+    host-tagged, time-ordered records. Positional order is the
+    process-index fallback, like ``merge``."""
+    return obs_dist.merge_events_shards(
+        [(p, pos) for pos, p in enumerate(paths)]
+    )
+
+
+def request_command(args) -> int:
+    from photon_ml_tpu.obs import reqtrace
+
+    records, warnings = _load_event_shards(args.shards)
+    for w in warnings:
+        print(f"photon-obs: warning: {w}", file=sys.stderr)
+    if not records:
+        print("photon-obs: no readable event shards", file=sys.stderr)
+        return 2
+    timeline = reqtrace.reconstruct_timeline(records, args.trace_id)
+    if timeline is None:
+        known = reqtrace.trace_ids(records)
+        print(
+            f"photon-obs: trace {args.trace_id!r} not found "
+            f"({len(records)} records, {len(known)} trace ids)",
+            file=sys.stderr,
+        )
+        for tid in known[-args.last:]:
+            print(f"  recent trace: {tid}", file=sys.stderr)
+        return 2
+
+    out = sys.stderr  # human rendering; the JSON summary owns stdout
+    flags = [
+        f for f in ("truncated", "failover", "degraded")
+        if timeline[f]
+    ]
+    print(
+        f"— request {timeline['trace']} "
+        f"[{' '.join(flags) if flags else 'complete'}] —",
+        file=out,
+    )
+    if timeline["request_id"] is not None:
+        print(
+            f"request_id={timeline['request_id']} "
+            f"batch_ids={timeline['batch_ids']} "
+            f"hosts={timeline['hosts']}",
+            file=out,
+        )
+    seg = timeline["segments"]
+    if seg:
+        order = ("wire_read_ms", "queue_wait_ms", "assembly_ms",
+                 "device_ms", "reply_write_ms")
+        print(
+            "segments: " + " -> ".join(
+                f"{k[:-3]} {seg[k]:.3f}ms" for k in order if k in seg
+            ),
+            file=out,
+        )
+    for hop in timeline["hops"]:
+        status = "FAILED" if hop["error"] else "ok"
+        print(
+            f"hop: replica={hop['replica']} attempt={hop['attempt']} "
+            f"{status}",
+            file=out,
+        )
+    if timeline["cache_misses"]:
+        print(f"cache misses: {timeline['cache_misses']}", file=out)
+    t0_unix = timeline["events"][0].get("time_unix", 0.0)
+    for rec in timeline["events"]:
+        dt = (rec.get("time_unix", 0.0) - t0_unix) * 1e3
+        dur = rec.get("duration_ms")
+        dur_s = f" {dur:.3f}ms" if isinstance(dur, (int, float)) else ""
+        host = rec.get("host")
+        host_s = f" host={host}" if host is not None else ""
+        print(
+            f"  +{dt:9.3f}ms {rec.get('kind', '?'):<5} "
+            f"{rec.get('name', '?')}{dur_s}{host_s}",
+            file=out,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "obs_request",
+                "value": len(timeline["events"]),
+                "unit": "events",
+                "extra": {
+                    "trace": timeline["trace"],
+                    "complete": timeline["complete"],
+                    "truncated": timeline["truncated"],
+                    "failover": timeline["failover"],
+                    "degraded": timeline["degraded"],
+                    "hops": len(timeline["hops"]),
+                    "cache_misses": timeline["cache_misses"],
+                    "hosts": timeline["hosts"],
+                    "segments": timeline["segments"],
+                    "warnings": len(warnings),
+                },
+            }
+        )
+    )
+    return 0
+
+
+# -- photon-obs top ----------------------------------------------------------
+
+# the admin commands one fleet poll issues per endpoint; an endpoint
+# missing a surface (single-tenant, unreplicated, no drift monitor)
+# answers {"error": ...} and folds in as None — schema-stable either way
+_TOP_CMDS = ("health", "stats", "tenants", "replicas", "slo", "drift")
+
+
+def _parse_endpoint(ep: str):
+    host, _, port = ep.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _prom_gauge(text: str, name: str):
+    """One gauge value out of a Prometheus text exposition, or None."""
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.split()[1])
+            except (IndexError, ValueError):
+                return None
+    return None
+
+
+def poll_endpoint(ep: str, *, binary: bool = False,
+                  timeout: float = 5.0) -> dict:
+    """One replica's raw admin answers (``_TOP_CMDS`` + the lifecycle
+    alarm latch dug out of the metrics exposition). Unreachable
+    endpoints come back ``{"reachable": False, "error": ...}`` — the
+    console renders whatever survived, like the merge path."""
+    from photon_ml_tpu.frontend.server import FrontendClient
+
+    entry: dict = {"reachable": False, "error": None}
+    try:
+        host, port = _parse_endpoint(ep)
+        with FrontendClient(
+            host, port, binary=binary, timeout=timeout
+        ) as cli:
+            for cmd in _TOP_CMDS:
+                reply = cli.call({"cmd": cmd})
+                reply.pop("id", None)
+                entry[cmd] = None if "error" in reply else reply
+            prom = cli.call({"cmd": "metrics"}).get("prometheus", "")
+            latched = _prom_gauge(prom, "photon_lifecycle_alarm_latched")
+            entry["lifecycle_alarm_latched"] = bool(latched)
+            entry["reachable"] = True
+    except (OSError, ConnectionError, ValueError, KeyError) as e:
+        entry["error"] = f"{type(e).__name__}: {e}"
+    return entry
+
+
+def collect_fleet_snapshot(
+    endpoints, *, binary: bool = False, timeout: float = 5.0
+) -> dict:
+    """Poll every endpoint once and aggregate to THE fleet snapshot —
+    the ``photon-obs top`` payload (schema-stable: every key below is
+    present regardless of which surfaces each replica serves)."""
+    raw = {ep: poll_endpoint(ep, binary=binary, timeout=timeout)
+           for ep in endpoints}
+
+    replicas = {}
+    tenants: dict = {}
+    fleet = {
+        "qps": 0.0,
+        "requests": 0,
+        "shed": 0,
+        "expired": 0,
+        "errors": 0,
+        "worst_p99_ms": 0.0,
+        "slo_met": True,
+        "drift_alarm": False,
+        "lifecycle_alarm": False,
+    }
+    for ep, entry in raw.items():
+        rep = {
+            "reachable": entry["reachable"],
+            "error": entry.get("error"),
+            "qps": None,
+            "p99_ms": None,
+            "queue_depth": None,
+            "degraded": None,
+            "draining": None,
+            "outstanding": None,
+            "breakers": {},
+            "failovers": 0,
+            "cache_hit_frac": None,
+            "resident_re_bytes": None,
+            "shards": {},
+            "drift": None,
+            "lifecycle_alarm_latched": bool(
+                entry.get("lifecycle_alarm_latched")
+            ),
+        }
+        stats = entry.get("stats")
+        if stats:
+            rep["qps"] = stats.get("qps")
+            rep["p99_ms"] = (stats.get("request_latency") or {}).get(
+                "p99_ms"
+            )
+            cache = stats.get("cache") or {}
+            rep["cache_hit_frac"] = cache.get("hit_frac")
+            rep["resident_re_bytes"] = stats.get(
+                "resident_re_bytes_per_process"
+            )
+            rep["shards"] = {
+                name: {"occupancy": shard.get("occupancy")}
+                for name, shard in (stats.get("shards") or {}).items()
+            }
+            fleet["qps"] += float(stats.get("qps") or 0.0)
+            fleet["requests"] += int(stats.get("requests") or 0)
+            fleet["errors"] += int(stats.get("errors") or 0)
+        health = entry.get("health")
+        if health:
+            rep["queue_depth"] = health.get("queue_depth")
+            rep["degraded"] = health.get("degraded")
+            rep["draining"] = health.get("draining")
+            fleet["shed"] += int(health.get("shed") or 0)
+            fleet["expired"] += int(health.get("expired") or 0)
+        routers = entry.get("replicas")
+        if routers:
+            for tname, router in routers.items():
+                for rname, snap in (
+                    router.get("replicas") or {}
+                ).items():
+                    rep["breakers"][f"{tname}/{rname}"] = {
+                        "state": snap.get("state"),
+                        "outstanding": snap.get("outstanding"),
+                        "failures": snap.get("failures"),
+                    }
+                rep["failovers"] += int(router.get("failovers") or 0)
+        drift = entry.get("drift")
+        if drift:
+            rep["drift"] = {
+                "checks": drift.get("checks"),
+                "alarms": drift.get("alarms"),
+                "psi_alarm": drift.get("psi_alarm"),
+            }
+            if drift.get("alarms"):
+                fleet["drift_alarm"] = True
+        if rep["lifecycle_alarm_latched"]:
+            fleet["lifecycle_alarm"] = True
+        tsnap = entry.get("tenants")
+        if tsnap:
+            for name, ten in (tsnap.get("tenants") or {}).items():
+                agg = tenants.setdefault(
+                    name,
+                    {
+                        "endpoints": 0,
+                        "outstanding": 0,
+                        "submitted": 0,
+                        "completed": 0,
+                        "failed": 0,
+                        "rejected": 0,
+                        "over_quota_submits": 0,
+                        "p99_ms": 0.0,
+                        "violation_rate": 0.0,
+                        "slo_met": True,
+                    },
+                )
+                agg["endpoints"] += 1
+                for k in ("outstanding", "submitted", "completed",
+                          "failed", "rejected", "over_quota_submits"):
+                    agg[k] += int(ten.get(k) or 0)
+                slo = ten.get("slo") or {}
+                agg["p99_ms"] = max(
+                    agg["p99_ms"], float(slo.get("p99_ms") or 0.0)
+                )
+                agg["violation_rate"] = max(
+                    agg["violation_rate"],
+                    float(slo.get("violation_rate") or 0.0),
+                )
+                if slo.get("slo_met") is False:
+                    agg["slo_met"] = False
+                    fleet["slo_met"] = False
+        if rep["p99_ms"]:
+            fleet["worst_p99_ms"] = max(
+                fleet["worst_p99_ms"], float(rep["p99_ms"])
+            )
+        replicas[ep] = rep
+    fleet["qps"] = round(fleet["qps"], 2)
+    return {
+        "schema": 1,
+        "endpoints": len(replicas),
+        "reachable": sum(
+            1 for r in replicas.values() if r["reachable"]
+        ),
+        "fleet": fleet,
+        "tenants": tenants,
+        "replicas": replicas,
+    }
+
+
+def _render_fleet(snap: dict, out) -> None:
+    fleet = snap["fleet"]
+    alarm_bits = []
+    if not fleet["slo_met"]:
+        alarm_bits.append("SLO-VIOLATED")
+    if fleet["drift_alarm"]:
+        alarm_bits.append("DRIFT-ALARM")
+    if fleet["lifecycle_alarm"]:
+        alarm_bits.append("LIFECYCLE-ALARM")
+    print(
+        f"— fleet: {snap['reachable']}/{snap['endpoints']} replicas up, "
+        f"{fleet['qps']:g} qps, worst p99 {fleet['worst_p99_ms']:g}ms, "
+        f"shed {fleet['shed']} expired {fleet['expired']} errors "
+        f"{fleet['errors']}"
+        + (f"  [{' '.join(alarm_bits)}]" if alarm_bits else " [healthy]"),
+        file=out,
+    )
+    for name, ten in sorted(snap["tenants"].items()):
+        met = "met" if ten["slo_met"] else "VIOLATED"
+        print(
+            f"tenant {name}: {ten['completed']}/{ten['submitted']} done "
+            f"({ten['endpoints']} eps) outstanding={ten['outstanding']} "
+            f"rejected={ten['rejected']} p99={ten['p99_ms']:g}ms "
+            f"slo={met}",
+            file=out,
+        )
+    for ep, rep in sorted(snap["replicas"].items()):
+        if not rep["reachable"]:
+            print(f"replica {ep}: UNREACHABLE ({rep['error']})", file=out)
+            continue
+        cache = (
+            f" cache={rep['cache_hit_frac']:.0%}"
+            if isinstance(rep["cache_hit_frac"], float)
+            and rep["cache_hit_frac"] > 0
+            else ""
+        )
+        resident = (
+            f" resident={rep['resident_re_bytes']}B"
+            if rep["resident_re_bytes"] else ""
+        )
+        lifecycle = (
+            " LIFECYCLE-ALARM" if rep["lifecycle_alarm_latched"] else ""
+        )
+        print(
+            f"replica {ep}: qps={rep['qps']} p99={rep['p99_ms']}ms "
+            f"queue={rep['queue_depth']} degraded={rep['degraded']}"
+            f"{cache}{resident} failovers={rep['failovers']}{lifecycle}",
+            file=out,
+        )
+        for bname, br in sorted(rep["breakers"].items()):
+            print(
+                f"  breaker {bname}: {br['state']} "
+                f"outstanding={br['outstanding']} "
+                f"failures={br['failures']}",
+                file=out,
+            )
+
+
+def top_command(args) -> int:
+    import time as _time
+
+    while True:
+        snap = collect_fleet_snapshot(
+            args.endpoint, binary=args.binary, timeout=args.timeout
+        )
+        if args.out:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(args.out)), exist_ok=True
+            )
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(snap, f, indent=2, sort_keys=True)
+        if args.json:
+            print(json.dumps(snap, sort_keys=True))
+        else:
+            _render_fleet(snap, sys.stderr)
+        if args.once:
+            if not args.json:
+                # the BENCH-style line owns stdout on the human path
+                print(
+                    json.dumps(
+                        {
+                            "metric": "obs_top",
+                            "value": snap["reachable"],
+                            "unit": "replicas",
+                            "extra": {
+                                "endpoints": snap["endpoints"],
+                                "tenants": sorted(snap["tenants"]),
+                                "qps": snap["fleet"]["qps"],
+                                "slo_met": snap["fleet"]["slo_met"],
+                            },
+                        }
+                    )
+                )
+            return 0 if snap["reachable"] else 2
+        _time.sleep(args.interval)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="photon-obs",
@@ -506,6 +927,66 @@ def main(argv=None) -> int:
         help="how many worst features to render (default 10)",
     )
     dp.set_defaults(func=drift_command)
+    rp = sub.add_parser(
+        "request",
+        help="rebuild one request's causal timeline from event logs",
+    )
+    rp.add_argument("trace_id", help="the trace id (echoed in replies)")
+    rp.add_argument(
+        "shards",
+        nargs="+",
+        help="trace directories (or events.jsonl paths) to search",
+    )
+    rp.add_argument(
+        "--last",
+        type=int,
+        default=5,
+        help="recent trace ids to suggest when the id is absent "
+        "(default 5)",
+    )
+    rp.set_defaults(func=request_command)
+    tp = sub.add_parser(
+        "top",
+        help="aggregated live fleet console over replica admin channels",
+    )
+    tp.add_argument(
+        "--endpoint",
+        action="append",
+        required=True,
+        help="replica front-end host:port (repeatable)",
+    )
+    tp.add_argument(
+        "--binary",
+        action="store_true",
+        help="speak the length-prefixed framing to the endpoints",
+    )
+    tp.add_argument(
+        "--timeout",
+        type=float,
+        default=5.0,
+        help="per-endpoint connect/answer timeout seconds (default 5)",
+    )
+    tp.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="refresh period in console mode (default 2s)",
+    )
+    tp.add_argument(
+        "--once",
+        action="store_true",
+        help="poll once and exit (2 when no endpoint answered)",
+    )
+    tp.add_argument(
+        "--json",
+        action="store_true",
+        help="print the full snapshot as one JSON line on stdout",
+    )
+    tp.add_argument(
+        "--out",
+        help="also write the snapshot to this fleet-snapshot.json path",
+    )
+    tp.set_defaults(func=top_command)
     args = p.parse_args(argv)
     return args.func(args)
 

@@ -54,6 +54,10 @@ Protocol (one JSON object per line):
     {"cmd": "drift"}    -> current model's DriftMonitor snapshot (live
                            PSI vs the export's train-time baseline
                            fingerprint; docs/OBSERVABILITY.md)
+    {"cmd": "exemplars"} -> tail-sampled exemplar rings: latency-bucket
+                           -> recent trace ids for `photon-obs request`
+                           (optional "ge_ms"/"class" filters;
+                           obs/exemplars.py, --exemplar-fraction)
 
 ``deadline_ms`` (per request, or ``--default-deadline-ms``) drops a
 request that can't start scoring in time — the Future answers
@@ -194,6 +198,24 @@ def make_admin_handler(
                         "quality fingerprint)"
                     }
                 return monitor.snapshot()
+            if cmd == "exemplars":
+                # tail-sampled exemplar rings (obs/exemplars.py): a
+                # latency-histogram bucket resolves to live trace ids
+                # for `photon-obs request`; optional "ge_ms" / "class"
+                # narrow the lookup
+                from photon_ml_tpu.obs import exemplars as _exemplars
+
+                st = _exemplars.store()
+                if st is None:
+                    return {"error": "no exemplar store installed"}
+                if obj.get("ge_ms") is not None or obj.get("class"):
+                    return {
+                        "exemplars": st.lookup(
+                            ge_ms=obj.get("ge_ms"),
+                            cls=obj.get("class"),
+                        )
+                    }
+                return st.snapshot()
             if cmd == "version":
                 return {"version": registry.version()}
             if cmd == "reload":
@@ -484,6 +506,13 @@ def main(argv=None) -> None:
         "tenant is the default for frames that name none. Without "
         "--tenant, one tenant 'default' serves --model-dir.",
     )
+    p.add_argument(
+        "--exemplar-fraction", type=float, default=0.01,
+        help="fast-path sampling fraction for the tail-based exemplar "
+        "store (errors/sheds/expiries/degraded/failovers and the "
+        "rolling slow tail are always kept; negative disables the "
+        "store entirely) — the {'cmd': 'exemplars'} surface",
+    )
     p.add_argument("--stats-json", help="dump a stats snapshot here on exit")
     args = p.parse_args(argv)
     if args.serving_shards > 1 and args.hbm_cache_entities:
@@ -564,6 +593,12 @@ def main(argv=None) -> None:
     from photon_ml_tpu.obs.quality import OnlineQuality
 
     quality = OnlineQuality(registry=stats.registry)
+    # tail-based exemplar sampling: the batcher feeds every finished
+    # request; the rings answer {"cmd": "exemplars"} with live trace ids
+    if args.exemplar_fraction >= 0:
+        from photon_ml_tpu.obs import exemplars as _exemplars
+
+        _exemplars.install_store(fast_fraction=args.exemplar_fraction)
     tm = None
     routers = {}
     frontend = None

@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu import obs
+from photon_ml_tpu.obs import reqtrace as _reqtrace
 from photon_ml_tpu.resilience import faults as _faults
 
 __all__ = ["Replica", "ReplicaRouter", "AllReplicasDown"]
@@ -226,22 +227,40 @@ class ReplicaRouter:
                 rep = pool[self._rr % len(pool)]
                 self._rr += 1
             tried.append(rep.name)
+            attempt = len(tried)
             with rep._lock:
                 rep.outstanding += 1
                 rep.batches += 1
             try:
                 # chaos seam: raise = this replica dying mid-batch,
-                # delay = a slow replica skewing the router's load view
-                _faults.fire("replica.route", key=rep.name)
-                scores = rep.score_fn(requests)
+                # delay = a slow replica skewing the router's load view.
+                # The hop span inherits the batch identity from the
+                # batcher's ambient span context, so a trace id leads
+                # through every attempted replica — failed hops record
+                # with error=True (docs/OBSERVABILITY.md).
+                with obs.span(
+                    "replica.hop", cat="frontend",
+                    replica=rep.name, attempt=attempt,
+                ):
+                    _faults.fire("replica.route", key=rep.name)
+                    scores = rep.score_fn(requests)
             except BaseException as e:  # noqa: BLE001 — failover decides
                 last_err = e
+                _reqtrace.note(
+                    kind="hop", replica=rep.name,
+                    attempt=attempt, error=True,
+                )
                 with rep._lock:
                     rep.failures += 1
                 if rep.breaker.record_failure():
+                    ctx = obs.current_span_context() or {}
                     obs.emit_event(
                         "replica.down", cat="frontend",
                         replica=rep.name, error=type(e).__name__,
+                        **(
+                            {"batch_id": ctx["batch_id"]}
+                            if "batch_id" in ctx else {}
+                        ),
                     )
                 obs.registry().inc(f"replica.failures.{rep.name}")
                 if t_fail is None:
@@ -250,6 +269,9 @@ class ReplicaRouter:
             finally:
                 with rep._lock:
                     rep.outstanding -= 1
+            _reqtrace.note(
+                kind="hop", replica=rep.name, attempt=attempt, error=False,
+            )
             rep.breaker.record_success()
             obs.registry().inc(f"replica.batches.{rep.name}")
             if t_fail is not None:

@@ -48,9 +48,11 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
 from photon_ml_tpu import obs
+from photon_ml_tpu.obs import reqtrace as _reqtrace
 from photon_ml_tpu.resilience import faults as _faults
 from photon_ml_tpu.serving.batcher import Backpressure, DeadlineExceeded
 from photon_ml_tpu.serving.engine import ScoreRequest
@@ -237,26 +239,38 @@ class FrontendServer:
                 pass
 
     async def _serve_lines(self, conn: _Conn, first: bytes) -> None:
+        # wire-read timing starts at each frame's FIRST byte (the
+        # untimed 1-byte read absorbs client think-time between frames),
+        # so wire_read_ms is transfer time, not connection idle
         reg = obs.registry()
-        rest = await conn.reader.readline()
-        line = first + rest
-        while line:
-            if line.strip():
-                reg.inc("frontend.frames")
-                reg.inc("frontend.bytes_in", len(line))
-                await self._dispatch(conn, line)
-            line = await conn.reader.readline()
+        while True:
+            t0 = time.perf_counter()
+            rest = await conn.reader.readline()
+            wire_ms = (time.perf_counter() - t0) * 1e3
+            line = first + rest
+            first = b""
+            if not line:
+                return
             if len(line) > self.max_frame_bytes:
                 await conn.send({
                     "error": "frame too large",
                     "code": "INVALID_ARGUMENT",
                 })
                 return
+            if line.strip():
+                reg.inc("frontend.frames")
+                reg.inc("frontend.bytes_in", len(line))
+                await self._dispatch(conn, line, wire_ms)
+            try:
+                first = await conn.reader.readexactly(1)
+            except asyncio.IncompleteReadError:
+                return
 
     async def _serve_binary(self, conn: _Conn, first: bytes) -> None:
         reg = obs.registry()
-        head = first + await conn.reader.readexactly(3)
         while True:
+            t0 = time.perf_counter()
+            head = first + await conn.reader.readexactly(4 - len(first))
             (n,) = _LEN.unpack(head)
             if n > self.max_frame_bytes:
                 await conn.send({
@@ -266,12 +280,15 @@ class FrontendServer:
                 })
                 return
             payload = await conn.reader.readexactly(n)
+            wire_ms = (time.perf_counter() - t0) * 1e3
             reg.inc("frontend.frames")
             reg.inc("frontend.bytes_in", n + 4)
-            await self._dispatch(conn, payload)
-            head = await conn.reader.readexactly(4)
+            await self._dispatch(conn, payload, wire_ms)
+            first = await conn.reader.readexactly(1)
 
-    async def _dispatch(self, conn: _Conn, raw: bytes) -> None:
+    async def _dispatch(
+        self, conn: _Conn, raw: bytes, wire_ms: float = 0.0
+    ) -> None:
         """Parse one frame and start its reply task — the reader loop
         moves straight on to the next frame (the multiplexing)."""
         reg = obs.registry()
@@ -289,11 +306,29 @@ class FrontendServer:
         if "cmd" in obj:
             await self._reply_admin(conn, rid, obj)
             return
+        # request causality (docs/OBSERVABILITY.md): accept the client's
+        # `trace` field or issue one here — the id rides the tenant
+        # envelope into the batcher and comes back in every reply, so
+        # `photon-obs request <id>` can rebuild the timeline
+        trace, issued = _reqtrace.ensure_trace_id(obj.get("trace"))
+        if issued:
+            reg.inc("frontend.traces_issued")
+        tracer = obs.get_tracer()
+        if tracer is not None:
+            # retro wire-read span: the frame's transfer time, stamped
+            # now that its trace id is known
+            end_us = tracer.now_us()
+            dur_us = max(wire_ms, 0.0) * 1e3
+            tracer.add_span(
+                "frontend.wire_read", end_us - dur_us, dur_us,
+                cat="frontend",
+                args={"trace": trace, "bytes": len(raw)},
+            )
         tenant = obj.get("tenant", self.default_tenant)
         # envelope-level deadline/priority override the tenant defaults
         # for every request in the frame (compat with the old per-line
         # protocol's fields)
-        kw = {}
+        kw = {"trace": trace, "wire_read_ms": wire_ms}
         if obj.get("deadline_ms") is not None:
             kw["deadline_ms"] = float(obj["deadline_ms"])
         if obj.get("priority") is not None:
@@ -309,14 +344,15 @@ class FrontendServer:
         except BaseException as e:  # noqa: BLE001 — answered on the wire
             reg.inc("frontend.rejected")
             await conn.send({
-                "id": rid, "error": str(e), "code": _error_code(e),
+                "id": rid, "trace": trace,
+                "error": str(e), "code": _error_code(e),
             })
             return
         wrapped = [
             asyncio.wrap_future(f, loop=self._loop) for f in futs
         ]
         task = self._loop.create_task(
-            self._reply(conn, rid, obj, wrapped)
+            self._reply(conn, rid, obj, wrapped, trace)
         )
         # keep a reference so shutdown grace can await it
         self._conn_tasks.add(task)
@@ -340,15 +376,33 @@ class FrontendServer:
             out["id"] = rid
         await conn.send(out)
 
-    async def _reply(self, conn: _Conn, rid, obj: dict, futs) -> None:
+    @staticmethod
+    def _note_reply_write(trace: str, write_s: float, nbytes: int) -> None:
+        """Retro-emit the reply-write segment — the trailing edge of the
+        request timeline (``photon-obs request`` closes the gap between
+        the device call and the bytes leaving the host with it)."""
+        tracer = obs.get_tracer()
+        if tracer is None:
+            return
+        end_us = tracer.now_us()
+        dur_us = max(write_s, 0.0) * 1e6
+        tracer.add_span(
+            "frontend.reply_write", end_us - dur_us, dur_us,
+            cat="frontend", args={"trace": trace, "bytes": nbytes},
+        )
+
+    async def _reply(self, conn: _Conn, rid, obj: dict, futs,
+                     trace: str) -> None:
         reg = obs.registry()
         stream = bool(obj.get("stream")) and "batch" in obj
         single = "batch" not in obj
+        write_s = 0.0
+        wrote = 0
         try:
             if stream:
                 done = 0
                 for seq, f in enumerate(futs):
-                    msg = {"id": rid, "seq": seq}
+                    msg = {"id": rid, "seq": seq, "trace": trace}
                     try:
                         msg["score"] = await f
                         done += 1
@@ -356,11 +410,20 @@ class FrontendServer:
                         msg["error"] = str(e)
                         msg["code"] = _error_code(e)
                         reg.inc("frontend.rejected")
+                    t0 = time.perf_counter()
                     sent = await conn.send(msg)
+                    write_s += time.perf_counter() - t0
+                    wrote += sent
                     reg.inc("frontend.bytes_out", sent)
-                sent = await conn.send({"id": rid, "done": done})
+                t0 = time.perf_counter()
+                sent = await conn.send({
+                    "id": rid, "done": done, "trace": trace,
+                })
+                write_s += time.perf_counter() - t0
+                wrote += sent
                 reg.inc("frontend.bytes_out", sent)
                 reg.inc("frontend.replies")
+                self._note_reply_write(trace, write_s, wrote)
                 return
             scores, errors = [], []
             for f in futs:
@@ -386,9 +449,13 @@ class FrontendServer:
                 if errors:
                     reg.inc("frontend.rejected", len(errors))
                     msg["errors"] = errors
+            msg["trace"] = trace
+            t0 = time.perf_counter()
             sent = await conn.send(msg)
+            write_s += time.perf_counter() - t0
             reg.inc("frontend.bytes_out", sent)
             reg.inc("frontend.replies")
+            self._note_reply_write(trace, write_s, sent)
         except (ConnectionError, asyncio.CancelledError):
             pass  # client went away; scoring already happened
 

@@ -216,12 +216,17 @@ class TenantManager:
         *,
         deadline_ms: Optional[float] = None,
         priority: Optional[int] = None,
+        trace: Optional[str] = None,
+        wire_read_ms: Optional[float] = None,
     ) -> Future:
         """Admit one request under the tenant's policy; the Future
         resolves to its float score. ``deadline_ms``/``priority``
         override the tenant's defaults for this one request (the compat
-        channel's per-request fields keep working through the shared
-        queue). Raises :class:`UnknownTenant`, :class:`Backpressure`
+        channel's per-line fields keep working through the shared
+        queue); ``trace``/``wire_read_ms`` thread the frontend's
+        request-causality fields through the envelope unchanged
+        (docs/OBSERVABILITY.md). Raises :class:`UnknownTenant`,
+        :class:`Backpressure`
         (queue full past the shed policy, or the quota seam failing
         closed), or surfaces :class:`DeadlineExceeded` through the
         Future like the bare batcher does."""
@@ -256,6 +261,8 @@ class TenantManager:
                 ),
                 priority=st.priority if priority is None else int(priority),
                 over_quota=over,
+                trace=trace,
+                wire_read_ms=wire_read_ms,
             )
         except Backpressure:
             with st._lock:

@@ -33,9 +33,23 @@ from typing import Optional
 from photon_ml_tpu.obs import collectives
 from photon_ml_tpu.obs import convergence
 from photon_ml_tpu.obs import dist
+from photon_ml_tpu.obs import exemplars
 from photon_ml_tpu.obs import quality
+from photon_ml_tpu.obs import reqtrace
 from photon_ml_tpu.obs import sketches
 from photon_ml_tpu.obs import taxonomy
+from photon_ml_tpu.obs.exemplars import (
+    ExemplarStore,
+    install_store as install_exemplar_store,
+    set_store as set_exemplar_store,
+    store as exemplar_store,
+)
+from photon_ml_tpu.obs.reqtrace import (
+    ensure_trace_id,
+    new_trace_id,
+    reconstruct_timeline,
+    valid_trace_id,
+)
 from photon_ml_tpu.obs.convergence import (
     ConvergenceReport,
     ConvergenceTracker,
@@ -202,6 +216,18 @@ __all__ = [
     "install_fingerprint_collector",
     "uninstall_fingerprint_collector",
     "try_load_fingerprint",
+    # request-trace propagation + reconstruction (obs.reqtrace)
+    "reqtrace",
+    "ensure_trace_id",
+    "new_trace_id",
+    "reconstruct_timeline",
+    "valid_trace_id",
+    # tail-based exemplar sampling (obs.exemplars)
+    "exemplars",
+    "ExemplarStore",
+    "exemplar_store",
+    "install_exemplar_store",
+    "set_exemplar_store",
 ]
 
 

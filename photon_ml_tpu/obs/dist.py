@@ -191,11 +191,14 @@ def _pick_sync_id(per_shard: Sequence[Dict[str, dict]]) -> Optional[str]:
 def _dedupe_key(ev: dict) -> tuple:
     """Identity of one event for duplicate dropping: phase, name, track,
     window, (for async/flow phases) the explicit id, and the request id
-    when the span carries one in args. Re-read shards and duplicated
-    span ids collapse; distinct same-name spans at different instants
-    survive — and two replicas' ``serving.request`` spans that happen to
-    share a (pid, tid, ts, dur) window are kept apart by their
-    instance-namespaced request ids instead of being wrongly collapsed."""
+    / trace id when the span carries one in args. Re-read shards and
+    duplicated span ids collapse; distinct same-name spans at different
+    instants survive — and two replicas' ``serving.request`` spans that
+    happen to share a (pid, tid, ts, dur) window are kept apart by their
+    instance-namespaced request ids (or the frontend's per-request trace
+    ids, which wire_read/reply_write spans carry instead) rather than
+    being wrongly collapsed."""
+    args = ev.get("args") or {}
     return (
         ev.get("ph"),
         ev.get("name"),
@@ -204,7 +207,8 @@ def _dedupe_key(ev: dict) -> tuple:
         round(float(ev.get("ts", 0.0)), 3),
         round(float(ev.get("dur", 0.0)), 3),
         ev.get("id"),
-        (ev.get("args") or {}).get("request_id"),
+        args.get("request_id"),
+        args.get("trace"),
     )
 
 

@@ -107,17 +107,30 @@ class LatencyHistogram:
     def _edge(self, i: int) -> float:
         return math.exp(self._lo + self._span * i / self._bins)
 
+    def bucket_index(self, ms: float) -> int:
+        """The bucket a latency lands in (0 = underflow, bins+1 =
+        overflow). Exposed so exemplar rings (obs/exemplars.py) attach
+        trace ids to exactly the bucket this histogram counted."""
+        if ms <= 0:
+            return 0
+        f = (math.log(ms) - self._lo) / self._span
+        return min(max(int(f * self._bins) + 1, 0), self._bins + 1)
+
+    def bucket_le(self, i: int) -> float:
+        """Inclusive upper edge of bucket ``i`` (``inf`` for overflow) —
+        the Prometheus-style ``le`` label exemplar lookups key on."""
+        if i <= 0:
+            return self._edge(0)
+        if i >= self._bins + 1:
+            return math.inf
+        return self._edge(i)
+
     def record(self, ms: float) -> None:
         self.count += 1
         self.sum_ms += ms
         if ms > self.max_ms:
             self.max_ms = ms
-        if ms <= 0:
-            b = 0
-        else:
-            f = (math.log(ms) - self._lo) / self._span
-            b = min(max(int(f * self._bins) + 1, 0), self._bins + 1)
-        self.counts[b] += 1
+        self.counts[self.bucket_index(ms)] += 1
 
     def quantile(self, q: float) -> float:
         """q in [0, 1] -> latency in ms (0.0 when empty)."""

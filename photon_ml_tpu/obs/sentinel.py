@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import platform as _platform
 import re
 import statistics
 from typing import Dict, List, Optional, Sequence
@@ -40,6 +42,8 @@ __all__ = [
     "flatten_record",
     "metric_direction",
     "metric_floor",
+    "host_fingerprint",
+    "env_change_note",
     "Baseline",
     "Regression",
     "fit_baselines",
@@ -61,7 +65,11 @@ UNTRACKED = 0
 
 # First match wins; order: untracked overrides, then higher, then lower.
 _DIRECTION_RULES = (
-    # environment / identity noise, not code performance
+    # environment / identity noise, not code performance. host.* is the
+    # environment FINGERPRINT (host_fingerprint below): identity, never
+    # a metric — but run_sentinel uses it to annotate regressions that
+    # coincide with an environment change vs the history
+    (re.compile(r"(^|\.)host\."), UNTRACKED),
     (re.compile(r"(^|\.)rtt_ms"), UNTRACKED),
     (re.compile(r"dense_wall_incl_rtt_s$"), UNTRACKED),
     (re.compile(r"max_dw"), UNTRACKED),
@@ -220,6 +228,52 @@ def metric_floor(name: str) -> Optional[float]:
     return None
 
 
+def host_fingerprint() -> Dict[str, object]:
+    """The environment identity stamped into every BENCH record's
+    ``extra.host``: enough to tell "the code regressed" apart from "the
+    bench box changed under us". Never initializes a jax backend — the
+    version string is importable without one."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # noqa: BLE001 — fingerprints must never fail
+        jax_version = None
+    return {
+        "cpu_count": os.cpu_count(),
+        "platform": _platform.platform(),
+        "machine": _platform.machine(),
+        "python": _platform.python_version(),
+        "jax": jax_version,
+    }
+
+
+def env_change_note(history: Sequence[dict], current: dict) -> str:
+    """Human-readable diff of ``current``'s host fingerprint vs the most
+    recent history record that carries one; ``""`` when nothing changed
+    or no fingerprinted history exists. ``history``/``current`` are RAW
+    parsed BENCH records (not flattened — flattening drops the strings
+    the fingerprint mostly consists of)."""
+    cur_fp = (current.get("extra") or {}).get("host")
+    if not isinstance(cur_fp, dict):
+        return ""
+    prev_fp = None
+    for rec in reversed(list(history)):
+        fp = (rec.get("extra") or {}).get("host")
+        if isinstance(fp, dict):
+            prev_fp = fp
+            break
+    if prev_fp is None:
+        return ""
+    changes = []
+    for key in sorted(set(prev_fp) | set(cur_fp)):
+        if prev_fp.get(key) != cur_fp.get(key):
+            changes.append(
+                f"{key} {prev_fp.get(key)!r}->{cur_fp.get(key)!r}"
+            )
+    return ", ".join(changes)
+
+
 def flatten_record(parsed: dict) -> Dict[str, float]:
     """Parsed BENCH record -> flat ``{dotted.metric: float}``. ``value``
     keeps its name; ``extra`` flattens recursively; non-numeric leaves
@@ -263,17 +317,23 @@ class Regression:
     metric: str
     current: float
     baseline: Baseline
+    # non-empty when the record's host fingerprint differs from the
+    # history's — the regression may be the box, not the code
+    env_note: str = ""
 
     def describe(self) -> str:
         arrow = (
             "below" if self.baseline.direction == HIGHER_IS_BETTER else "above"
         )
-        return (
+        out = (
             f"{self.metric}: {self.current:g} is {arrow} the tolerated "
             f"bound {self.baseline.bound():g} (median {self.baseline.median:g}"
             f" over {self.baseline.n_samples} records, band "
             f"±{self.baseline.tol:.0%})"
         )
+        if self.env_note:
+            out += f" [environment changed vs history: {self.env_note}]"
+        return out
 
 
 def fit_baselines(
@@ -387,17 +447,23 @@ def run_sentinel(
     mad_k: float = DEFAULT_MAD_K,
 ):
     """History files + a current parsed record -> (regressions,
-    fitted baselines, n_history_records)."""
-    history = []
+    fitted baselines, n_history_records). Regressions carry an
+    ``env_note`` when the current host fingerprint (``extra.host``)
+    differs from the history's — a flag that may be the box, not the
+    code."""
+    raw_history = []
     for p in history_paths:
         rec = load_bench_record(p)
         if rec is not None:
-            history.append(flatten_record(rec))
+            raw_history.append(rec)
     baselines = fit_baselines(
-        history,
+        [flatten_record(r) for r in raw_history],
         min_samples=min_samples,
         tolerance=tolerance,
         mad_k=mad_k,
     )
     regs = check_record(flatten_record(current), baselines)
-    return regs, baselines, len(history)
+    note = env_change_note(raw_history, current)
+    if note:
+        regs = [dataclasses.replace(r, env_note=note) for r in regs]
+    return regs, baselines, len(raw_history)

@@ -86,7 +86,8 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         "serving.cache",
         r"serving\.cache\.[a-z_]+",
         "tiered HBM/host entity cache: hit/miss/promotion/demotion "
-        "counters, tier-error counter (serving/cache.py)",
+        "counters, tier-error counter, per-batch miss/promotion "
+        "instants carrying batch_id for trace joins (serving/cache.py)",
     ),
     (
         "serving.shard",
@@ -172,7 +173,8 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         "frontend",
         r"frontend\.[a-z_]+(\..+)?",
         "async front end: connection/frame/reply counters, rejected "
-        "(RESOURCE_EXHAUSTED answers), bytes in/out "
+        "(RESOURCE_EXHAUSTED answers), bytes in/out, per-request "
+        "wire_read/reply_write spans + traces_issued counter "
         "(frontend/server.py, docs/FRONTEND.md)",
     ),
     (
@@ -185,7 +187,8 @@ TAXONOMY: Tuple[Tuple[str, str, str], ...] = (
         "replica",
         r"replica\.[a-z_]+(\..+)?",
         "replica router: per-replica batch/failure counters, "
-        "replica.down events, failover_ms histogram, exhausted "
+        "replica.down events, per-attempt replica.hop spans (trace "
+        "failover joins), failover_ms histogram, exhausted "
         "counter (frontend/replicas.py)",
     ),
 )

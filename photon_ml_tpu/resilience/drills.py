@@ -1930,6 +1930,157 @@ def drill_replica_loss(smoke: bool = True) -> dict:
         tm.drain(timeout=10.0)
 
 
+# ---------------------------------------------------------------------------
+# drill: trace survival under replica loss (docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+
+def drill_trace_loss(smoke: bool = True) -> dict:
+    """Request-causality survival drill: traced load flows through the
+    full fabric (frontend -> tenant envelope -> shared batcher ->
+    replica router) while one replica is killed mid-stream, then BOTH
+    are killed for a window. Afterwards the event log must reconstruct:
+
+    - a COMPLETE timeline for every request that scored (including the
+      failover-touched ones, flagged ``failover`` with the failed hop
+      recorded),
+    - an explicitly-marked TRUNCATED timeline for every request the
+      fabric lost (errored batches — no silent disappearance),
+    - ZERO orphan spans: every request-scoped record is claimed by
+      exactly the timeline of the trace that caused it,
+    - 100%-kept exemplars for the error/failover classes, so the rings
+      hand back exactly the trace ids worth investigating.
+    """
+    import json as _json
+    import tempfile as _tempfile
+
+    from photon_ml_tpu.frontend.replicas import ReplicaRouter
+    from photon_ml_tpu.frontend.server import (
+        FrontendClient,
+        FrontendServer,
+    )
+    from photon_ml_tpu.frontend.tenants import TenantManager
+    from photon_ml_tpu.obs import exemplars as _exemplars
+    from photon_ml_tpu.obs import reqtrace as _reqtrace
+
+    n_phase = 16 if smoke else 64
+
+    def scorer(reqs):
+        return np.asarray(
+            [float(r.offset) for r in reqs], dtype=np.float64
+        )
+
+    prev_store = _exemplars.set_store(
+        _exemplars.ExemplarStore(fast_fraction=1.0, ring_size=64)
+    )
+    td = _tempfile.mkdtemp(prefix="trace-loss-")
+    try:
+        with obs.trace(td):
+            router = ReplicaRouter(
+                [("r0", scorer), ("r1", scorer)],
+                failure_threshold=2,
+                backoff_s=30.0,  # the corpse stays benched for the drill
+            )
+            tm = TenantManager(max_batch=8, max_wait_ms=0.5)
+            tm.add_tenant("t0", router.score)
+            with FrontendServer(tm.submit, default_tenant="t0") as srv:
+                with FrontendClient("127.0.0.1", srv.port) as cli:
+                    # phase A: clean traced load
+                    for i in range(n_phase):
+                        r = cli.call({
+                            "trace": f"tA-{i}", "offset": float(i),
+                            "features": {},
+                        })
+                        assert r.get("score") == float(i), r
+                    # phase B: r0 dies mid-stream -> failover, requests
+                    # still complete
+                    with inject(FaultSpec(
+                        "replica.route", "raise", nth=1, count=-1,
+                        key="r0",
+                    )):
+                        for i in range(n_phase):
+                            r = cli.call({
+                                "trace": f"tB-{i}", "offset": float(i),
+                                "features": {},
+                            })
+                            assert r.get("score") == float(i), r
+                    # phase C: EVERY replica dies -> the fabric answers
+                    # with errors, never silently drops
+                    with inject(FaultSpec(
+                        "replica.route", "raise", nth=1, count=-1,
+                    )):
+                        for i in range(n_phase):
+                            r = cli.call({
+                                "trace": f"tC-{i}", "offset": float(i),
+                                "features": {},
+                            })
+                            assert "error" in r and "score" not in r, r
+            tm.drain(timeout=10.0)
+
+        with open(os.path.join(td, "events.jsonl"), encoding="utf-8") as f:
+            records = [_json.loads(line) for line in f if line.strip()]
+
+        timelines = []
+        failovers = 0
+        for tid in _reqtrace.trace_ids(records):
+            tl = _reqtrace.reconstruct_timeline(records, tid)
+            assert tl is not None, tid
+            timelines.append(tl)
+            phase = tl["trace"][:2]
+            if phase in ("tA", "tB"):
+                assert tl["complete"] and not tl["truncated"], (
+                    f"{tid}: scored request must reconstruct complete: "
+                    f"{tl['events']}"
+                )
+            else:
+                assert tl["truncated"] and not tl["complete"], (
+                    f"{tid}: lost request must be explicitly truncated"
+                )
+                assert tl["error"], f"{tid}: truncation carries no error"
+            if tl["failover"]:
+                failovers += 1
+                assert any(h["error"] for h in tl["hops"]), (
+                    f"{tid}: failover flag without a failed hop"
+                )
+        assert len(timelines) == 3 * n_phase, (
+            f"{len(timelines)} timelines for {3 * n_phase} requests"
+        )
+        assert failovers >= 1, "no failover-touched timeline captured"
+        orphans = _reqtrace.find_orphans(records, timelines)
+        assert not orphans, (
+            f"{len(orphans)} orphan record(s): "
+            f"{[o.get('name') for o in orphans[:5]]}"
+        )
+
+        st = _exemplars.store()
+        error_traces = {
+            e["trace"] for e in st.lookup(cls="error")
+        }
+        assert any(t.startswith("tC-") for t in error_traces), (
+            "error exemplar ring holds no lost-request trace"
+        )
+        failover_traces = {
+            e["trace"] for e in st.lookup(cls="failover")
+        }
+        assert any(t.startswith("tB-") for t in failover_traces), (
+            "failover exemplar ring holds no failover-touched trace"
+        )
+        return {
+            "requests": 3 * n_phase,
+            "complete_timelines": sum(
+                1 for t in timelines if t["complete"]
+            ),
+            "truncated_timelines": sum(
+                1 for t in timelines if t["truncated"]
+            ),
+            "failover_timelines": failovers,
+            "orphan_records": 0,
+            "error_exemplars": len(error_traces),
+        }
+    finally:
+        _exemplars.set_store(prev_store)
+
+
 DRILLS: Dict[str, Callable[[bool], dict]] = {
     "site_registry": drill_site_registry,
     "serving_score": drill_serving_score,
@@ -1963,6 +2114,11 @@ DRILLS: Dict[str, Callable[[bool], dict]] = {
     # requests, the corpse's breaker opens, SLO ledgers stay honest,
     # and the recovered replica rejoins after its backoff
     "replica_loss": drill_replica_loss,
+    # request causality (docs/OBSERVABILITY.md): mid-stream replica kill
+    # under traced load -> complete timelines for everything that
+    # scored, explicitly-truncated ones for what the fabric lost, zero
+    # orphan spans, error/failover exemplars 100%-kept
+    "trace_loss": drill_trace_loss,
     # the self-healing lifecycle loop (docs/LIFECYCLE.md): drift alarm
     # -> entity-keyed warm-started retrain with admitted entities ->
     # manifest-gated export -> breaker-guarded hot-reload, zero dropped

@@ -48,6 +48,8 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu import obs
+from photon_ml_tpu.obs import exemplars as _exemplars
+from photon_ml_tpu.obs import reqtrace as _reqtrace
 from photon_ml_tpu.serving.stats import ServingStats, SloTracker
 
 
@@ -70,10 +72,12 @@ _INSTANCE_IDS = itertools.count(1)
 
 class _Item:
     __slots__ = ("request", "future", "enqueued", "rid", "deadline",
-                 "priority", "over_quota")
+                 "priority", "over_quota", "trace", "wire_ms")
 
     def __init__(self, request, rid: int = 0, deadline: Optional[float] = None,
-                 priority: int = 0, over_quota: bool = False):
+                 priority: int = 0, over_quota: bool = False,
+                 trace: Optional[str] = None,
+                 wire_ms: Optional[float] = None):
         self.request = request
         self.future: Future = Future()
         self.enqueued = time.perf_counter()
@@ -81,6 +85,11 @@ class _Item:
         self.deadline = deadline  # absolute perf_counter seconds, or None
         self.priority = priority
         self.over_quota = over_quota
+        # request-causality fields (docs/OBSERVABILITY.md): the frontend-
+        # issued trace id and the wire-read time it measured for this
+        # request's frame, stamped onto the serving.request retro-span
+        self.trace = trace
+        self.wire_ms = wire_ms
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -380,6 +389,8 @@ class MicroBatcher:
         deadline_ms: Optional[float] = None,
         priority: int = 0,
         over_quota: bool = False,
+        trace: Optional[str] = None,
+        wire_read_ms: Optional[float] = None,
     ) -> Future:
         """Enqueue one request; the Future resolves to its float score.
 
@@ -391,7 +402,10 @@ class MicroBatcher:
         policy); ties never shed. ``over_quota``: the submitting tenant
         is past its admission quota — the request still scores when there
         is room, but it is first in line to shed and may itself only
-        displace other over-quota work (docs/FRONTEND.md). Raises
+        displace other over-quota work (docs/FRONTEND.md). ``trace`` /
+        ``wire_read_ms``: the frontend-issued trace id and wire-read
+        time, carried through to the ``serving.request`` retro-span and
+        the exemplar store (docs/OBSERVABILITY.md). Raises
         :class:`Backpressure` when draining or when admission control
         cannot make room."""
         if self._draining.is_set():
@@ -403,6 +417,8 @@ class MicroBatcher:
             deadline=(now + deadline_ms / 1e3) if deadline_ms else None,
             priority=priority,
             over_quota=over_quota,
+            trace=trace,
+            wire_ms=wire_read_ms,
         )
         try:
             self._q.put_nowait(item)
@@ -452,11 +468,33 @@ class MicroBatcher:
                 queue_capacity=self._q.maxsize,
             )
 
+    @staticmethod
+    def _offer_exemplar(
+        item: _Item,
+        latency_s: float,
+        outcome: str,
+        degraded: bool = False,
+        failover: bool = False,
+    ) -> None:
+        """Feed the finished request to the process exemplar store, if
+        one is installed — errors/expiries/sheds are 100%-kept there
+        (obs/exemplars.py); one global read when sampling is off."""
+        st = _exemplars.store()
+        if st is not None:
+            st.record(
+                item.trace,
+                latency_s * 1e3,
+                outcome=outcome,
+                degraded=degraded,
+                failover=failover,
+            )
+
     def _expire(self, item: _Item) -> None:
         now = time.perf_counter()
         self.stats.record_expired()
         if self.slo is not None:
             self.slo.record(now - item.enqueued, ok=False)
+        self._offer_exemplar(item, now - item.enqueued, "expired")
         if not item.future.done():
             item.future.set_exception(
                 DeadlineExceeded(
@@ -471,6 +509,9 @@ class MicroBatcher:
             self.slo.record(
                 time.perf_counter() - item.enqueued, ok=False
             )
+        self._offer_exemplar(
+            item, time.perf_counter() - item.enqueued, "shed"
+        )
         if not item.future.done():
             why = "over quota" if item.over_quota else \
                 f"priority {item.priority}"
@@ -575,8 +616,10 @@ class MicroBatcher:
             # ambient span context: the engine's `serving.score` span
             # (and anything below it) inherits the batch identity, so a
             # request id found in a trace leads straight to its device
-            # call
-            with obs.span_context(
+            # call. The note channel carries replica-hop reports back up
+            # (obs/reqtrace.py) — how the per-request retro-span learns
+            # its batch was failover-touched.
+            with _reqtrace.collect_notes() as hop_notes, obs.span_context(
                 batch_id=bid, batch_size=len(batch), degraded=degraded
             ):
                 scores = np.asarray(
@@ -585,12 +628,39 @@ class MicroBatcher:
         except BaseException as e:  # noqa: BLE001 — futures carry the error
             self.stats.record_error()
             t_err = time.perf_counter()
+            failover = any(n.get("error") for n in hop_notes)
+            tracer = obs.get_tracer()
             for it in batch:
                 if self.slo is not None:
                     self.slo.record(t_err - it.enqueued, ok=False)
+                self._offer_exemplar(
+                    it, t_err - it.enqueued, "error",
+                    degraded=degraded, failover=failover,
+                )
+                if tracer is not None:
+                    # the failed request still gets its retro-span —
+                    # carrying the error instead of segments — so its
+                    # timeline reconstructs as explicitly TRUNCATED and
+                    # the batch's hop/down records are never orphaned
+                    end_us = tracer.now_us()
+                    dur_us = (t_err - it.enqueued) * 1e6
+                    args = {
+                        "request_id": it.rid,
+                        "batch_id": bid,
+                        "degraded": degraded,
+                        "failover": failover,
+                        "error": type(e).__name__,
+                    }
+                    if it.trace is not None:
+                        args["trace"] = it.trace
+                    tracer.add_span(
+                        "serving.request", end_us - dur_us, dur_us,
+                        cat="serving", args=args,
+                    )
                 if not it.future.done():
                     it.future.set_exception(e)
             return
+        failover = any(n.get("error") for n in hop_notes)
         t1 = time.perf_counter()
         self.stats.record_batch(len(batch), t1 - t0)
         if degraded:
@@ -603,28 +673,38 @@ class MicroBatcher:
             self.stats.record_request_latency(latency)
             if self.slo is not None:
                 self.slo.record(latency)
+            self._offer_exemplar(
+                it, latency, "ok", degraded=degraded, failover=failover
+            )
             if tracer is not None:
                 # request-scoped trace: one retro-emitted span per
                 # request covering enqueue -> result, decomposed into
-                # queue-wait (sitting in the bounded queue), batch
-                # assembly (the coalescing window), and the device call
+                # wire read (when the frontend fed it), queue-wait
+                # (sitting in the bounded queue), batch assembly (the
+                # coalescing window), and the device call
                 end_us = tracer.now_us()
                 dur_us = latency * 1e6
+                args = {
+                    "request_id": it.rid,
+                    "batch_id": bid,
+                    "degraded": degraded,
+                    "failover": failover,
+                    "queue_wait_ms": round(
+                        max(t_first - it.enqueued, 0.0) * 1e3, 4
+                    ),
+                    "assembly_ms": round(assembly_ms, 4),
+                    "device_ms": round(device_ms, 4),
+                }
+                if it.trace is not None:
+                    args["trace"] = it.trace
+                if it.wire_ms is not None:
+                    args["wire_read_ms"] = round(it.wire_ms, 4)
                 tracer.add_span(
                     "serving.request",
                     end_us - dur_us,
                     dur_us,
                     cat="serving",
-                    args={
-                        "request_id": it.rid,
-                        "batch_id": bid,
-                        "degraded": degraded,
-                        "queue_wait_ms": round(
-                            max(t_first - it.enqueued, 0.0) * 1e3, 4
-                        ),
-                        "assembly_ms": round(assembly_ms, 4),
-                        "device_ms": round(device_ms, 4),
-                    },
+                    args=args,
                 )
             if not it.future.done():
                 it.future.set_result(float(s))

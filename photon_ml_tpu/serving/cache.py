@@ -388,6 +388,30 @@ class TieredEntityCache:
         misses = int(np.count_nonzero(known) - hits)
         if self.stats is not None:
             self.stats.record_cache(hits, misses)
+        if misses:
+            # request-causality breadcrumb (docs/OBSERVABILITY.md): the
+            # miss inherits the batch identity from the batcher's
+            # ambient span context, so a traced request that scored
+            # degraded shows WHY — which tier missed, how many entities.
+            # Rides the batched flush (no per-miss fsync on the scoring
+            # path); instant tracer write only when tracing is on.
+            tracer = obs.get_tracer()
+            if tracer is not None:
+                ctx = obs.current_span_context() or {}
+                tracer.add_instant(
+                    "serving.cache.miss",
+                    cat="serving",
+                    args={
+                        "re_key": self.re_key,
+                        "hits": hits,
+                        "misses": misses,
+                        **(
+                            {"batch_id": ctx["batch_id"]}
+                            if "batch_id" in ctx else {}
+                        ),
+                    },
+                    flush=False,
+                )
         if self.admission_log is not None and missed.size:
             self.admission_log.note(
                 self.re_key,
@@ -489,6 +513,18 @@ class TieredEntityCache:
             total += len(pairs)
         if total and self.stats is not None:
             self.stats.record_promotions(total)
+        if total:
+            tracer = obs.get_tracer()
+            if tracer is not None:
+                # promotion runs on the async worker, outside any batch
+                # context — the event still lands on the shared timeline
+                # so a miss followed by a promotion reads causally
+                tracer.add_instant(
+                    "serving.cache.promotion",
+                    cat="serving",
+                    args={"re_key": self.re_key, "promoted": total},
+                    flush=False,
+                )
         return total
 
     def flush(self, timeout: float = 10.0) -> None:

@@ -874,6 +874,7 @@ class ScoringEngine:
         }
         ents_p = {}
         params = self._params
+        unknown = 0
         if not fixed_only:
             translated, params = self._translate_entities(entity_ids)
             for rk in self._re_keys:
@@ -883,6 +884,10 @@ class ScoringEngine:
                     if col is None
                     else np.asarray(col, np.int32)
                 )
+                # rows scoring cold-start on this RE type: the per-trace
+                # timeline needs this to explain a degraded-looking score
+                # without any fixed_only/cache-miss event in sight
+                unknown += int(np.count_nonzero(col < 0))
                 ents_p[rk] = _pad_rows(col, bucket, fill=-1)
         compiled = self._ensure_compiled(
             bucket,
@@ -895,6 +900,7 @@ class ScoringEngine:
             bucket=bucket,
             rows=n,
             fixed_only=fixed_only,
+            unknown_entities=unknown,
             sparse_kernel=self._sparse_kernel,
         ) as sp:
             t0 = time.perf_counter()

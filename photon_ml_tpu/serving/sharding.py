@@ -265,18 +265,25 @@ def route_batch(
     if tracer is not None:
         # retro-emitted stage spans (the batcher's serving.request idiom):
         # group = ownership lookup + placements + fault probes + RE ids,
-        # pad = bucket sizing + slot assignment
+        # pad = bucket sizing + slot assignment. Retro add_span bypasses
+        # the ambient-context merge obs.span does, so the batch identity
+        # (the trace join key — docs/OBSERVABILITY.md) rides explicitly.
+        ctx = obs.current_span_context() or {}
+        ctx_args = (
+            {"batch_id": ctx["batch_id"]} if "batch_id" in ctx else {}
+        )
         end_us = tracer.now_us()
         pad_us = (t_end - t_pad) * 1e6
         group_us = (t_pad - t_group) * 1e6
         tracer.add_span(
             "serving.route.group", end_us - pad_us - group_us, group_us,
             cat="serving", args={"rows": int(num_rows),
-                                 "placements": int(p_row.size)},
+                                 "placements": int(p_row.size),
+                                 **ctx_args},
         )
         tracer.add_span(
             "serving.route.pad", end_us - pad_us, pad_us,
-            cat="serving", args={"bucket": int(bucket)},
+            cat="serving", args={"bucket": int(bucket), **ctx_args},
         )
 
     return RoutedBatch(

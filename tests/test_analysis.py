@@ -35,7 +35,10 @@ pytestmark = pytest.mark.analysis
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO_ROOT, "photon_ml_tpu")
 
-ALL_RULES = ("PL001", "PL002", "PL003", "PL004", "PL005", "PL006", "PL007")
+ALL_RULES = (
+    "PL001", "PL002", "PL003", "PL004", "PL005", "PL006", "PL007",
+    "PL008",
+)
 
 
 def lint_source(tmp_path, code, name="snippet.py"):
@@ -536,6 +539,112 @@ class TestPL007:
 
 
 # ---------------------------------------------------------------------------
+# PL008 span-context-drop
+# ---------------------------------------------------------------------------
+
+
+class TestPL008:
+    def test_thread_spawn_drops_trace(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            def handle(request, trace):
+                t = threading.Thread(target=score, args=(request,))
+                t.start()
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL008"]
+        assert "trace" in res.findings[0].message
+        assert "orphaned" in res.findings[0].message
+
+    def test_executor_submit_drops_trace_id(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            def enqueue(pool, request, trace_id):
+                return pool.submit(score, request)
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL008"]
+
+    def test_create_task_drops_span_context(self, tmp_path):
+        res = lint_source(
+            tmp_path,
+            """
+            async def dispatch(loop, request, span_ctx):
+                loop.create_task(reply(request))
+            """,
+        )
+        assert [f.rule for f in res.findings] == ["PL008"]
+
+    def test_forwarding_in_args_stays_silent(self, tmp_path):
+        # the near-misses: explicit forwarding, in every idiom the
+        # serving fabric actually uses
+        res = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            def positional(request, trace):
+                t = threading.Thread(target=score, args=(request, trace))
+                t.start()
+
+            def keyword(batcher, request, trace):
+                return batcher.submit(request, trace=trace)
+
+            async def task_arg(loop, conn, request, trace):
+                loop.create_task(reply(conn, request, trace))
+            """,
+        )
+        assert res.findings == []
+
+    def test_closure_capture_stays_silent(self, tmp_path):
+        # Thread(target=worker) where worker closes over the context IS
+        # forwarding — the spawned work can stamp its spans
+        res = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            def handle(request, trace):
+                def worker():
+                    emit(trace, score(request))
+
+                threading.Thread(target=worker).start()
+            """,
+        )
+        assert res.findings == []
+
+    def test_opaque_kwargs_stays_silent(self, tmp_path):
+        # **kw may carry the context; the ratchet does not guess
+        res = lint_source(
+            tmp_path,
+            """
+            def relay(batcher, request, trace, kw):
+                return batcher.submit(request, **kw)
+            """,
+        )
+        assert res.findings == []
+
+    def test_no_context_param_stays_silent(self, tmp_path):
+        # spawning without ever holding a context is not a drop
+        res = lint_source(
+            tmp_path,
+            """
+            import threading
+
+            def start_worker(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                return t
+            """,
+        )
+        assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -824,6 +933,12 @@ SEEDS = {
         "    except Exception:\n"
         "        pass\n",
         4,
+    ),
+    "PL008": (
+        "import threading\n"
+        "def handle(request, trace):\n"
+        "    threading.Thread(target=request).start()\n",
+        3,
     ),
 }
 

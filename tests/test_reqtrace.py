@@ -1,0 +1,474 @@
+"""Request-causality drills (docs/OBSERVABILITY.md "Request tracing"):
+trace ids across the serving fabric, interleaved streaming replies,
+exemplar-ring bounds, timeline reconstruction, and the fleet console.
+
+CPU-only, tier-1-safe: every scorer is the deterministic frontend fake
+(score == the request's ``offset``), so the drills exercise the wire
+protocol, the batcher's retro-spans, and the offline join without JAX
+compiles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import obs
+from photon_ml_tpu.cli import obs_tools
+from photon_ml_tpu.cli.serve import make_admin_handler
+from photon_ml_tpu.frontend import (
+    FrontendClient,
+    FrontendServer,
+    ReplicaRouter,
+    TenantManager,
+)
+from photon_ml_tpu.obs import reqtrace
+from photon_ml_tpu.obs.exemplars import ExemplarStore, set_store
+from photon_ml_tpu.resilience.faults import FaultSpec, inject
+
+pytestmark = [pytest.mark.obs, pytest.mark.frontend]
+
+
+def echo_score(batch):
+    return np.asarray([r.offset for r in batch])
+
+
+def read_events(trace_dir):
+    path = os.path.join(trace_dir, "events.jsonl")
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()], path
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+
+class TestTraceIds:
+    def test_valid_client_id_passes_through(self):
+        tid, issued = reqtrace.ensure_trace_id("client-id_1.2:x")
+        assert tid == "client-id_1.2:x" and not issued
+
+    @pytest.mark.parametrize(
+        "bad", [None, 7, "", "has space", "x" * 65, "bad\nnewline", {}]
+    )
+    def test_garbage_is_replaced_not_errored(self, bad):
+        tid, issued = reqtrace.ensure_trace_id(bad)
+        assert issued and reqtrace.valid_trace_id(tid)
+
+    def test_issued_ids_are_unique_and_valid(self):
+        ids = {reqtrace.new_trace_id() for _ in range(512)}
+        assert len(ids) == 512
+        assert all(reqtrace.valid_trace_id(t) for t in ids)
+
+
+# ---------------------------------------------------------------------------
+# exemplar rings
+# ---------------------------------------------------------------------------
+
+
+class TestExemplarStore:
+    def test_ring_bound_and_eviction(self):
+        # 100% keep + tiny rings: the ring NEVER grows past its bound
+        # and holds the most recent entries (oldest evicted first)
+        st = ExemplarStore(fast_fraction=1.0, ring_size=4)
+        for i in range(64):
+            st.record(f"t-{i}", 5.0)  # one bucket: same latency
+        assert st.recorded == 64 and st.kept == 64
+        got = st.lookup(ge_ms=0.0)
+        assert [e["trace"] for e in got] == [
+            "t-60", "t-61", "t-62", "t-63"
+        ]
+
+    def test_keep_classes_survive_zero_sampling(self):
+        # fast_fraction=0: the healthy fast path keeps NOTHING, the
+        # outcome classes still keep 100%
+        st = ExemplarStore(fast_fraction=0.0, tail_frac=0.0, ring_size=8)
+        for i in range(32):
+            st.record(f"ok-{i}", 1.0)
+        st.record("boom-1", 1.0, outcome="error")
+        st.record("late-1", 1.0, outcome="expired")
+        st.record("cut-1", 1.0, outcome="shed")
+        st.record("deg-1", 1.0, degraded=True)
+        st.record("hop-1", 1.0, failover=True)
+        snap = st.snapshot()
+        assert snap["kept_by"]["sampled"] == 0
+        for cls, tid in [
+            ("error", "boom-1"), ("expired", "late-1"),
+            ("shed", "cut-1"), ("degraded", "deg-1"),
+            ("failover", "hop-1"),
+        ]:
+            assert [e["trace"] for e in st.lookup(cls=cls)] == [tid], cls
+
+    def test_slow_tail_and_bucket_lookup(self):
+        # a latency spike lands in a high bucket; ge_ms hands back its
+        # trace ids (the histogram-bucket -> exemplars query)
+        st = ExemplarStore(fast_fraction=0.0, tail_frac=0.05, ring_size=8)
+        for i in range(200):
+            st.record(f"fast-{i}", 1.0 + (i % 10) * 0.01)
+        st.record("spike-1", 250.0)
+        slow = st.lookup(ge_ms=100.0)
+        assert [e["trace"] for e in slow] == ["spike-1"]
+        # the rolling tail also keeps the RELATIVE slowest of the fast
+        # spread (that is the point); the spike is its newest entry
+        tail = [e["trace"] for e in st.lookup(cls="slow")]
+        assert tail[-1] == "spike-1"
+        assert len(tail) <= st.ring_size
+        snap = st.snapshot()
+        assert snap["slow_threshold_ms"] is not None
+        assert any(
+            e["trace"] == "spike-1"
+            for b in snap["buckets"] for e in b["exemplars"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# reconstruction unit drills (synthetic records)
+# ---------------------------------------------------------------------------
+
+
+def _span(name, trace=None, batch_id=None, t=0.0, **args):
+    rec = {"kind": "span", "name": name, "time_unix": t,
+           "duration_ms": 1.0}
+    if trace is not None:
+        rec["trace"] = trace
+    if batch_id is not None:
+        rec["batch_id"] = batch_id
+    rec.update(args)
+    return rec
+
+
+class TestReconstruction:
+    def test_cache_miss_and_degraded_join_via_batch_id(self):
+        records = [
+            _span("frontend.wire_read", trace="t1", t=0.0),
+            _span("serving.request", trace="t1", batch_id=7, t=3.0,
+                  request_id=11, degraded=True, queue_wait_ms=0.5,
+                  wire_read_ms=0.1, assembly_ms=0.2, device_ms=1.0),
+            _span("serving.cache.miss", batch_id=7, t=1.0, misses=3),
+            _span("serving.cache.promotion", batch_id=7, t=2.0),
+            # a DIFFERENT trace's span in the same batch stays out
+            _span("serving.request", trace="t2", batch_id=7, t=3.0,
+                  request_id=12),
+            # an unrelated batch stays out entirely
+            _span("serving.cache.miss", batch_id=8, t=1.5, misses=9),
+        ]
+        tl = reqtrace.reconstruct_timeline(records, "t1")
+        assert tl["complete"] and not tl["truncated"]
+        assert tl["degraded"] and tl["cache_misses"] == 3
+        assert tl["batch_ids"] == [7]
+        names = [r["name"] for r in tl["events"]]
+        assert "serving.cache.promotion" in names
+        assert all(r.get("trace") in (None, "t1") for r in tl["events"])
+        seg = tl["segments"]
+        assert set(seg) == {"wire_read_ms", "queue_wait_ms",
+                           "assembly_ms", "device_ms"}
+
+    def test_unknown_trace_is_none(self):
+        assert reqtrace.reconstruct_timeline([_span("x", trace="a")],
+                                             "zzz") is None
+
+    def test_find_orphans_flags_unclaimed_batch_work(self):
+        records = [
+            _span("serving.request", trace="t1", batch_id=1,
+                  request_id=1),
+            _span("replica.hop", batch_id=1, replica="r0", attempt=1),
+            _span("replica.hop", batch_id=99, replica="r0", attempt=1),
+        ]
+        tl = reqtrace.reconstruct_timeline(records, "t1")
+        orphans = reqtrace.find_orphans(records, [tl])
+        assert [o.get("batch_id") for o in orphans] == [99]
+
+
+# ---------------------------------------------------------------------------
+# the concurrent-connection streaming drill
+# ---------------------------------------------------------------------------
+
+
+class TestInterleavedStreams:
+    def test_two_clients_streaming_out_of_order(self, tmp_path):
+        """Two connections stream traced batches through one fabric at
+        once; the fast client's DONE arrives while the slow client's
+        rows are still in flight. Every streamed row echoes its own
+        trace id, and the reconstructed timelines claim disjoint
+        hops/batches."""
+
+        def scorer(batch):
+            if any(r.offset >= 1000 for r in batch):
+                time.sleep(0.05)  # the slow client's rows
+            return np.asarray([float(r.offset) for r in batch])
+
+        td = str(tmp_path / "trace")
+        with obs.trace(td):
+            router = ReplicaRouter([("r0", scorer)])
+            # max_batch=1: each row is its own batch, so no batch-scoped
+            # record is legitimately shared between the two timelines
+            tm = TenantManager(max_batch=1, max_wait_ms=0.2)
+            tm.add_tenant("t0", router.score)
+            with FrontendServer(tm.submit, default_tenant="t0") as srv:
+                replies = {"A": [], "B": []}
+                order = []
+                lock = threading.Lock()
+
+                def drain(label, cli):
+                    while True:
+                        msg = cli.recv()
+                        with lock:
+                            replies[label].append(msg)
+                            order.append((label, msg))
+                        if "done" in msg:
+                            return
+
+                with FrontendClient("127.0.0.1", srv.port) as ca, \
+                        FrontendClient("127.0.0.1", srv.port) as cb:
+                    # Admission order is pinned: B's frame is already
+                    # dispatched (its first row streamed back) before
+                    # the slow client's batch lands behind it, so B's
+                    # DONE beats A's deterministically even on a
+                    # loaded single-CPU runner, while both
+                    # connections drain concurrently.
+                    cb.submit({
+                        "trace": "client-B", "stream": True,
+                        "batch": [{"offset": o} for o in (1.0, 2.0, 3.0)],
+                    })
+                    first = cb.recv()
+                    with lock:
+                        replies["B"].append(first)
+                        order.append(("B", first))
+                    ca.submit({
+                        "trace": "client-A", "stream": True,
+                        "batch": [
+                            {"offset": o} for o in (1000.0, 1001.0, 1002.0)
+                        ],
+                    })
+                    ta = threading.Thread(target=drain, args=("A", ca))
+                    tb = threading.Thread(target=drain, args=("B", cb))
+                    ta.start(); tb.start()
+                    ta.join(30.0); tb.join(30.0)
+            tm.drain(timeout=10.0)
+
+        # wire-level isolation: every reply carries its own trace id,
+        # and the interleaving really happened (B finished while A's
+        # slow rows were still streaming)
+        for label, trace in (("A", "client-A"), ("B", "client-B")):
+            msgs = replies[label]
+            assert all(m.get("trace") == trace for m in msgs), msgs
+            rows = [m for m in msgs if "seq" in m]
+            assert [m["score"] for m in rows] == sorted(
+                m["score"] for m in rows
+            )
+        done_idx = {
+            label: next(
+                i for i, (lb, m) in enumerate(order)
+                if lb == label and "done" in m
+            )
+            for label in ("A", "B")
+        }
+        assert done_idx["B"] < done_idx["A"], order
+
+        records, _ = read_events(td)
+        tl_a = reqtrace.reconstruct_timeline(records, "client-A")
+        tl_b = reqtrace.reconstruct_timeline(records, "client-B")
+        for tl in (tl_a, tl_b):
+            assert tl is not None and tl["complete"]
+            assert len(tl["hops"]) == 3
+            assert len(tl["batch_ids"]) == 3
+        # each timeline contains ONLY its own hops
+        assert not set(tl_a["batch_ids"]) & set(tl_b["batch_ids"])
+        for tl, own in ((tl_a, "client-A"), (tl_b, "client-B")):
+            assert all(
+                r.get("trace") in (None, own) for r in tl["events"]
+            )
+        assert reqtrace.find_orphans(records, [tl_a, tl_b]) == []
+
+
+# ---------------------------------------------------------------------------
+# photon-obs request: the e2e CLI reconstruction (incl. forced failover)
+# ---------------------------------------------------------------------------
+
+
+class TestRequestCli:
+    def _traced_failover_run(self, td):
+        prev = set_store(ExemplarStore(fast_fraction=1.0))
+        try:
+            with obs.trace(td):
+                router = ReplicaRouter(
+                    [("r0", echo_score), ("r1", echo_score)],
+                    failure_threshold=2, backoff_s=30.0,
+                )
+                tm = TenantManager(max_batch=4, max_wait_ms=0.5)
+                tm.add_tenant("t0", router.score)
+                with FrontendServer(
+                    tm.submit, default_tenant="t0"
+                ) as srv:
+                    with FrontendClient("127.0.0.1", srv.port) as cli:
+                        # r0 dies on contact -> the batch fails over
+                        with inject(FaultSpec(
+                            "replica.route", "raise", nth=1, count=-1,
+                            key="r0",
+                        )):
+                            r = cli.call({
+                                "trace": "req-e2e-1", "offset": 42.0,
+                            })
+                assert r["score"] == 42.0 and r["trace"] == "req-e2e-1"
+                tm.drain(timeout=10.0)
+        finally:
+            set_store(prev)
+
+    def test_failover_timeline_via_cli(self, tmp_path, capsys):
+        td = str(tmp_path / "trace")
+        self._traced_failover_run(td)
+        _, events_path = read_events(td)
+        rc = obs_tools.main(["request", "req-e2e-1", events_path])
+        captured = capsys.readouterr()
+        assert rc == 0
+        doc = json.loads(captured.out.strip().splitlines()[-1])
+        assert doc["metric"] == "obs_request"
+        extra = doc["extra"]
+        assert extra["trace"] == "req-e2e-1"
+        assert extra["complete"] and not extra["truncated"]
+        assert extra["failover"] and extra["hops"] == 2
+        for seg in ("wire_read_ms", "queue_wait_ms", "assembly_ms",
+                    "device_ms", "reply_write_ms"):
+            assert seg in extra["segments"], extra["segments"]
+        # the human rendering names the failed hop and the retry
+        assert "replica=r0" in captured.err
+        assert "FAILED" in captured.err
+        assert "replica=r1" in captured.err
+
+    def test_unknown_trace_exits_2_with_suggestions(
+        self, tmp_path, capsys
+    ):
+        td = str(tmp_path / "trace")
+        self._traced_failover_run(td)
+        _, events_path = read_events(td)
+        rc = obs_tools.main(["request", "no-such-trace", events_path])
+        captured = capsys.readouterr()
+        assert rc == 2
+        assert "not found" in captured.err
+        assert "req-e2e-1" in captured.err  # recent-trace suggestion
+
+
+# ---------------------------------------------------------------------------
+# photon-obs top: the fleet console gate (2 replicas x 2 tenants)
+# ---------------------------------------------------------------------------
+
+
+def _replica_process(tenants=("gold", "bronze")):
+    """One in-process 'replica': router + tenant manager + frontend
+    with the full admin channel (the shape cli/serve.py wires)."""
+    router = ReplicaRouter([("r0", echo_score)])
+    tm = TenantManager(max_batch=4, max_wait_ms=0.5)
+    for name in tenants:
+        tm.add_tenant(name, router.score)
+    srv = FrontendServer(
+        tm.submit,
+        admin_fn=make_admin_handler(
+            tm.batcher, stats=tm.stats, tenants=tm,
+            replicas={name: router for name in tenants},
+        ),
+        default_tenant=tenants[0],
+    )
+    srv.start()
+    return srv, tm
+
+
+class TestFleetTop:
+    def test_top_once_json_aggregates_two_replicas(
+        self, tmp_path, capsys
+    ):
+        s1, tm1 = _replica_process()
+        s2, tm2 = _replica_process()
+        try:
+            for srv in (s1, s2):
+                with FrontendClient("127.0.0.1", srv.port) as cli:
+                    for tenant in ("gold", "bronze"):
+                        r = cli.call({
+                            "tenant": tenant, "offset": 5.0,
+                        })
+                        assert r["score"] == 5.0, r
+            out_path = str(tmp_path / "fleet-snapshot.json")
+            rc = obs_tools.main([
+                "top",
+                "--endpoint", f"127.0.0.1:{s1.port}",
+                "--endpoint", f"127.0.0.1:{s2.port}",
+                "--once", "--json", "--out", out_path,
+            ])
+            captured = capsys.readouterr()
+        finally:
+            for srv, tm in ((s1, tm1), (s2, tm2)):
+                srv.stop()
+                tm.drain(timeout=10.0)
+        assert rc == 0
+        snap = json.loads(captured.out.strip().splitlines()[-1])
+        # the schema-stable shape the acceptance gate names
+        assert snap["schema"] == 1
+        assert snap["endpoints"] == 2 and snap["reachable"] == 2
+        assert set(snap["tenants"]) == {"gold", "bronze"}
+        for ten in snap["tenants"].values():
+            assert ten["endpoints"] == 2
+            assert ten["submitted"] >= 2 and ten["completed"] >= 2
+            for key in ("outstanding", "failed", "rejected",
+                        "over_quota_submits", "p99_ms",
+                        "violation_rate", "slo_met"):
+                assert key in ten
+        assert len(snap["replicas"]) == 2
+        for rep in snap["replicas"].values():
+            assert rep["reachable"] and rep["error"] is None
+            assert rep["qps"] is not None
+            assert rep["queue_depth"] is not None
+            assert "gold/r0" in rep["breakers"]
+            assert rep["breakers"]["gold/r0"]["state"] == "closed"
+            for key in ("p99_ms", "degraded", "draining", "failovers",
+                        "cache_hit_frac", "resident_re_bytes",
+                        "shards", "drift", "lifecycle_alarm_latched"):
+                assert key in rep
+        fleet = snap["fleet"]
+        for key in ("qps", "requests", "shed", "expired", "errors",
+                    "worst_p99_ms", "slo_met", "drift_alarm",
+                    "lifecycle_alarm"):
+            assert key in fleet
+        assert fleet["requests"] >= 4 and fleet["slo_met"] is True
+        # the --out artifact matches the printed snapshot
+        with open(out_path, encoding="utf-8") as f:
+            assert json.load(f)["endpoints"] == 2
+
+    def test_unreachable_endpoint_is_schema_stable(self, capsys):
+        s1, tm1 = _replica_process(tenants=("gold",))
+        try:
+            snap = obs_tools.collect_fleet_snapshot([
+                f"127.0.0.1:{s1.port}",
+                "127.0.0.1:1",  # nothing listens here
+            ], timeout=2.0)
+        finally:
+            s1.stop()
+            tm1.drain(timeout=10.0)
+        assert snap["endpoints"] == 2 and snap["reachable"] == 1
+        dead = snap["replicas"]["127.0.0.1:1"]
+        assert not dead["reachable"] and dead["error"]
+        # every replica entry keeps the full schema even when dead
+        assert set(dead) == set(
+            snap["replicas"][f"127.0.0.1:{s1.port}"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# the trace_loss chaos drill end to end
+# ---------------------------------------------------------------------------
+
+
+class TestTraceLossDrill:
+    def test_drill_runs_clean(self):
+        from photon_ml_tpu.resilience.drills import DRILLS
+
+        out = DRILLS["trace_loss"](True)
+        assert out["orphan_records"] == 0
+        assert out["complete_timelines"] == 2 * (out["requests"] // 3)
+        assert out["truncated_timelines"] == out["requests"] // 3
+        assert out["failover_timelines"] >= 1
+        assert out["error_exemplars"] >= 1
